@@ -1,0 +1,37 @@
+"""mlops_tpu — a TPU-native MLOps framework.
+
+Brand-new implementation (JAX/XLA/Flax/optax/Pallas) of the capabilities of
+the reference MLOps proof-of-concept (``nfmoore/databricks-kubernetes-mlops-poc``):
+train a credit-card-default classifier with hyperparameter search and tracked
+metrics, package it as a versioned bundle pairing the model with drift and
+outlier detectors, serve it over HTTP ``POST /predict`` with structured
+per-request JSON logging, and promote it through a containerized
+staging -> smoke-test -> gated-production pipeline.
+
+Layer map (mirrors SURVEY.md SS1, re-based on TPU):
+
+- ``schema``   single source of truth for the 23-feature contract
+  (reference duplicates it three times: notebooks 01/02 cell 4 and
+  ``app/model.py:8-34``).
+- ``data``     CSV/Parquet ingest + synthetic generator + stats fit +
+  fixed-shape device encoding (replaces Spark external table,
+  ``databricks/src/00-create-external-table.ipynb``).
+- ``models``   Flax model zoo (MLP, FT-Transformer, linear) — replaces the
+  sklearn RandomForest pipeline (``01-train-model.ipynb:195-227``).
+- ``ops``      pure-JAX / Pallas numerics: drift tests, outlier scores,
+  fused predict.
+- ``monitor``  drift + outlier detector fit/state (replaces alibi-detect
+  TabularDrift + IForest, ``02-register-model.ipynb:225-233``).
+- ``train``    optax loop under jit/pjit, vmapped+sharded HPO (replaces
+  hyperopt fmin, ``01-train-model.ipynb:333-360``).
+- ``bundle``   versioned model bundle + registry (replaces the MLflow pyfunc
+  CustomModel + model registry, ``02-register-model.ipynb:305-353,461-470``).
+- ``parallel`` device mesh / sharding / collectives helpers (the reference has
+  no distributed compute at all — SURVEY.md SS2.7).
+- ``serve``    asyncio HTTP server + micro-batching engine (replaces
+  FastAPI/uvicorn + mlflow pyfunc serving, ``app/main.py``).
+"""
+
+from mlops_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,54 @@
+"""Command-line entry point: train | tune | register | serve | bench | predict-file.
+
+Replaces the reference's operational surface (Databricks bundle job runs,
+`databricks bundle run train_register_model_job` — `deploy-kubernetes.yml:61`
+— and ad-hoc notebook widgets) with one typed CLI.
+
+Subcommands land with their subsystems; this module grows with the framework.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="mlops-tpu",
+        description="TPU-native credit-default MLOps framework",
+    )
+    parser.add_argument(
+        "--config", default=None, help="path to a TOML config file"
+    )
+    sub = parser.add_subparsers(dest="command")
+    for name, help_text in [
+        ("synth", "generate a synthetic schema-conforming CSV"),
+        ("train", "train a model and write a bundle"),
+        ("tune", "hyperparameter search (vmapped + sharded trials)"),
+        ("register", "register a bundle in the model registry"),
+        ("serve", "serve a bundle over HTTP"),
+        ("bench", "run the inference benchmark"),
+        ("predict-file", "batch-score a CSV offline"),
+    ]:
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument(
+            "overrides",
+            nargs="*",
+            help="config overrides, e.g. train.steps=500",
+        )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command is None:
+        build_parser().print_help()
+        return 1
+    from mlops_tpu import commands
+
+    return commands.run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

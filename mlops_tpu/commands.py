@@ -1,0 +1,30 @@
+"""CLI subcommand implementations. Grows with the framework."""
+
+from __future__ import annotations
+
+import argparse
+
+from mlops_tpu.config import load_config
+
+
+def run(args: argparse.Namespace) -> int:
+    config = load_config(args.config, overrides=getattr(args, "overrides", []))
+    handler = _HANDLERS.get(args.command)
+    if handler is None:
+        raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
+    return handler(config) or 0
+
+
+def _synth(config) -> int:
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+
+    path = config.data.train_path or "data/curated.csv"
+    columns, labels = generate_synthetic(config.data.rows, seed=config.data.seed)
+    write_csv_columns(path, columns, labels)
+    print(f"wrote {config.data.rows} rows -> {path}")
+    return 0
+
+
+_HANDLERS = {
+    "synth": _synth,
+}

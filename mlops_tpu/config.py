@@ -1,0 +1,158 @@
+"""Typed configuration tree.
+
+The reference scatters configuration across four ad-hoc mechanisms (notebook
+widgets, bundle variables, env vars, CI secrets/vars — SURVEY.md SS5.6). Here
+a single dataclass tree covers model/train/serve/mesh, loadable from TOML,
+overridable from environment (``MLOPS_TPU_<SECTION>_<FIELD>``) and CLI flags
+(``--section.field=value``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+import warnings
+from pathlib import Path
+from typing import Any
+
+
+@dataclasses.dataclass
+class DataConfig:
+    train_path: str = ""  # empty -> synthetic
+    rows: int = 50_000  # synthetic row count
+    seed: int = 0
+    valid_fraction: float = 0.2  # parity: train_test_split 80/20,
+    # random_state=2024 (`01-train-model.ipynb` cell 7)
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    family: str = "mlp"  # mlp | ft_transformer | linear
+    hidden_dims: tuple[int, ...] = (256, 256, 128)
+    embed_dim: int = 16
+    dropout: float = 0.1
+    # FT-Transformer specifics
+    depth: int = 3
+    heads: int = 8
+    token_dim: int = 64
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    batch_size: int = 1024
+    steps: int = 2000
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 100
+    seed: int = 0
+    eval_every: int = 200
+    checkpoint_every: int = 500
+    pos_weight: float = 1.0  # class-imbalance weight on the positive class
+    precision: str = "bf16"  # compute dtype on MXU: bf16 | f32
+
+
+@dataclasses.dataclass
+class HPOConfig:
+    """Hyperparameter search (replaces hyperopt TPE ``fmin(max_evals=10)``,
+    `01-train-model.ipynb:342-353`). Trials with identical architectures are
+    vmapped; distinct architectures loop; everything shards across the mesh."""
+
+    trials: int = 10
+    seed: int = 2024
+    objective: str = "roc_auc"  # selection metric, parity with
+    # `mlflow.search_runs(order_by validation_roc_auc_score DESC)` (cell 10)
+    steps: int = 1000
+
+
+@dataclasses.dataclass
+class MonitorConfig:
+    drift_p_val: float = 0.05  # parity: TabularDrift(p_val=.05)
+    outlier_quantile: float = 0.95  # parity: IForest(threshold=0.95)
+    drift_ref_size: int = 2048  # per-feature reference sample for K-S
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    host: str = "0.0.0.0"
+    port: int = 5000  # parity: `app/Dockerfile:22-24`
+    service_name: str = "credit-default-api"
+    model_directory: str = "model"  # parity: MODEL_DIRECTORY (`app/main.py:27`)
+    max_batch: int = 256
+    batch_window_ms: float = 1.0  # micro-batching window
+    warmup_batch_sizes: tuple[int, ...] = (1, 8, 64, 256)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    data_axis: int = 0  # 0 -> use all devices on the data axis
+    model_axis: int = 1
+
+
+@dataclasses.dataclass
+class Config:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    hpo: HPOConfig = dataclasses.field(default_factory=HPOConfig)
+    monitor: MonitorConfig = dataclasses.field(default_factory=MonitorConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+
+def _coerce(current: Any, raw: str) -> Any:
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, tuple):
+        inner = type(current[0]) if current else int
+        return tuple(inner(x) for x in raw.strip("()[] ").split(",") if x.strip())
+    return raw
+
+
+def _apply(config: Config, section: str, field: str, value: Any) -> None:
+    sub = getattr(config, section, None)
+    if sub is None or not hasattr(sub, field):
+        raise KeyError(f"unknown config key {section}.{field}")
+    current = getattr(sub, field)
+    if isinstance(value, str) and not isinstance(current, str):
+        value = _coerce(current, value)
+    if isinstance(current, tuple) and isinstance(value, list):
+        value = tuple(value)
+    setattr(sub, field, value)
+
+
+def load_config(
+    toml_path: str | Path | None = None,
+    overrides: list[str] | None = None,
+    env: dict[str, str] | None = None,
+) -> Config:
+    """Build a Config: defaults <- TOML <- env <- CLI overrides."""
+    config = Config()
+    if toml_path:
+        with open(toml_path, "rb") as f:
+            doc = tomllib.load(f)
+        for section, fields in doc.items():
+            for field, value in fields.items():
+                _apply(config, section, field, value)
+    env = dict(os.environ if env is None else env)
+    for key, raw in env.items():
+        if not key.startswith("MLOPS_TPU_"):
+            continue
+        parts = key[len("MLOPS_TPU_") :].lower().split("_", 1)
+        if len(parts) != 2:
+            warnings.warn(f"ignoring malformed env override {key}", stacklevel=2)
+            continue
+        section, field = parts
+        try:
+            _apply(config, section, field, raw)
+        except KeyError:
+            warnings.warn(f"ignoring unknown env override {key}", stacklevel=2)
+    for item in overrides or []:
+        key, _, raw = item.partition("=")
+        section, _, field = key.strip("-").partition(".")
+        _apply(config, section, field, raw)
+    return config

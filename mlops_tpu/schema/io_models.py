@@ -1,0 +1,68 @@
+"""Pydantic I/O models, generated from the canonical schema.
+
+Wire-compatible with the reference serving contract:
+
+- request body = ``list[LoanApplicant]`` (`app/main.py:43`, `app/model.py:8-34`)
+- response = ``ModelOutput{predictions, outliers, feature_drift_batch}``
+  (`app/model.py:64-70`), where ``feature_drift_batch`` carries one drift
+  score per feature (`app/model.py:37-61`).
+
+Unlike the reference, these classes are *generated* from
+``mlops_tpu.schema.features.SCHEMA`` via ``pydantic.create_model`` — no
+hand-maintained duplicate field lists — and they do not replicate the
+reference's ``@dataclasses.dataclass``-on-``BaseModel`` bug
+(`app/model.py:8-9`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, create_model
+
+from mlops_tpu.schema.features import SCHEMA
+
+_applicant_fields: dict[str, Any] = {}
+for _cat in SCHEMA.categorical:
+    _applicant_fields[_cat.name] = (str, _cat.default)
+for _num in SCHEMA.numeric:
+    _applicant_fields[_num.name] = (float, _num.default)
+
+LoanApplicant = create_model(
+    "LoanApplicant",
+    __config__=ConfigDict(extra="ignore"),
+    **_applicant_fields,
+)
+LoanApplicant.__doc__ = "Loan applicant record (23 features, schema-generated)."
+
+FeatureBatchDrift = create_model(
+    "FeatureBatchDrift",
+    **{name: (float, ...) for name in SCHEMA.feature_names},
+)
+FeatureBatchDrift.__doc__ = (
+    "Per-feature batch drift score (1 - p_value), one field per feature."
+)
+
+
+class ModelOutput(BaseModel):
+    """Response of ``POST /predict`` (parity: `app/model.py:64-70`)."""
+
+    predictions: list[float]
+    outliers: list[float]
+    feature_drift_batch: FeatureBatchDrift  # type: ignore[valid-type]
+
+
+def records_to_columns(records: list[Any]) -> dict[str, list]:
+    """Pivot a list of LoanApplicant-like records into columnar lists.
+
+    Accepts pydantic models or plain dicts; missing keys take schema defaults.
+    """
+    columns: dict[str, list] = {name: [] for name in SCHEMA.feature_names}
+    for record in records:
+        data = record if isinstance(record, dict) else record.__dict__
+        for cat in SCHEMA.categorical:
+            columns[cat.name].append(str(data.get(cat.name, cat.default)))
+        for num in SCHEMA.numeric:
+            value = data.get(num.name, num.default)
+            columns[num.name].append(float(value) if value is not None else num.default)
+    return columns

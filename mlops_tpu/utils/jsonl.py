@@ -1,0 +1,34 @@
+"""Append-only JSONL writer for training metrics.
+
+Replaces the reference's MLflow metric logging
+(`01-train-model.ipynb:296-304`) with a local, greppable metrics stream that
+the registry manifest links to.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+
+class JsonlWriter:
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self.path.open("a")
+
+    def write(self, record: dict[str, Any]) -> None:
+        record = {"ts": time.time(), **record}
+        self._f.write(json.dumps(record, default=float) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "JsonlWriter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,27 @@
+"""Wall-clock timing helpers (used by serving metrics and bench)."""
+
+from __future__ import annotations
+
+import math
+import time
+
+
+class Timer:
+    """Context-manager stopwatch: ``with Timer() as t: ...; t.ms``."""
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.seconds = time.perf_counter() - self._start
+        self.ms = self.seconds * 1e3
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted list."""
+    if not sorted_values:
+        return float("nan")
+    n = len(sorted_values)
+    rank = min(n - 1, max(0, math.ceil(q / 100.0 * n) - 1))
+    return sorted_values[rank]

@@ -1,0 +1,72 @@
+"""Test harness: force an 8-device CPU-simulated mesh before JAX imports.
+
+Standard JAX fake-backend trick (SURVEY.md SS4 build obligation (d)): all
+multi-chip logic is exercised without a TPU via
+``--xla_force_host_platform_device_count=8``. Real-TPU benchmarks run
+out-of-band through ``bench.py``.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def synth_small():
+    from mlops_tpu.data import generate_synthetic
+
+    columns, labels = generate_synthetic(2000, seed=7)
+    return columns, labels
+
+
+@pytest.fixture(scope="session")
+def encoded_small(synth_small):
+    from mlops_tpu.data import Preprocessor
+
+    columns, labels = synth_small
+    prep = Preprocessor.fit(columns)
+    return prep, prep.encode(columns, labels)
+
+
+@pytest.fixture(scope="session")
+def sample_request():
+    """The reference's exact smoke-test payload (`app/sample-request.json`)."""
+    return [
+        {
+            "sex": "male",
+            "education": "university",
+            "marriage": "married",
+            "repayment_status_1": "duly_paid",
+            "repayment_status_2": "duly_paid",
+            "repayment_status_3": "duly_paid",
+            "repayment_status_4": "duly_paid",
+            "repayment_status_5": "no_delay",
+            "repayment_status_6": "no_delay",
+            "credit_limit": 18000,
+            "age": 18000,
+            "bill_amount_1": 764.95,
+            "bill_amount_2": 2221.95,
+            "bill_amount_3": 1131.85,
+            "bill_amount_4": 5074.85,
+            "bill_amount_5": 18000,
+            "bill_amount_6": 1419.95,
+            "payment_amount_1": 2236.5,
+            "payment_amount_2": 1137.55,
+            "payment_amount_3": 5084.55,
+            "payment_amount_4": 111.65,
+            "payment_amount_5": 306.9,
+            "payment_amount_6": 805.65,
+        }
+    ]

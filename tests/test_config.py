@@ -1,0 +1,35 @@
+"""Config tree tests: TOML + env + CLI override layering."""
+
+import pytest
+
+from mlops_tpu.config import Config, load_config
+
+
+def test_defaults():
+    config = load_config(env={})
+    assert config.serve.port == 5000  # parity: app/Dockerfile EXPOSE 5000
+    assert config.monitor.drift_p_val == 0.05
+    assert config.hpo.trials == 10  # parity: hyperopt max_evals=10
+
+
+def test_toml_and_overrides(tmp_path):
+    toml = tmp_path / "config.toml"
+    toml.write_text(
+        '[train]\nbatch_size = 512\n[model]\nfamily = "ft_transformer"\n'
+        "hidden_dims = [64, 64]\n"
+    )
+    config = load_config(toml, overrides=["train.steps=42"], env={})
+    assert config.train.batch_size == 512
+    assert config.model.family == "ft_transformer"
+    assert config.model.hidden_dims == (64, 64)
+    assert config.train.steps == 42
+
+
+def test_env_overrides():
+    config = load_config(env={"MLOPS_TPU_SERVE_PORT": "8080"})
+    assert config.serve.port == 8080
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        load_config(overrides=["nope.nope=1"], env={})

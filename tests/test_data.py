@@ -1,0 +1,82 @@
+"""Data pipeline tests: synthesis, ingest round-trip, encoding."""
+
+import numpy as np
+
+from mlops_tpu.data import (
+    Preprocessor,
+    generate_synthetic,
+    load_csv_columns,
+    write_csv_columns,
+)
+from mlops_tpu.schema import NUM_CATEGORICAL, NUM_NUMERIC, SCHEMA
+
+
+def test_synthetic_shapes_and_signal(synth_small):
+    columns, labels = synth_small
+    assert set(columns) == set(SCHEMA.feature_names)
+    assert len(labels) == 2000
+    rate = labels.mean()
+    # Default rate should be in a plausible band (UCI is ~22%).
+    assert 0.05 < rate < 0.6
+    # Signal check: customers with long repayment delays default more.
+    delayed = np.array(
+        [s.startswith("delay") for s in columns["repayment_status_1"]]
+    )
+    assert labels[delayed].mean() > labels[~delayed].mean()
+
+
+def test_synthetic_deterministic():
+    c1, l1 = generate_synthetic(100, seed=3)
+    c2, l2 = generate_synthetic(100, seed=3)
+    assert c1["education"] == c2["education"]
+    assert (l1 == l2).all()
+
+
+def test_csv_round_trip(tmp_path, synth_small):
+    columns, labels = synth_small
+    path = tmp_path / "data.csv"
+    write_csv_columns(path, columns, labels)
+    columns2, labels2 = load_csv_columns(path, require_target=True)
+    assert (labels2 == labels).all()
+    assert columns2["sex"] == columns["sex"]
+    np.testing.assert_allclose(
+        np.asarray(columns2["bill_amount_3"]),
+        np.asarray(columns["bill_amount_3"]),
+        rtol=1e-6,
+    )
+
+
+def test_encode_shapes_and_standardization(encoded_small):
+    prep, ds = encoded_small
+    assert ds.cat_ids.shape == (2000, NUM_CATEGORICAL)
+    assert ds.numeric.shape == (2000, NUM_NUMERIC)
+    assert ds.cat_ids.dtype == np.int32
+    assert ds.numeric.dtype == np.float32
+    # Standardized columns: ~zero mean, ~unit std.
+    np.testing.assert_allclose(ds.numeric.mean(0), 0.0, atol=1e-2)
+    np.testing.assert_allclose(ds.numeric.std(0), 1.0, atol=1e-2)
+    # Ids within cardinality.
+    for j, feat in enumerate(SCHEMA.categorical):
+        assert ds.cat_ids[:, j].max() < feat.card
+
+
+def test_encode_handles_oov_and_nan(encoded_small):
+    prep, _ = encoded_small
+    columns = {f.name: ["???"] for f in SCHEMA.categorical}
+    columns |= {f.name: [float("nan")] for f in SCHEMA.numeric}
+    ds = prep.encode(columns)
+    for j, feat in enumerate(SCHEMA.categorical):
+        assert ds.cat_ids[0, j] == feat.oov_id
+    # NaN -> median -> finite standardized value.
+    assert np.isfinite(ds.numeric).all()
+
+
+def test_preprocessor_save_load(tmp_path, encoded_small):
+    prep, _ = encoded_small
+    path = tmp_path / "prep.npz"
+    prep.save(path)
+    prep2 = Preprocessor.load(path)
+    np.testing.assert_array_equal(prep.numeric_mean, prep2.numeric_mean)
+    np.testing.assert_array_equal(prep.numeric_median, prep2.numeric_median)
+    np.testing.assert_array_equal(prep.numeric_std, prep2.numeric_std)
+    assert prep2.schema_fingerprint == SCHEMA.fingerprint()
