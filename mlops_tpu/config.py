@@ -32,6 +32,7 @@ class ModelConfig:
     hidden_dims: tuple[int, ...] = (256, 256, 128)
     embed_dim: int = 16
     dropout: float = 0.1
+    precision: str = "bf16"  # compute dtype on MXU: bf16 | f32 (params stay f32)
     # FT-Transformer specifics
     depth: int = 3
     heads: int = 8
@@ -49,7 +50,6 @@ class TrainConfig:
     eval_every: int = 200
     checkpoint_every: int = 500
     pos_weight: float = 1.0  # class-imbalance weight on the positive class
-    precision: str = "bf16"  # compute dtype on MXU: bf16 | f32
 
 
 @dataclasses.dataclass

@@ -103,12 +103,12 @@ def generate_synthetic(
     # Default probability: driven by delinquency, utilization, payment ratio.
     payment_ratio = payments.sum(0) / np.maximum(bills.sum(0), 1.0)
     logit = (
-        -2.2
-        + 3.4 * delinquency
-        + 1.1 * np.clip(utilization, 0, 1.2)
-        - 1.3 * payment_ratio
-        + 0.25 * (repayment[0] >= 3)
-        - 0.01 * (age - 37.0)
+        -3.6
+        + 7.0 * delinquency
+        + 2.0 * np.clip(utilization, 0, 1.2)
+        - 2.6 * payment_ratio
+        + 0.5 * (repayment[0] >= 3)
+        - 0.02 * (age - 37.0)
     )
     labels = (rng.random(n) < _sigmoid(logit)).astype(np.int8)
 

@@ -1,0 +1,64 @@
+"""Model zoo — Flax replacements for the reference's sklearn pipeline.
+
+The reference's only model family is
+``SimpleImputer + OneHotEncoder + RandomForestClassifier``
+(`01-train-model.ipynb:195-227`). Tree ensembles don't map onto the MXU, so
+the TPU-native zoo is:
+
+- ``linear``          embedding-sum logistic regression (fast floor)
+- ``mlp``             embeddings + residual MLP (flagship for serving)
+- ``ft_transformer``  feature-tokenized transformer (BASELINE.json config 3)
+
+All families share one calling convention:
+``model.apply(vars, cat_ids[int32 N,C], numeric[f32 N,M], train=...) ->
+logits[f32 N]`` so the trainer, bundle, and server are family-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mlops_tpu.config import ModelConfig
+from mlops_tpu.models.ft_transformer import FTTransformer
+from mlops_tpu.models.mlp import MLP, LinearModel
+from mlops_tpu.schema.features import SCHEMA
+
+FAMILIES = ("linear", "mlp", "ft_transformer")
+
+
+def build_model(config: ModelConfig) -> nn.Module:
+    """Instantiate a model family from config (embedding sizes from SCHEMA)."""
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[config.precision]
+    if config.family == "linear":
+        return LinearModel(cards=SCHEMA.cards, dtype=dtype)
+    if config.family == "mlp":
+        return MLP(
+            cards=SCHEMA.cards,
+            embed_dim=config.embed_dim,
+            hidden_dims=tuple(config.hidden_dims),
+            dropout=config.dropout,
+            dtype=dtype,
+        )
+    if config.family == "ft_transformer":
+        return FTTransformer(
+            cards=SCHEMA.cards,
+            num_numeric=SCHEMA.num_numeric,
+            token_dim=config.token_dim,
+            depth=config.depth,
+            heads=config.heads,
+            dropout=config.dropout,
+            dtype=dtype,
+        )
+    raise ValueError(f"unknown model family {config.family!r}; one of {FAMILIES}")
+
+
+def init_params(model: nn.Module, rng: jax.Array, batch: int = 2):
+    """Initialize variables with dummy fixed-shape inputs."""
+    cat = jnp.zeros((batch, SCHEMA.num_categorical), jnp.int32)
+    num = jnp.zeros((batch, SCHEMA.num_numeric), jnp.float32)
+    return model.init({"params": rng}, cat, num, train=False)
+
+
+__all__ = ["FAMILIES", "FTTransformer", "LinearModel", "MLP", "build_model", "init_params"]

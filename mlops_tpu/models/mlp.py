@@ -1,0 +1,94 @@
+"""Embedding-MLP and linear tabular classifiers.
+
+TPU-first design notes: categorical features enter as int32 ids and hit
+embedding tables (a gather — cheap, HBM-friendly) instead of the reference's
+one-hot matmul (`OneHotEncoder`, `01-train-model.ipynb:204-209`); the trunk is
+dense matmuls in bfloat16 so XLA tiles them onto the MXU and fuses the
+elementwise tail (GELU, LayerNorm, residual) into the matmul epilogue.
+Params stay float32; only compute is bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CategoricalEmbed(nn.Module):
+    """Per-feature embedding tables, concatenated.
+
+    One table per categorical feature (cardinalities from the schema, each
+    including its OOV bucket — parity with ``handle_unknown="ignore"``).
+    """
+
+    cards: Sequence[int]
+    embed_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, cat_ids: jnp.ndarray) -> jnp.ndarray:  # [N, C] -> [N, C*E]
+        pieces = []
+        for j, card in enumerate(self.cards):
+            table = nn.Embed(
+                num_embeddings=card,
+                features=self.embed_dim,
+                dtype=self.dtype,
+                name=f"embed_{j}",
+            )
+            pieces.append(table(cat_ids[:, j]))
+        return jnp.concatenate(pieces, axis=-1)
+
+
+class LinearModel(nn.Module):
+    """Logistic regression with categorical embeddings (scalar embeds).
+
+    The quality floor / sanity baseline — replaces nothing in the reference
+    directly but anchors the metric table like its per-trial weak learners.
+    """
+
+    cards: Sequence[int]
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        cat = CategoricalEmbed(self.cards, embed_dim=1, dtype=self.dtype)(cat_ids)
+        features = jnp.concatenate([cat, numeric.astype(self.dtype)], axis=-1)
+        logit = nn.Dense(1, dtype=self.dtype, name="head")(features)
+        return logit[:, 0].astype(jnp.float32)
+
+
+class MLP(nn.Module):
+    """Residual MLP over embedded categoricals + standardized numerics.
+
+    Flagship serving model (BASELINE.json config 2). Width/depth from config;
+    pre-LN residual blocks keep optimization stable at the depths HPO
+    explores.
+    """
+
+    cards: Sequence[int]
+    embed_dim: int = 16
+    hidden_dims: tuple[int, ...] = (256, 256, 128)
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        cat = CategoricalEmbed(self.cards, self.embed_dim, dtype=self.dtype)(cat_ids)
+        x = jnp.concatenate([cat, numeric.astype(self.dtype)], axis=-1)
+        x = nn.Dense(self.hidden_dims[0], dtype=self.dtype, name="stem")(x)
+        for i, width in enumerate(self.hidden_dims):
+            h = nn.LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+            h = nn.Dense(width, dtype=self.dtype, name=f"dense_{i}a")(h)
+            h = nn.gelu(h)
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+            h = nn.Dense(self.hidden_dims[0], dtype=self.dtype, name=f"dense_{i}b")(h)
+            x = x + h
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_out")(x)
+        logit = nn.Dense(1, dtype=self.dtype, name="head")(x)
+        return logit[:, 0].astype(jnp.float32)

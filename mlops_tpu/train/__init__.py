@@ -1,0 +1,29 @@
+"""Training: optax loop under jit, eval metrics, checkpoint/resume, HPO.
+
+Replaces the reference's Databricks job (`train_register_model.yml:11-39`)
+running hyperopt over sklearn fits (`01-train-model.ipynb:252-360`). The
+reference re-reads the dataset from Spark and re-fits the pipeline three
+times per trial (SURVEY.md SS7 bugs); here data is encoded once, lives on
+device, and the step loop is a single compiled ``lax.scan``.
+"""
+
+from mlops_tpu.train.loop import TrainResult, evaluate, fit
+from mlops_tpu.train.metrics import binary_metrics, roc_auc
+from mlops_tpu.train.checkpoint import (
+    load_checkpoint,
+    restore_tree,
+    save_checkpoint,
+    tree_bytes,
+)
+
+__all__ = [
+    "TrainResult",
+    "binary_metrics",
+    "evaluate",
+    "fit",
+    "load_checkpoint",
+    "restore_tree",
+    "roc_auc",
+    "save_checkpoint",
+    "tree_bytes",
+]

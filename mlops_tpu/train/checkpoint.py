@@ -1,0 +1,81 @@
+"""Checkpoint / resume for training state and param pytrees.
+
+The reference has no in-run checkpointing (SURVEY.md SS5.4) — runs restart
+from zero. Here: periodic serialization of ``{params, opt_state, step}`` so
+training resumes after preemption (first-class on preemptible TPU pools),
+plus the pytree (de)serialization primitive the bundle format reuses.
+
+Format: flax msgpack bytes (``flax.serialization.to_bytes``) + a tiny JSON
+sidecar with the step counter — restore requires a structurally matching
+target pytree, which the trainer reconstructs from config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+from flax import serialization
+
+
+def tree_bytes(tree: Any) -> bytes:
+    return serialization.to_bytes(tree)
+
+
+def restore_tree(target: Any, data: bytes) -> Any:
+    """Restore msgpack bytes into the structure of ``target``."""
+    return serialization.from_bytes(target, data)
+
+
+def _atomic_write(path: Path, data: bytes) -> None:
+    """Write via temp file + rename so a preemption never leaves a torn file."""
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+
+
+def save_checkpoint(directory: str | Path, state: Any, step: int) -> Path:
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / f"ckpt_{step:08d}.msgpack"
+    _atomic_write(path, tree_bytes(state))
+    _atomic_write(
+        directory / "latest.json",
+        json.dumps({"step": step, "file": path.name}).encode(),
+    )
+    return path
+
+
+def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | None:
+    """Load the newest readable checkpoint into ``target``'s structure.
+
+    Prefers the ``latest.json`` pointer; falls back to the newest
+    ``ckpt_*.msgpack`` on disk if the pointer or its target is corrupt, and
+    returns None (fresh start) when nothing is recoverable.
+    """
+    directory = Path(directory)
+    candidates: list[Path] = []
+    latest = directory / "latest.json"
+    if latest.exists():
+        try:
+            meta = json.loads(latest.read_text())
+            candidates.append(directory / meta["file"])
+        except (json.JSONDecodeError, KeyError, OSError):
+            pass
+    candidates.extend(sorted(directory.glob("ckpt_*.msgpack"), reverse=True))
+    for path in candidates:
+        try:
+            restored = restore_tree(target, path.read_bytes())
+        except (OSError, ValueError, KeyError):
+            continue
+        step = int(path.stem.split("_")[1])
+        return restored, step
+    return None

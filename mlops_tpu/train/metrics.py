@@ -1,0 +1,61 @@
+"""Jittable binary-classification metrics.
+
+Parity with the reference's per-trial validation metrics
+(`01-train-model.ipynb:296-304`): ``validation_{accuracy, roc_auc, f1,
+precision, recall}_score`` — computed here as pure JAX so they run on device
+inside the compiled eval step (no sklearn, no host round-trip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def roc_auc(scores: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """ROC-AUC via the Mann-Whitney U statistic with average ranks for ties.
+
+    Equivalent to ``sklearn.metrics.roc_auc_score`` (which the reference gets
+    through ``mlflow.sklearn.autolog``) up to floating point.
+    """
+    scores = scores.astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    n = scores.shape[0]
+    order = jnp.argsort(scores)
+    sorted_scores = scores[order]
+    # Average ranks with tie handling: rank = mean of ordinal ranks within a
+    # tied group. Compute via searchsorted on the sorted array.
+    first = jnp.searchsorted(sorted_scores, scores, side="left")
+    last = jnp.searchsorted(sorted_scores, scores, side="right")
+    ranks = (first + last + 1.0) / 2.0  # 1-indexed average ranks
+    n_pos = labels.sum()
+    n_neg = n - n_pos
+    rank_sum = jnp.sum(ranks * labels)
+    u = rank_sum - n_pos * (n_pos + 1.0) / 2.0
+    denom = jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos == 0) | (n_neg == 0), 0.5, u / denom)
+
+
+def binary_metrics(
+    logits: jnp.ndarray, labels: jnp.ndarray, threshold: float = 0.5
+) -> dict[str, jnp.ndarray]:
+    """accuracy / roc_auc / f1 / precision / recall at a probability threshold.
+
+    ``logits`` are raw (pre-sigmoid) model outputs.
+    """
+    labels = labels.astype(jnp.float32)
+    probs = jax.nn.sigmoid(jnp.asarray(logits, jnp.float32))
+    preds = (probs >= threshold).astype(jnp.float32)
+    tp = jnp.sum(preds * labels)
+    fp = jnp.sum(preds * (1.0 - labels))
+    fn = jnp.sum((1.0 - preds) * labels)
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2.0 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return {
+        "accuracy": jnp.mean(preds == labels),
+        "roc_auc": roc_auc(probs, labels),
+        "f1": f1,
+        "precision": precision,
+        "recall": recall,
+    }

@@ -19,6 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+# The environment's TPU bootstrap (sitecustomize) force-sets
+# jax_platforms="axon,cpu" at interpreter start, overriding the env var and
+# making any backend init dial the TPU tunnel. Override back at the config
+# level BEFORE any backend is initialized so tests stay on the fake 8-device
+# CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,114 @@
+"""Training loop tests: learning happens, metrics parity, checkpoint resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlops_tpu.config import ModelConfig, TrainConfig
+from mlops_tpu.data import Preprocessor, generate_synthetic
+from mlops_tpu.models import build_model
+from mlops_tpu.train import evaluate, fit
+from mlops_tpu.train.metrics import binary_metrics, roc_auc
+
+
+def test_roc_auc_matches_sklearn():
+    pytest.importorskip("sklearn")
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.default_rng(0)
+    scores = rng.normal(size=500)
+    labels = (rng.random(500) < 1 / (1 + np.exp(-scores + rng.normal(size=500)))).astype(
+        int
+    )
+    ours = float(roc_auc(jnp.asarray(scores), jnp.asarray(labels)))
+    ref = roc_auc_score(labels, scores)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_roc_auc_with_ties():
+    scores = jnp.asarray([0.1, 0.1, 0.1, 0.9, 0.9])
+    labels = jnp.asarray([0, 0, 1, 1, 1])
+    try:
+        from sklearn.metrics import roc_auc_score
+
+        ref = roc_auc_score(np.asarray(labels), np.asarray(scores))
+    except ImportError:
+        ref = 11 / 12  # hand-computed
+    assert abs(float(roc_auc(scores, labels)) - ref) < 1e-6
+
+
+def test_binary_metrics_names_and_ranges():
+    logits = jnp.asarray([-2.0, -1.0, 1.0, 2.0])
+    labels = jnp.asarray([0, 0, 1, 1])
+    m = binary_metrics(logits, labels)
+    assert set(m) == {"accuracy", "roc_auc", "f1", "precision", "recall"}
+    assert float(m["accuracy"]) == 1.0
+    assert float(m["roc_auc"]) == 1.0
+
+
+def _train_tiny(steps=300, checkpoint_dir=None, seed=0):
+    columns, labels = generate_synthetic(4000, seed=5)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns, labels)
+    split = int(0.8 * ds.n)
+    train_ds, valid_ds = ds.slice(np.arange(split)), ds.slice(np.arange(split, ds.n))
+    model = build_model(ModelConfig(family="mlp", hidden_dims=(64, 64), embed_dim=8))
+    config = TrainConfig(
+        batch_size=256,
+        steps=steps,
+        eval_every=100,
+        checkpoint_every=100,
+        learning_rate=3e-3,
+        warmup_steps=20,
+        seed=seed,
+    )
+    result = fit(
+        model, train_ds, valid_ds, config, checkpoint_dir=checkpoint_dir
+    )
+    return model, result, valid_ds
+
+
+def test_fit_learns_signal(tmp_path):
+    model, result, valid_ds = _train_tiny(
+        steps=300, checkpoint_dir=tmp_path / "ckpt"
+    )
+    # The synthetic process has strong signal; anything above 0.75 AUC means
+    # the loop is actually learning (linear floor is ~0.80).
+    assert result.metrics["validation_roc_auc_score"] > 0.75
+    assert result.steps == 300
+    # History carries the reference's five validation metric names.
+    assert {
+        "validation_accuracy_score",
+        "validation_roc_auc_score",
+        "validation_f1_score",
+        "validation_precision_score",
+        "validation_recall_score",
+    } <= set(result.history[-1])
+    # Checkpoints were written.
+    assert (tmp_path / "ckpt" / "latest.json").exists()
+
+
+def test_checkpoint_resume(tmp_path):
+    # Train 200 steps with checkpointing, then "resume" a fresh fit with the
+    # same config pointed at the same dir and 300 total steps: it should do
+    # only the remaining 100.
+    _train_tiny(steps=200, checkpoint_dir=tmp_path / "c")
+    model, result, _ = _train_tiny(steps=300, checkpoint_dir=tmp_path / "c")
+    assert result.steps == 300
+    assert result.history[0]["step"] > 200  # resumed, not restarted
+
+
+def test_step_budget_exact_when_not_window_aligned(tmp_path):
+    # steps=250 with eval_every=100 must stop at exactly 250, not 300.
+    model, result, _ = _train_tiny(steps=250)
+    assert result.steps == 250
+
+
+def test_checkpoint_survives_corrupt_pointer(tmp_path):
+    _train_tiny(steps=200, checkpoint_dir=tmp_path / "c")
+    (tmp_path / "c" / "latest.json").write_text("{torn")
+    # Resume falls back to the newest readable ckpt file instead of crashing.
+    model, result, _ = _train_tiny(steps=300, checkpoint_dir=tmp_path / "c")
+    assert result.steps == 300
+    assert result.history[0]["step"] > 200
