@@ -1,0 +1,29 @@
+"""Model bundle + registry — the packaging layer.
+
+Replaces the reference's MLflow pyfunc ``CustomModel`` artifact (one artifact
+= classifier + drift detector + outlier detector + pinned env,
+`02-register-model.ipynb:305-353,431-440`) and the MLflow model registry
+(`:461-470`, addressed as ``models:/<name>/<version>``, `:503-504`).
+
+A bundle is a directory:
+
+    manifest.json     version, schema fingerprint, model config, metrics,
+                      framework versions, tags
+    params.msgpack    flax param pytree
+    preprocess.npz    fitted Preprocessor state
+    monitor.npz       fitted MonitorState (drift refs + outlier detector)
+
+The deploy invariant preserved from the reference: the serving image bakes
+the bundle in; rollback = previous image tag (SURVEY.md SS3.4).
+"""
+
+from mlops_tpu.bundle.bundle import Bundle, load_bundle, save_bundle
+from mlops_tpu.bundle.registry import ModelRegistry, parse_model_uri
+
+__all__ = [
+    "Bundle",
+    "ModelRegistry",
+    "load_bundle",
+    "parse_model_uri",
+    "save_bundle",
+]

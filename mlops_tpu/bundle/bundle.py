@@ -1,0 +1,107 @@
+"""Bundle save/load: one directory carrying everything serving needs."""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from mlops_tpu.config import ModelConfig
+from mlops_tpu.data.encode import Preprocessor
+from mlops_tpu.monitor.state import MonitorState
+from mlops_tpu.schema.features import SCHEMA
+from mlops_tpu.train.checkpoint import restore_tree, tree_bytes
+from mlops_tpu.version import __version__
+
+MANIFEST_NAME = "manifest.json"
+PARAMS_NAME = "params.msgpack"
+PREPROCESS_NAME = "preprocess.npz"
+MONITOR_NAME = "monitor.npz"
+
+
+@dataclasses.dataclass
+class Bundle:
+    """A loaded bundle: rebuilt model + fitted state, ready to serve."""
+
+    manifest: dict[str, Any]
+    model: Any  # nn.Module
+    variables: dict[str, Any]
+    preprocessor: Preprocessor
+    monitor: MonitorState
+
+    @property
+    def model_config(self) -> ModelConfig:
+        return ModelConfig(**self.manifest["model_config"])
+
+
+def save_bundle(
+    directory: str | Path,
+    model_config: ModelConfig,
+    params: Any,
+    preprocessor: Preprocessor,
+    monitor: MonitorState,
+    metrics: dict[str, float] | None = None,
+    tags: dict[str, str] | None = None,
+) -> Path:
+    """Write a self-contained bundle directory.
+
+    The manifest is the typed replacement for the reference's implicit
+    notebook->notebook ``taskValues`` handoff + conda-env synthesis
+    (`02-register-model.ipynb` cells 7, 11; SURVEY.md SS3.2).
+    """
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    manifest = {
+        "format_version": 1,
+        "framework": {"mlops_tpu": __version__, "jax": jax.__version__},
+        "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "schema_fingerprint": SCHEMA.fingerprint(),
+        "model_config": dataclasses.asdict(model_config),
+        "metrics": metrics or {},
+        "tags": tags or {},
+    }
+    (directory / PARAMS_NAME).write_bytes(tree_bytes(params))
+    preprocessor.save(directory / PREPROCESS_NAME)
+    monitor.save(directory / MONITOR_NAME)
+    (directory / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
+    return directory
+
+
+def load_bundle(directory: str | Path) -> Bundle:
+    """Load + validate a bundle; rebuilds the model from its manifest.
+
+    Schema-fingerprint mismatch is a hard error: serving a bundle trained
+    against a different feature contract is the train/serve skew the
+    reference is exposed to via its triple-duplicated feature lists
+    (SURVEY.md SS2.2 "Feature schema constants").
+    """
+    from mlops_tpu.models import build_model, init_params
+
+    directory = Path(directory)
+    manifest = json.loads((directory / MANIFEST_NAME).read_text())
+    if manifest["schema_fingerprint"] != SCHEMA.fingerprint():
+        raise ValueError(
+            f"bundle {directory} was built for schema "
+            f"{manifest['schema_fingerprint']}, runtime schema is "
+            f"{SCHEMA.fingerprint()}"
+        )
+    model_config = ModelConfig(**{
+        k: tuple(v) if isinstance(v, list) else v
+        for k, v in manifest["model_config"].items()
+    })
+    model = build_model(model_config)
+    template = init_params(model, jax.random.PRNGKey(0))
+    params = restore_tree(
+        template["params"], (directory / PARAMS_NAME).read_bytes()
+    )
+    return Bundle(
+        manifest=manifest,
+        model=model,
+        variables={"params": params},
+        preprocessor=Preprocessor.load(directory / PREPROCESS_NAME),
+        monitor=MonitorState.load(directory / MONITOR_NAME),
+    )

@@ -1,0 +1,147 @@
+"""Filesystem model registry with staged promotion.
+
+Replaces the MLflow model registry (`02-register-model.ipynb:461-470`
+``mlflow.register_model`` with tags; addressed as
+``models:/<name>/<version>``, `:503-504`) and the reference's
+dev -> staging -> production environment model
+(`.github/docs/getting-started.md:57-69`). Works on a local directory (or a
+mounted GCS bucket) — no tracking server.
+
+Layout:
+
+    <root>/<name>/versions/<v>/   the bundle directory
+    <root>/<name>/index.json      versions, stages, tags (atomic rewrite)
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import shutil
+import uuid
+from pathlib import Path
+from typing import Any
+
+from mlops_tpu.utils.io import atomic_write
+
+STAGES = ("none", "staging", "production")
+
+
+def parse_model_uri(uri: str) -> tuple[str, str]:
+    """Parse ``models:/<name>/<version-or-stage>`` (reference URI contract)."""
+    if not uri.startswith("models:/"):
+        raise ValueError(f"not a model uri: {uri!r}")
+    name, _, version = uri[len("models:/") :].partition("/")
+    if not name or not version:
+        raise ValueError(f"malformed model uri: {uri!r}")
+    return name, version
+
+
+class ModelRegistry:
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+
+    # ---------------------------------------------------------------- index
+    def _index_path(self, name: str) -> Path:
+        return self.root / name / "index.json"
+
+    def _read_index(self, name: str) -> dict[str, Any]:
+        path = self._index_path(name)
+        if not path.exists():
+            return {"name": name, "versions": []}
+        return json.loads(path.read_text())
+
+    def _write_index(self, name: str, index: dict[str, Any]) -> None:
+        path = self._index_path(name)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        atomic_write(path, json.dumps(index, indent=2).encode())
+
+    # ------------------------------------------------------------------ api
+    def register(
+        self,
+        name: str,
+        bundle_dir: str | Path,
+        tags: dict[str, str] | None = None,
+    ) -> str:
+        """Copy a bundle into the registry as the next version.
+
+        Returns a ``models:/<name>/<version>`` URI — the same contract the
+        reference's registration notebook exits with
+        (`02-register-model.ipynb:504`).
+        """
+        index = self._read_index(name)
+        versions_dir = self.root / name / "versions"
+        # Next version = 1 + max over index AND on-disk dirs, so an orphan
+        # directory from a crash between copy and index write can never
+        # collide with a later registration.
+        on_disk = (
+            int(p.name)
+            for p in versions_dir.glob("[0-9]*")
+            if p.is_dir() and p.name.isdigit()
+        )
+        version = 1 + max(
+            [0, *(v["version"] for v in index["versions"]), *on_disk]
+        )
+        dest = versions_dir / str(version)
+        # Copy to a temp sibling then rename: a partial copy is never visible
+        # under a version number.
+        staging = versions_dir / f".incoming-{uuid.uuid4().hex}"
+        shutil.copytree(bundle_dir, staging)
+        staging.replace(dest)
+        index["versions"].append(
+            {
+                "version": version,
+                "created_at": datetime.datetime.now(
+                    datetime.timezone.utc
+                ).isoformat(),
+                "stage": "none",
+                "tags": tags or {},
+            }
+        )
+        self._write_index(name, index)
+        return f"models:/{name}/{version}"
+
+    def resolve(self, name: str, version_or_stage: str) -> Path:
+        """Resolve a version number, stage name, or 'latest' to a bundle dir."""
+        index = self._read_index(name)
+        if not index["versions"]:
+            raise KeyError(f"no versions registered for model {name!r}")
+        if version_or_stage == "latest":
+            version = max(v["version"] for v in index["versions"])
+        elif version_or_stage.isdigit():
+            version = int(version_or_stage)
+            if not any(v["version"] == version for v in index["versions"]):
+                raise KeyError(f"model {name!r} has no version {version}")
+        elif version_or_stage in STAGES:
+            staged = [
+                v for v in index["versions"] if v["stage"] == version_or_stage
+            ]
+            if not staged:
+                raise KeyError(
+                    f"model {name!r} has no version in stage {version_or_stage!r}"
+                )
+            version = max(v["version"] for v in staged)
+        else:
+            raise KeyError(f"unknown version or stage {version_or_stage!r}")
+        return self.root / name / "versions" / str(version)
+
+    def resolve_uri(self, uri: str) -> Path:
+        return self.resolve(*parse_model_uri(uri))
+
+    def set_stage(self, name: str, version: int, stage: str) -> None:
+        """Promote/demote a version (staging -> production gate, SURVEY.md SS3.4)."""
+        if stage not in STAGES:
+            raise ValueError(f"stage must be one of {STAGES}")
+        index = self._read_index(name)
+        for entry in index["versions"]:
+            if entry["version"] == version:
+                entry["stage"] = stage
+                entry[f"{stage}_since"] = datetime.datetime.now(
+                    datetime.timezone.utc
+                ).isoformat()
+                self._write_index(name, index)
+                return
+        raise KeyError(f"model {name!r} has no version {version}")
+
+    def list_versions(self, name: str) -> list[dict[str, Any]]:
+        return self._read_index(name)["versions"]

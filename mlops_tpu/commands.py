@@ -1,8 +1,9 @@
-"""CLI subcommand implementations. Grows with the framework."""
+"""CLI subcommand implementations."""
 
 from __future__ import annotations
 
 import argparse
+import json
 
 from mlops_tpu.config import load_config
 
@@ -25,6 +26,117 @@ def _synth(config) -> int:
     return 0
 
 
+def _train(config) -> int:
+    from mlops_tpu.train.pipeline import run_training
+
+    result = run_training(config)
+    print(
+        json.dumps(
+            {
+                "bundle": str(result.bundle_dir),
+                "model_uri": result.model_uri,
+                "steps": result.train_result.steps,
+                "metrics": result.train_result.metrics,
+            }
+        )
+    )
+    return 0
+
+
+def _register(config) -> int:
+    """Register an existing bundle directory (data.train_path doubles as the
+    bundle path argument: ``mlops-tpu register data.train_path=<dir>``)."""
+    from mlops_tpu.bundle import ModelRegistry
+
+    bundle_dir = config.data.train_path
+    if not bundle_dir:
+        raise SystemExit("pass the bundle dir via data.train_path=<dir>")
+    registry = ModelRegistry(config.registry.root)
+    uri = registry.register(config.registry.model_name, bundle_dir)
+    print(uri)
+    return 0
+
+
+def _predict_file(config) -> int:
+    """Batch-score a schema CSV offline with the full fused predict."""
+    import numpy as np
+
+    from mlops_tpu.bundle import ModelRegistry, load_bundle
+    from mlops_tpu.data import load_csv_columns
+    from mlops_tpu.ops.predict import make_predict_fn
+    from mlops_tpu.schema import SCHEMA
+
+    source = config.data.train_path
+    if not source:
+        raise SystemExit("pass the input csv via data.train_path=<csv>")
+    registry = ModelRegistry(config.registry.root)
+    bundle = load_bundle(
+        registry.resolve(config.registry.model_name, config.serve.model_directory)
+        if not _looks_like_dir(config.serve.model_directory)
+        else config.serve.model_directory
+    )
+    predict = make_predict_fn(bundle.model, bundle.variables, bundle.monitor)
+    columns, _ = load_csv_columns(source)
+    ds = bundle.preprocessor.encode(columns)
+    out = predict(ds.cat_ids, ds.numeric)
+    record = {
+        "predictions": np.asarray(out["predictions"]).tolist(),
+        "outliers": np.asarray(out["outliers"]).tolist(),
+        "feature_drift_batch": dict(
+            zip(
+                SCHEMA.feature_names,
+                np.asarray(out["feature_drift_batch"]).round(6).tolist(),
+            )
+        ),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+def _looks_like_dir(value: str) -> bool:
+    from pathlib import Path
+
+    return Path(value).is_dir()
+
+
+def _serve(config) -> int:
+    """Serve a bundle over HTTP.
+
+    Env contract parity with the reference (`app/main.py:27,36`):
+    ``MODEL_DIRECTORY`` points at a bundle dir (or a registry
+    version/stage/"latest"), ``SERVICE_NAME`` names the service in logs.
+    """
+    import logging
+    import os
+
+    from mlops_tpu.bundle import ModelRegistry, load_bundle
+    from mlops_tpu.serve import InferenceEngine, serve_forever
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    model_dir = os.environ.get("MODEL_DIRECTORY", config.serve.model_directory)
+    config.serve.service_name = os.environ.get(
+        "SERVICE_NAME", config.serve.service_name
+    )
+    if _looks_like_dir(model_dir):
+        bundle_path = model_dir
+    else:
+        bundle_path = ModelRegistry(config.registry.root).resolve(
+            config.registry.model_name, model_dir
+        )
+    bundle = load_bundle(bundle_path)
+    engine = InferenceEngine(
+        bundle,
+        buckets=tuple(config.serve.warmup_batch_sizes),
+        service_name=config.serve.service_name,
+    )
+    serve_forever(engine, config.serve)
+    return 0
+
+
 _HANDLERS = {
     "synth": _synth,
+    "train": _train,
+    "register": _register,
+    "predict-file": _predict_file,
+    "serve": _serve,
 }

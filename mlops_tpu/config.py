@@ -78,9 +78,19 @@ class ServeConfig:
     port: int = 5000  # parity: `app/Dockerfile:22-24`
     service_name: str = "credit-default-api"
     model_directory: str = "model"  # parity: MODEL_DIRECTORY (`app/main.py:27`)
-    max_batch: int = 256
-    batch_window_ms: float = 1.0  # micro-batching window
+    max_batch: int = 256  # request-size cap; must equal the largest warmed
+    # bucket so steady-state serving never compiles a novel shape
     warmup_batch_sizes: tuple[int, ...] = (1, 8, 64, 256)
+
+
+@dataclasses.dataclass
+class RegistryConfig:
+    root: str = "registry"
+    model_name: str = "credit-default-uci-custom"  # parity:
+    # `databricks/resources/train_register_model.yml` var model_name
+    experiment_name: str = "credit-default-uci-train"  # parity: parent
+    # MLflow run name (`01-train-model.ipynb` cell 8)
+    run_root: str = "runs"  # per-run artifacts: metrics.jsonl, checkpoints
 
 
 @dataclasses.dataclass
@@ -97,6 +107,7 @@ class Config:
     hpo: HPOConfig = dataclasses.field(default_factory=HPOConfig)
     monitor: MonitorConfig = dataclasses.field(default_factory=MonitorConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    registry: RegistryConfig = dataclasses.field(default_factory=RegistryConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
 

@@ -1,0 +1,19 @@
+"""Monitoring: drift + outlier detector state, fit, and jit scoring.
+
+Replaces the reference's alibi-detect pair bundled into its pyfunc artifact
+(`02-register-model.ipynb:225-233`: ``TabularDrift(p_val=.05)`` on all
+features + ``IForest(threshold=0.95)`` on numeric features; scored serially
+on CPU inside ``CustomModel.predict``, `:330-353`). Here the fitted state is
+a pytree of arrays that rides into the SAME compiled predict function as the
+classifier, and the response contract is identical: per-feature drift scores
+``1 - p_val`` and per-row 0/1 outlier flags.
+"""
+
+from mlops_tpu.monitor.state import (
+    MonitorState,
+    drift_scores,
+    fit_monitor,
+    outlier_flags,
+)
+
+__all__ = ["MonitorState", "drift_scores", "fit_monitor", "outlier_flags"]

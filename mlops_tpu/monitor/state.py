@@ -1,0 +1,137 @@
+"""Fitted monitor state (a pytree) + the jittable scoring functions."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from mlops_tpu.config import MonitorConfig
+from mlops_tpu.data.encode import EncodedDataset
+from mlops_tpu.ops.drift import chi2_two_sample, ks_two_sample, ks_two_sample_masked
+from mlops_tpu.ops.outlier import fit_mahalanobis, mahalanobis_sq
+from mlops_tpu.schema.features import SCHEMA
+
+
+class MonitorState(struct.PyTreeNode):
+    """Everything the fused predict needs, as fixed-shape device arrays.
+
+    - ``cat_ref_counts``  f32 [C, max_card]: training category counts per
+      categorical feature, zero-padded to the max cardinality.
+    - ``num_ref_sorted``  f32 [M, R]: sorted training reference sample per
+      numeric feature (subsampled to ``drift_ref_size``).
+    - ``out_mean/out_precision/out_threshold``: Mahalanobis detector.
+    """
+
+    cat_ref_counts: jnp.ndarray
+    num_ref_sorted: jnp.ndarray
+    out_mean: jnp.ndarray
+    out_precision: jnp.ndarray
+    out_threshold: jnp.ndarray
+
+    # ------------------------------------------------------------ serialize
+    def to_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "cat_ref_counts": np.asarray(self.cat_ref_counts),
+            "num_ref_sorted": np.asarray(self.num_ref_sorted),
+            "out_mean": np.asarray(self.out_mean),
+            "out_precision": np.asarray(self.out_precision),
+            "out_threshold": np.asarray(self.out_threshold),
+        }
+
+    @classmethod
+    def from_arrays(cls, arrays: dict[str, np.ndarray]) -> "MonitorState":
+        return cls(
+            **{k: jnp.asarray(arrays[k]) for k in (
+                "cat_ref_counts",
+                "num_ref_sorted",
+                "out_mean",
+                "out_precision",
+                "out_threshold",
+            )}
+        )
+
+    def save(self, path: str | Path) -> None:
+        np.savez(Path(path).with_suffix(".npz"), **self.to_arrays())
+
+    @classmethod
+    def load(cls, path: str | Path) -> "MonitorState":
+        with np.load(Path(path).with_suffix(".npz")) as data:
+            return cls.from_arrays({k: data[k] for k in data.files})
+
+
+def fit_monitor(
+    ds: EncodedDataset, config: MonitorConfig | None = None, seed: int = 0
+) -> MonitorState:
+    """Host-side fit on the encoded TRAINING split.
+
+    Mirrors the reference's fit inputs: drift reference = full feature
+    matrix, outlier detector = numeric features only
+    (`02-register-model.ipynb:225-233`).
+    """
+    config = config or MonitorConfig()
+    max_card = max(SCHEMA.cards)
+    counts = np.zeros((SCHEMA.num_categorical, max_card), dtype=np.float32)
+    for j, feat in enumerate(SCHEMA.categorical):
+        binc = np.bincount(ds.cat_ids[:, j], minlength=feat.card)
+        counts[j, : feat.card] = binc
+
+    rng = np.random.default_rng(seed)
+    n = ds.numeric.shape[0]
+    size = min(config.drift_ref_size, n)
+    idx = rng.choice(n, size=size, replace=False)
+    ref = np.sort(ds.numeric[idx].astype(np.float32), axis=0).T  # [M, R]
+
+    mean, precision, threshold = fit_mahalanobis(
+        ds.numeric, quantile=config.outlier_quantile
+    )
+    return MonitorState(
+        cat_ref_counts=jnp.asarray(counts),
+        num_ref_sorted=jnp.asarray(ref),
+        out_mean=jnp.asarray(mean),
+        out_precision=jnp.asarray(precision),
+        out_threshold=jnp.asarray(threshold, dtype=jnp.float32),
+    )
+
+
+def drift_scores(
+    state: MonitorState,
+    cat_ids: jnp.ndarray,
+    numeric: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-feature drift scores ``1 - p_val`` in schema order ([C+M]).
+
+    Categorical: chi-squared contingency vs training counts. Numeric:
+    two-sample K-S vs the stored reference sample. Both vmapped across
+    features — the entire drift pass is one fused computation. ``mask``
+    (bool [N]) excludes padded rows when serving pads to bucket sizes.
+    """
+    max_card = state.cat_ref_counts.shape[1]
+    one_hot = jax.nn.one_hot(cat_ids, max_card, dtype=jnp.float32)  # [N, C, K]
+    if mask is not None:
+        one_hot = one_hot * mask.astype(jnp.float32)[:, None, None]
+    batch_counts = one_hot.sum(axis=0)  # [C, K]
+    _, cat_p = jax.vmap(chi2_two_sample)(state.cat_ref_counts, batch_counts)
+
+    if mask is None:
+        _, num_p = jax.vmap(ks_two_sample)(state.num_ref_sorted, numeric.T)
+    else:
+        _, num_p = jax.vmap(ks_two_sample_masked, in_axes=(0, 0, None))(
+            state.num_ref_sorted, numeric.T, mask
+        )
+    return 1.0 - jnp.concatenate([cat_p, num_p])
+
+
+def outlier_flags(
+    state: MonitorState, numeric: jnp.ndarray, mask: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Per-row 0/1 outlier flags (reference contract: `app/model.py:69`)."""
+    distances = mahalanobis_sq(numeric, state.out_mean, state.out_precision)
+    flags = (distances > state.out_threshold).astype(jnp.float32)
+    if mask is not None:
+        flags = flags * mask.astype(jnp.float32)
+    return flags

@@ -1,0 +1,23 @@
+"""Pure-JAX numerics: drift tests, outlier scores, fused predict.
+
+These are the jit-able building blocks the monitor and serving layers use.
+The reference delegates this math to CPU libraries (alibi-detect's
+``TabularDrift`` chi2/K-S tests and ``IForest``,
+`02-register-model.ipynb:225-233`) executed serially after the classifier
+(`02-register-model.ipynb:330-353`); here every statistic is expressed in
+XLA-friendly form so classifier + drift + outlier run as ONE fused device
+computation per request.
+"""
+
+from mlops_tpu.ops.drift import chi2_two_sample, ks_two_sample
+from mlops_tpu.ops.outlier import mahalanobis_sq
+
+# NOTE: the fused predict builder lives in ``mlops_tpu.ops.predict`` and is
+# imported from there directly (not re-exported here) because it composes the
+# monitor layer on top of these primitives.
+
+__all__ = [
+    "chi2_two_sample",
+    "ks_two_sample",
+    "mahalanobis_sq",
+]

@@ -1,0 +1,50 @@
+"""Outlier scoring as a jittable JAX function.
+
+The reference uses ``alibi_detect.od.IForest(threshold=0.95)`` fit on the
+numeric features only (`02-register-model.ipynb:232-233`), whose ``predict``
+yields per-row 0/1 flags consumed at `02-register-model.ipynb:330-353`.
+Isolation forests are a poor fit for XLA (data-dependent tree walks), so the
+TPU-native detector is **Mahalanobis distance** on the same numeric features
+with the decision threshold calibrated to the same quantile contract: flag a
+row when its squared distance exceeds the train-split quantile (0.95 by
+default). Same response semantics (``outliers: list[float]`` of 0/1 —
+`app/model.py:69`), hardware-friendly math: one (x-mu) @ P matmul.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def mahalanobis_sq(
+    x: jnp.ndarray,  # f32 [N, M]
+    mean: jnp.ndarray,  # f32 [M]
+    precision: jnp.ndarray,  # f32 [M, M] inverse covariance
+) -> jnp.ndarray:
+    """Squared Mahalanobis distance per row — one matmul + reduction."""
+    centered = x - mean
+    return jnp.einsum("ni,ij,nj->n", centered, precision, centered)
+
+
+def fit_mahalanobis(
+    x: np.ndarray, quantile: float = 0.95, ridge: float = 1e-6
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """Host-side fit: mean, precision (ridge-regularized), threshold.
+
+    ``quantile`` mirrors the reference's ``IForest(threshold=0.95)``: the
+    flag threshold is the empirical quantile of training distances.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    mean = x.mean(axis=0)
+    cov = np.cov(x, rowvar=False)
+    cov += ridge * np.eye(cov.shape[0])
+    precision = np.linalg.inv(cov)
+    centered = x - mean
+    distances = np.einsum("ni,ij,nj->n", centered, precision, centered)
+    threshold = float(np.quantile(distances, quantile))
+    return (
+        mean.astype(np.float32),
+        precision.astype(np.float32),
+        threshold,
+    )

@@ -1,0 +1,22 @@
+"""Serving layer: inference engine + dependency-free asyncio HTTP server.
+
+Replaces the reference's FastAPI + uvicorn + mlflow-pyfunc serving stack
+(`app/main.py`). Same HTTP contract:
+
+- ``POST /predict``  body ``list[LoanApplicant]`` -> ``ModelOutput``
+  (`app/main.py:42-86`)
+- port 5000, env ``MODEL_DIRECTORY`` / ``SERVICE_NAME``
+  (`app/Dockerfile:22-24`, `app/main.py:27,36`)
+- two structured JSON log events per request (``InferenceData`` /
+  ``ModelOutput``) sharing a ``request_id`` (`app/main.py:57-84`)
+
+plus what the reference lacks (SURVEY.md SS5.1/5.3): ``/healthz/live`` and
+``/healthz/ready`` probes, a Prometheus ``/metrics`` endpoint with latency
+percentiles, jit warmup over fixed batch buckets, and micro-batch padding so
+steady-state serving never recompiles.
+"""
+
+from mlops_tpu.serve.engine import InferenceEngine
+from mlops_tpu.serve.server import HttpServer, serve_forever
+
+__all__ = ["HttpServer", "InferenceEngine", "serve_forever"]

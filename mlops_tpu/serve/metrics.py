@@ -1,0 +1,74 @@
+"""In-process serving metrics with a Prometheus text exposition endpoint.
+
+The reference ships logs only — drift monitoring is "grep the Log Analytics
+table" (SURVEY.md SS5.5). Here the service additionally exposes ``/metrics``:
+request counts by route/status, latency percentiles, rows scored, outlier
+counts, and the last per-feature drift scores.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class ServingMetrics:
+    # Fixed latency histogram buckets (ms).
+    LATENCY_BUCKETS = (0.5, 1, 2, 5, 10, 25, 50, 100, 250, 1000, float("inf"))
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests: dict[tuple[str, int], int] = defaultdict(int)
+        self.latency_counts = [0] * len(self.LATENCY_BUCKETS)
+        self.latency_sum_ms = 0.0
+        self.latency_n = 0
+        self.rows_total = 0
+        self.outliers_total = 0
+        self.last_drift: dict[str, float] = {}
+
+    def observe_request(self, route: str, status: int, latency_ms: float) -> None:
+        with self._lock:
+            self.requests[(route, status)] += 1
+            self.latency_sum_ms += latency_ms
+            self.latency_n += 1
+            for i, edge in enumerate(self.LATENCY_BUCKETS):
+                if latency_ms <= edge:
+                    self.latency_counts[i] += 1
+                    break
+
+    def observe_prediction(self, response: dict) -> None:
+        with self._lock:
+            self.rows_total += len(response["predictions"])
+            self.outliers_total += int(sum(response["outliers"]))
+            self.last_drift = dict(response["feature_drift_batch"])
+
+    def render(self) -> str:
+        """Prometheus text format."""
+        with self._lock:
+            lines = [
+                "# TYPE mlops_tpu_requests_total counter",
+            ]
+            for (route, status), count in sorted(self.requests.items()):
+                lines.append(
+                    f'mlops_tpu_requests_total{{route="{route}",status="{status}"}} {count}'
+                )
+            lines.append("# TYPE mlops_tpu_request_latency_ms histogram")
+            cumulative = 0
+            for edge, count in zip(self.LATENCY_BUCKETS, self.latency_counts):
+                cumulative += count
+                label = "+Inf" if edge == float("inf") else str(edge)
+                lines.append(
+                    f'mlops_tpu_request_latency_ms_bucket{{le="{label}"}} {cumulative}'
+                )
+            lines.append(f"mlops_tpu_request_latency_ms_sum {self.latency_sum_ms}")
+            lines.append(f"mlops_tpu_request_latency_ms_count {self.latency_n}")
+            lines.append("# TYPE mlops_tpu_rows_scored_total counter")
+            lines.append(f"mlops_tpu_rows_scored_total {self.rows_total}")
+            lines.append("# TYPE mlops_tpu_outliers_total counter")
+            lines.append(f"mlops_tpu_outliers_total {self.outliers_total}")
+            lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
+            for feature, score in self.last_drift.items():
+                lines.append(
+                    f'mlops_tpu_feature_drift_score{{feature="{feature}"}} {score}'
+                )
+            return "\n".join(lines) + "\n"

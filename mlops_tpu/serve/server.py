@@ -1,0 +1,242 @@
+"""Dependency-free asyncio HTTP/1.1 server for the predict service.
+
+The reference serves via FastAPI + uvicorn (`app/main.py:35-39,92-93`);
+neither is a baked-in dependency here, so the framework carries its own thin
+HTTP layer: an asyncio protocol server with keep-alive, routing, pydantic
+validation (422 on bad bodies, matching FastAPI's contract), and the
+reference's structured two-event JSON logging per request
+(`app/main.py:57-84`). Model compute runs in a small thread pool so the
+event loop keeps accepting connections while the device works.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import logging
+import time
+import uuid
+from typing import Awaitable, Callable
+
+import pydantic
+
+from mlops_tpu.config import ServeConfig
+from mlops_tpu.schema import LoanApplicant
+from mlops_tpu.serve.engine import InferenceEngine
+from mlops_tpu.serve.metrics import ServingMetrics
+
+logger = logging.getLogger("mlops_tpu.serve")
+
+_DOCS_HTML = """<!doctype html>
+<html><head><title>{title}</title></head>
+<body style="font-family: sans-serif; max-width: 42rem; margin: 2rem auto">
+<h1>{title}</h1>
+<p>TPU-native credit-default inference service.</p>
+<ul>
+<li><code>POST /predict</code> — body: JSON list of loan-applicant records;
+returns <code>{{"predictions": [...], "outliers": [...],
+"feature_drift_batch": {{...}}}}</code></li>
+<li><code>GET /healthz/live</code> — liveness probe</li>
+<li><code>GET /healthz/ready</code> — readiness probe (model loaded + jit warm)</li>
+<li><code>GET /metrics</code> — Prometheus metrics</li>
+</ul>
+</body></html>"""
+
+
+class HttpServer:
+    def __init__(self, engine: InferenceEngine, config: ServeConfig):
+        self.engine = engine
+        self.config = config
+        self.metrics = ServingMetrics()
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="predict"
+        )
+        self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
+
+    # ----------------------------------------------------------- HTTP layer
+    async def handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    break
+                try:
+                    method, path, _ = request_line.decode("latin1").split(" ", 2)
+                except ValueError:
+                    await self._write_response(writer, 400, {"detail": "bad request"})
+                    break
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode("latin1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                body = b""
+                try:
+                    length = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    await self._write_response(
+                        writer, 400, {"detail": "bad content-length"}
+                    )
+                    break
+                if length:
+                    body = await reader.readexactly(length)
+
+                keep_alive = headers.get("connection", "keep-alive") != "close"
+                start = time.perf_counter()
+                status, payload, content_type = await self._route(
+                    method, path.split("?")[0], body
+                )
+                latency_ms = (time.perf_counter() - start) * 1e3
+                self.metrics.observe_request(path.split("?")[0], status, latency_ms)
+                await self._write_response(
+                    writer, status, payload, content_type, keep_alive
+                )
+                if not keep_alive:
+                    break
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+        ):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload,
+        content_type: str = "application/json",
+        keep_alive: bool = True,
+    ) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large", 422: "Unprocessable Entity",
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        if isinstance(payload, (dict, list)):
+            body = json.dumps(payload).encode()
+        elif isinstance(payload, str):
+            body = payload.encode()
+        else:
+            body = payload
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: {content_type}\r\n"
+            f"content-length: {len(body)}\r\n"
+            f"connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+        )
+        writer.write(head.encode() + body)
+        await writer.drain()
+
+    # -------------------------------------------------------------- routing
+    async def _route(self, method: str, path: str, body: bytes):
+        if path == "/predict" and method == "POST":
+            return await self._predict(body)
+        if method == "GET":
+            if path == "/":
+                return 200, _DOCS_HTML.format(title=self.config.service_name), "text/html"
+            if path == "/healthz/live":
+                return 200, {"status": "alive"}, "application/json"
+            if path == "/healthz/ready":
+                if self.engine.ready:
+                    return 200, {"status": "ready"}, "application/json"
+                return 503, {"status": "warming"}, "application/json"
+            if path == "/metrics":
+                return 200, self.metrics.render(), "text/plain; version=0.0.4"
+        return 404, {"detail": "not found"}, "application/json"
+
+    async def _predict(self, body: bytes):
+        """The reference's `predict()` endpoint (`app/main.py:42-86`):
+        validate -> log InferenceData -> model -> log ModelOutput -> respond.
+        """
+        try:
+            records = self._applicant_list.validate_json(body)
+        except pydantic.ValidationError as err:
+            return 422, {"detail": json.loads(err.json())}, "application/json"
+        if len(records) > self.config.max_batch:
+            # Cap guards the compile cache: anything beyond the largest
+            # warmed bucket would trigger an exact-shape compile per novel
+            # size. Offline scoring of big files goes through predict-file.
+            return (
+                413,
+                {
+                    "detail": f"batch of {len(records)} exceeds "
+                    f"max_batch={self.config.max_batch}"
+                },
+                "application/json",
+            )
+
+        request_id = uuid.uuid4().hex
+        record_dicts = [r.model_dump() for r in records]
+        logger.info(
+            json.dumps(
+                {
+                    "service_name": self.config.service_name,
+                    "type": "InferenceData",
+                    "request_id": request_id,
+                    "data": record_dicts,
+                }
+            )
+        )
+        loop = asyncio.get_running_loop()
+        try:
+            response = await loop.run_in_executor(
+                self._executor, self.engine.predict_records, record_dicts
+            )
+        except Exception:
+            logger.exception("prediction failed request_id=%s", request_id)
+            return 500, {"detail": "prediction failed"}, "application/json"
+        self.metrics.observe_prediction(response)
+        logger.info(
+            json.dumps(
+                {
+                    "service_name": self.config.service_name,
+                    "type": "ModelOutput",
+                    "request_id": request_id,
+                    "data": response,
+                }
+            )
+        )
+        return 200, response, "application/json"
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> asyncio.AbstractServer:
+        return await asyncio.start_server(
+            self.handle_connection, self.config.host, self.config.port
+        )
+
+
+async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
+    server = HttpServer(engine, config)
+    srv = await server.start()
+    logger.info(
+        "serving %s on %s:%s", config.service_name, config.host, config.port
+    )
+    # Bind FIRST, warm up concurrently: probes are reachable immediately and
+    # /healthz/ready flips to 200 when every bucket is compiled. (Warming
+    # before binding would make K8s liveness probes connection-refuse through
+    # the whole compile window and restart the pod.)
+    loop = asyncio.get_running_loop()
+    warmup = loop.run_in_executor(None, engine.warmup)
+    warmup.add_done_callback(
+        lambda f: logger.error("warmup failed: %s", f.exception())
+        if f.exception()
+        else logger.info("warmup complete; ready")
+    )
+    async with srv:
+        await srv.serve_forever()
+
+
+def serve_forever(engine: InferenceEngine, config: ServeConfig) -> None:
+    """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`)."""
+    asyncio.run(_serve(engine, config))

@@ -13,12 +13,12 @@ target pytree, which the trainer reconstructs from config.
 from __future__ import annotations
 
 import json
-import os
-import tempfile
 from pathlib import Path
 from typing import Any
 
 from flax import serialization
+
+from mlops_tpu.utils.io import atomic_write
 
 
 def tree_bytes(tree: Any) -> bytes:
@@ -30,24 +30,12 @@ def restore_tree(target: Any, data: bytes) -> Any:
     return serialization.from_bytes(target, data)
 
 
-def _atomic_write(path: Path, data: bytes) -> None:
-    """Write via temp file + rename so a preemption never leaves a torn file."""
-    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
-    except BaseException:
-        os.unlink(tmp)
-        raise
-
-
 def save_checkpoint(directory: str | Path, state: Any, step: int) -> Path:
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     path = directory / f"ckpt_{step:08d}.msgpack"
-    _atomic_write(path, tree_bytes(state))
-    _atomic_write(
+    atomic_write(path, tree_bytes(state))
+    atomic_write(
         directory / "latest.json",
         json.dumps({"step": step, "file": path.name}).encode(),
     )
@@ -62,20 +50,26 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
     returns None (fresh start) when nothing is recoverable.
     """
     directory = Path(directory)
-    candidates: list[Path] = []
+    candidates: list[tuple[Path, int | None]] = []
     latest = directory / "latest.json"
     if latest.exists():
         try:
             meta = json.loads(latest.read_text())
-            candidates.append(directory / meta["file"])
-        except (json.JSONDecodeError, KeyError, OSError):
+            candidates.append((directory / meta["file"], int(meta["step"])))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError, OSError):
             pass
-    candidates.extend(sorted(directory.glob("ckpt_*.msgpack"), reverse=True))
-    for path in candidates:
+    candidates.extend(
+        (p, None) for p in sorted(directory.glob("ckpt_*.msgpack"), reverse=True)
+    )
+    for path, known_step in candidates:
         try:
             restored = restore_tree(target, path.read_bytes())
-        except (OSError, ValueError, KeyError):
+            step = (
+                known_step
+                if known_step is not None
+                else int(path.stem.split("_")[1])
+            )
+        except (OSError, ValueError, KeyError, IndexError):
             continue
-        step = int(path.stem.split("_")[1])
         return restored, step
     return None

@@ -1,0 +1,123 @@
+"""End-to-end training pipeline: data -> train -> monitor -> bundle -> registry.
+
+This is the TPU-native restatement of the reference's two-notebook job
+(`train_register_model_job`: notebook 01 trains + selects, notebook 02 fits
+detectors + packages + registers — SURVEY.md SS3.2). One process, one data
+read, typed artifacts instead of ``dbutils.jobs.taskValues`` handoffs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.bundle import ModelRegistry, save_bundle
+from mlops_tpu.config import Config
+from mlops_tpu.data import (
+    EncodedDataset,
+    Preprocessor,
+    generate_synthetic,
+    load_csv_columns,
+)
+from mlops_tpu.models import build_model
+from mlops_tpu.monitor import fit_monitor
+from mlops_tpu.train.loop import TrainResult, fit
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    bundle_dir: Path
+    model_uri: str | None
+    train_result: TrainResult
+    run_dir: Path
+
+
+def load_training_data(config: Config) -> tuple[dict[str, list], np.ndarray]:
+    """CSV if configured, else the synthetic generator (data layer contract)."""
+    if config.data.train_path:
+        columns, labels = load_csv_columns(
+            config.data.train_path, require_target=True
+        )
+        return columns, labels
+    return generate_synthetic(config.data.rows, seed=config.data.seed)
+
+
+def split_dataset(
+    ds: EncodedDataset, valid_fraction: float, seed: int = 2024
+) -> tuple[EncodedDataset, EncodedDataset]:
+    """Shuffled split (parity: ``train_test_split(random_state=2024)``,
+    `01-train-model.ipynb` cell 7)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(ds.n)
+    n_valid = int(ds.n * valid_fraction)
+    return ds.slice(perm[n_valid:]), ds.slice(perm[:n_valid])
+
+
+def run_training(
+    config: Config,
+    register: bool = True,
+    run_name: str | None = None,
+) -> PipelineResult:
+    """Train one model per config and package it as a bundle.
+
+    Steps (each replacing a reference stage):
+      1. read + encode data once (vs per-trial Spark re-reads)
+      2. ``fit`` the model (notebook 01's role)
+      3. fit drift + outlier monitors on the training split (notebook 02
+         cell 6)
+      4. write the bundle (notebook 02's pyfunc ``log_model``)
+      5. register it (notebook 02's ``register_model``), returning a
+         ``models:/<name>/<version>`` URI
+    """
+    run_name = run_name or time.strftime("%Y%m%d-%H%M%S")
+    run_dir = Path(config.registry.run_root) / run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    columns, labels = load_training_data(config)
+    preprocessor = Preprocessor.fit(columns)
+    ds = preprocessor.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
+
+    model = build_model(config.model)
+    result = fit(
+        model,
+        train_ds,
+        valid_ds,
+        config.train,
+        metrics_path=run_dir / "metrics.jsonl",
+        checkpoint_dir=run_dir / "checkpoints",
+    )
+
+    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
+
+    bundle_dir = run_dir / "bundle"
+    save_bundle(
+        bundle_dir,
+        config.model,
+        result.params,
+        preprocessor,
+        monitor,
+        metrics=result.metrics,
+        tags={"run_name": run_name, "experiment": config.registry.experiment_name},
+    )
+
+    model_uri = None
+    if register:
+        registry = ModelRegistry(config.registry.root)
+        model_uri = registry.register(
+            config.registry.model_name,
+            bundle_dir,
+            tags={"run_name": run_name, **{
+                k: f"{v:.6f}" for k, v in result.metrics.items()
+            }},
+        )
+    return PipelineResult(
+        bundle_dir=bundle_dir,
+        model_uri=model_uri,
+        train_result=result,
+        run_dir=run_dir,
+    )

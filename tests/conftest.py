@@ -50,6 +50,23 @@ def encoded_small(synth_small):
 
 
 @pytest.fixture(scope="session")
+def tiny_pipeline(tmp_path_factory):
+    """One small end-to-end training run shared by bundle/serve/CLI tests."""
+    from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.train.pipeline import run_training
+
+    root = tmp_path_factory.mktemp("pipeline")
+    config = Config()
+    config.data.rows = 3000
+    config.model = ModelConfig(family="mlp", hidden_dims=(32, 32), embed_dim=4)
+    config.train = TrainConfig(steps=100, eval_every=100, batch_size=256)
+    config.registry.root = str(root / "registry")
+    config.registry.run_root = str(root / "runs")
+    result = run_training(config)
+    return config, result
+
+
+@pytest.fixture(scope="session")
 def sample_request():
     """The reference's exact smoke-test payload (`app/sample-request.json`)."""
     return [

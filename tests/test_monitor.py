@@ -1,0 +1,103 @@
+"""Monitor tests: scipy parity for the statistics, drift/outlier semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlops_tpu.config import MonitorConfig
+from mlops_tpu.monitor import MonitorState, drift_scores, fit_monitor, outlier_flags
+from mlops_tpu.ops.drift import chi2_two_sample, ks_two_sample
+from mlops_tpu.ops.outlier import fit_mahalanobis, mahalanobis_sq
+from mlops_tpu.schema import NUM_FEATURES
+
+
+def test_chi2_matches_scipy():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    rng = np.random.default_rng(0)
+    ref = rng.multinomial(5000, [0.5, 0.3, 0.15, 0.05]).astype(float)
+    batch = rng.multinomial(300, [0.4, 0.35, 0.15, 0.10]).astype(float)
+    stat, p = chi2_two_sample(jnp.asarray(ref), jnp.asarray(batch))
+    ref_stat, ref_p, _, _ = scipy_stats.chi2_contingency(
+        np.stack([ref, batch]), correction=False
+    )
+    assert abs(float(stat) - ref_stat) < 1e-3
+    assert abs(float(p) - ref_p) < 1e-5
+
+
+def test_chi2_empty_categories_masked():
+    # Categories observed in neither sample must not poison the statistic.
+    ref = jnp.asarray([100.0, 50.0, 0.0, 0.0])
+    batch = jnp.asarray([40.0, 20.0, 0.0, 0.0])
+    stat, p = chi2_two_sample(ref, batch)
+    assert np.isfinite(float(stat))
+    assert float(p) > 0.9  # same distribution -> no drift
+
+
+def test_ks_matches_scipy_asymp():
+    scipy_stats = pytest.importorskip("scipy.stats")
+    rng = np.random.default_rng(1)
+    ref = np.sort(rng.normal(size=2048)).astype(np.float32)
+    batch = rng.normal(0.3, 1.0, size=256).astype(np.float32)
+    stat, p = ks_two_sample(jnp.asarray(ref), jnp.asarray(batch))
+    res = scipy_stats.ks_2samp(ref, batch, method="asymp")
+    assert abs(float(stat) - res.statistic) < 1e-6
+    # Asymptotic formulas differ slightly (Stephens correction) — tight but
+    # not exact.
+    assert abs(float(p) - res.pvalue) < 5e-3
+
+
+def test_ks_identical_distribution_high_p():
+    rng = np.random.default_rng(2)
+    sample = rng.normal(size=2048).astype(np.float32)
+    stat, p = ks_two_sample(jnp.asarray(np.sort(sample)), jnp.asarray(sample))
+    assert float(stat) < 1e-6
+    assert float(p) > 0.99
+
+
+def test_mahalanobis_flags_quantile():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5000, 14)).astype(np.float32)
+    mean, precision, threshold = fit_mahalanobis(x, quantile=0.95)
+    d = mahalanobis_sq(jnp.asarray(x), jnp.asarray(mean), jnp.asarray(precision))
+    frac = float((np.asarray(d) > threshold).mean())
+    assert abs(frac - 0.05) < 0.01  # ~5% of training data flagged
+
+
+def test_monitor_fit_and_score_in_distribution(encoded_small):
+    _, ds = encoded_small
+    state = fit_monitor(ds, MonitorConfig())
+    scores = drift_scores(state, jnp.asarray(ds.cat_ids), jnp.asarray(ds.numeric))
+    assert scores.shape == (NUM_FEATURES,)
+    # Scoring the training data against itself: no drift anywhere.
+    assert float(np.max(np.asarray(scores))) < 0.95
+    flags = outlier_flags(state, jnp.asarray(ds.numeric))
+    assert set(np.unique(np.asarray(flags))) <= {0.0, 1.0}
+    assert 0.01 < float(np.mean(np.asarray(flags))) < 0.10
+
+
+def test_monitor_detects_shift(encoded_small):
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+
+    prep, ds = encoded_small
+    state = fit_monitor(ds, MonitorConfig())
+    shifted_cols, _ = generate_synthetic(1000, seed=99, drift=1.5)
+    shifted = prep.encode(shifted_cols)
+    scores = drift_scores(
+        state, jnp.asarray(shifted.cat_ids), jnp.asarray(shifted.numeric)
+    )
+    # The drifted generator shifts age/credit distributions and repayment
+    # behavior: a majority of features should cross 1 - p_val > 0.95.
+    assert float(np.mean(np.asarray(scores) > 0.95)) > 0.5
+
+
+def test_monitor_state_save_load(tmp_path, encoded_small):
+    _, ds = encoded_small
+    state = fit_monitor(ds, MonitorConfig())
+    state.save(tmp_path / "monitor")
+    state2 = MonitorState.load(tmp_path / "monitor")
+    np.testing.assert_array_equal(
+        np.asarray(state.cat_ref_counts), np.asarray(state2.cat_ref_counts)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.out_precision), np.asarray(state2.out_precision)
+    )

@@ -1,0 +1,213 @@
+"""Serving tests: engine semantics + real-socket HTTP round trips.
+
+Upgrades the reference's 200-only smoke test (SURVEY.md SS4: CI curls
+`app/sample-request.json` and checks the status code, response body never
+validated) into payload-asserting golden tests.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.config import ServeConfig
+from mlops_tpu.schema import FEATURE_NAMES
+from mlops_tpu.serve import HttpServer, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_pipeline):
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+    engine = InferenceEngine(bundle, buckets=(1, 8, 64))
+    engine.warmup()
+    return engine
+
+
+# ------------------------------------------------------------------ engine
+
+
+def test_engine_padding_invariance(engine, sample_request):
+    """Bucket padding must not change any statistic: a 3-row request (padded
+    to 8) and the same rows at exact shape agree."""
+    records = sample_request * 3
+    padded = engine.predict_records(records)
+    # Bypass bucketing: exact-shape path.
+    from mlops_tpu.schema import records_to_columns
+
+    ds = engine.bundle.preprocessor.encode(records_to_columns(records))
+    big = InferenceEngine(engine.bundle, buckets=(3,))
+    exact = big.predict_arrays(ds.cat_ids, ds.numeric)
+    np.testing.assert_allclose(
+        padded["predictions"], exact["predictions"], rtol=1e-6
+    )
+    np.testing.assert_array_equal(padded["outliers"], exact["outliers"])
+    for name in FEATURE_NAMES:
+        assert abs(
+            padded["feature_drift_batch"][name]
+            - exact["feature_drift_batch"][name]
+        ) < 1e-5
+
+
+def test_engine_oversized_batch(engine, sample_request):
+    out = engine.predict_records(sample_request * 100)  # > max bucket 64
+    assert len(out["predictions"]) == 100
+    assert len(out["outliers"]) == 100
+
+
+def test_engine_response_contract(engine, sample_request):
+    out = engine.predict_records(sample_request)
+    assert set(out) == {"predictions", "outliers", "feature_drift_batch"}
+    assert len(out["predictions"]) == 1
+    assert 0.0 <= out["predictions"][0] <= 1.0
+    assert out["outliers"][0] in (0.0, 1.0)
+    assert list(out["feature_drift_batch"]) == list(FEATURE_NAMES)
+
+
+# ------------------------------------------------------------- HTTP server
+
+
+async def _http(server_port_payloads):
+    """Open the server on an ephemeral port, run client exchanges, return
+    (status, headers, body-json) per exchange."""
+    server, exchanges = server_port_payloads
+    srv = await server.start()
+    port = srv.sockets[0].getsockname()[1]
+    results = []
+    try:
+        for method, path, body in exchanges:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            data = b"" if body is None else json.dumps(body).encode()
+            request = (
+                f"{method} {path} HTTP/1.1\r\nhost: t\r\n"
+                f"content-length: {len(data)}\r\nconnection: close\r\n\r\n"
+            ).encode() + data
+            writer.write(request)
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            head, _, payload = raw.partition(b"\r\n\r\n")
+            status = int(head.split(b" ")[1])
+            results.append((status, head.decode("latin1"), payload))
+    finally:
+        srv.close()
+        await srv.wait_closed()
+    return results
+
+
+def _run_exchanges(engine, exchanges, port=0):
+    config = ServeConfig(host="127.0.0.1", port=port)
+    server = HttpServer(engine, config)
+    return asyncio.run(_http((server, exchanges)))
+
+
+def test_http_predict_golden(engine, sample_request):
+    """The reference's exact smoke payload over a real socket -> validated
+    body (vs the reference CI's unchecked `cat`, `deploy-kubernetes.yml:271`).
+    """
+    [(status, _, body)] = _run_exchanges(
+        engine, [("POST", "/predict", sample_request)]
+    )
+    assert status == 200
+    payload = json.loads(body)
+    assert set(payload) == {"predictions", "outliers", "feature_drift_batch"}
+    assert len(payload["predictions"]) == 1
+    assert 0.0 <= payload["predictions"][0] <= 1.0
+    # Determinism: same request -> identical response.
+    [(_, _, body2)] = _run_exchanges(
+        engine, [("POST", "/predict", sample_request)]
+    )
+    assert json.loads(body2)["predictions"] == payload["predictions"]
+
+
+def test_http_validation_and_probes(engine):
+    results = _run_exchanges(
+        engine,
+        [
+            ("POST", "/predict", [{"age": "not-a-number"}]),
+            ("GET", "/healthz/live", None),
+            ("GET", "/healthz/ready", None),
+            ("GET", "/metrics", None),
+            ("GET", "/nope", None),
+            ("GET", "/", None),
+        ],
+    )
+    statuses = [r[0] for r in results]
+    assert statuses == [422, 200, 200, 200, 404, 200]
+    assert b"mlops_tpu_requests_total" in results[3][2]
+    assert b"credit-default-api" in results[5][2]
+
+
+def test_http_defaults_fill_missing_fields(engine):
+    # Reference parity: every LoanApplicant field has a default
+    # (`app/model.py:12-34`), so an empty record is valid.
+    [(status, _, body)] = _run_exchanges(engine, [("POST", "/predict", [{}])])
+    assert status == 200
+    assert len(json.loads(body)["predictions"]) == 1
+
+
+def test_http_malformed_json_rejected(engine):
+    config = ServeConfig(host="127.0.0.1", port=0)
+    server = HttpServer(engine, config)
+
+    async def go():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        body = b"{not json"
+        writer.write(
+            (
+                f"POST /predict HTTP/1.1\r\nhost: t\r\n"
+                f"content-length: {len(body)}\r\nconnection: close\r\n\r\n"
+            ).encode()
+            + body
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        srv.close()
+        await srv.wait_closed()
+        return int(raw.split(b" ")[1])
+
+    assert asyncio.run(go()) == 422
+
+
+def test_http_max_batch_cap(engine, sample_request):
+    config = ServeConfig(host="127.0.0.1", port=0, max_batch=4)
+    server = HttpServer(engine, config)
+    [(status, _, body)] = asyncio.run(
+        _http((server, [("POST", "/predict", sample_request * 5)]))
+    )
+    assert status == 413
+    assert b"max_batch" in body
+
+
+def test_http_bad_content_length(engine):
+    config = ServeConfig(host="127.0.0.1", port=0)
+    server = HttpServer(engine, config)
+
+    async def go():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"POST /predict HTTP/1.1\r\nhost: t\r\ncontent-length: abc\r\n\r\n"
+        )
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        srv.close()
+        await srv.wait_closed()
+        return int(raw.split(b" ")[1])
+
+    assert asyncio.run(go()) == 400
+
+
+def test_readiness_gate(tiny_pipeline):
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+    cold = InferenceEngine(bundle, buckets=(1,))  # no warmup
+    [(status, _, body)] = _run_exchanges(cold, [("GET", "/healthz/ready", None)])
+    assert status == 503
