@@ -1,0 +1,98 @@
+"""Inference benchmark — the headline number (BASELINE.md north star:
+p50 < 5 ms and >= 2k req/s per chip for credit-default inference).
+
+Runs on whatever backend JAX selects (the real TPU chip under the driver;
+CPU if forced). Flow: train the flagship serving model briefly, build the
+warmed engine, then measure:
+
+- batch-1 end-to-end latency through the full serving path
+  (records -> encode -> device -> classifier+drift+outlier -> host), and
+- bulk throughput at the largest serving bucket.
+
+Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline", ...extras}`` where
+``vs_baseline`` = (5 ms target) / (measured p50) — >1.0 beats the target.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.serve.engine import InferenceEngine
+    from mlops_tpu.train.pipeline import run_training
+    from mlops_tpu.utils.timing import percentile
+
+    device = jax.devices()[0]
+
+    config = Config()
+    config.data.rows = 50_000
+    config.model = ModelConfig(family="mlp")
+    config.train = TrainConfig(
+        batch_size=1024, steps=600, eval_every=600, warmup_steps=60
+    )
+    config.registry.run_root = "runs/bench"
+    result = run_training(config, register=False, run_name="bench")
+    bundle = load_bundle(result.bundle_dir)
+
+    engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256))
+    engine.warmup()
+
+    # --- batch-1 latency through the full serving path -------------------
+    from mlops_tpu.schema import LoanApplicant
+
+    record = LoanApplicant().model_dump()
+    for _ in range(20):  # post-warmup steady state
+        engine.predict_records([record])
+    latencies = []
+    for _ in range(300):
+        t0 = time.perf_counter()
+        engine.predict_records([record])
+        latencies.append((time.perf_counter() - t0) * 1e3)
+    latencies.sort()
+    p50 = percentile(latencies, 50)
+    p99 = percentile(latencies, 99)
+
+    # --- bulk throughput at the largest bucket ---------------------------
+    rng = np.random.default_rng(0)
+    from mlops_tpu.schema import SCHEMA
+
+    n = 256
+    cat = rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
+    num = rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32)
+    engine.predict_arrays(cat, num)
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        engine.predict_arrays(cat, num)
+    dt = time.perf_counter() - t0
+    rows_per_s = reps * n / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "inference_p50_latency_ms",
+                "value": round(p50, 4),
+                "unit": "ms",
+                "vs_baseline": round(5.0 / p50, 3),
+                "p99_ms": round(p99, 4),
+                "batch1_req_per_s": round(1e3 / p50, 1),
+                "bulk_rows_per_s": round(rows_per_s, 1),
+                "device": str(device),
+                "model_auc": round(
+                    result.train_result.metrics["validation_roc_auc_score"], 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

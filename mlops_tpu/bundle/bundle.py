@@ -35,7 +35,15 @@ class Bundle:
 
     @property
     def model_config(self) -> ModelConfig:
-        return ModelConfig(**self.manifest["model_config"])
+        return _model_config_from_manifest(self.manifest)
+
+
+def _model_config_from_manifest(manifest: dict[str, Any]) -> ModelConfig:
+    """JSON lists -> tuples so manifests round-trip to equal ModelConfigs."""
+    return ModelConfig(**{
+        k: tuple(v) if isinstance(v, list) else v
+        for k, v in manifest["model_config"].items()
+    })
 
 
 def save_bundle(
@@ -89,10 +97,7 @@ def load_bundle(directory: str | Path) -> Bundle:
             f"{manifest['schema_fingerprint']}, runtime schema is "
             f"{SCHEMA.fingerprint()}"
         )
-    model_config = ModelConfig(**{
-        k: tuple(v) if isinstance(v, list) else v
-        for k, v in manifest["model_config"].items()
-    })
+    model_config = _model_config_from_manifest(manifest)
     model = build_model(model_config)
     template = init_params(model, jax.random.PRNGKey(0))
     params = restore_tree(

@@ -84,10 +84,16 @@ class ModelRegistry:
         )
         dest = versions_dir / str(version)
         # Copy to a temp sibling then rename: a partial copy is never visible
-        # under a version number.
+        # under a version number. Single-writer assumption: concurrent
+        # registers of the same name are not coordinated (CI serializes the
+        # release pipeline, as the reference's workflow jobs do via `needs:`).
         staging = versions_dir / f".incoming-{uuid.uuid4().hex}"
-        shutil.copytree(bundle_dir, staging)
-        staging.replace(dest)
+        try:
+            shutil.copytree(bundle_dir, staging)
+            staging.replace(dest)
+        except BaseException:
+            shutil.rmtree(staging, ignore_errors=True)
+            raise
         index["versions"].append(
             {
                 "version": version,
@@ -129,19 +135,27 @@ class ModelRegistry:
         return self.resolve(*parse_model_uri(uri))
 
     def set_stage(self, name: str, version: int, stage: str) -> None:
-        """Promote/demote a version (staging -> production gate, SURVEY.md SS3.4)."""
+        """Promote/demote a version (staging -> production gate, SURVEY.md
+        SS3.4). Single-holder semantics: promoting a version to a stage
+        archives (stage='none') whichever version held it before.
+        """
         if stage not in STAGES:
             raise ValueError(f"stage must be one of {STAGES}")
         index = self._read_index(name)
-        for entry in index["versions"]:
-            if entry["version"] == version:
-                entry["stage"] = stage
-                entry[f"{stage}_since"] = datetime.datetime.now(
-                    datetime.timezone.utc
-                ).isoformat()
-                self._write_index(name, index)
-                return
-        raise KeyError(f"model {name!r} has no version {version}")
+        target = next(
+            (e for e in index["versions"] if e["version"] == version), None
+        )
+        if target is None:
+            raise KeyError(f"model {name!r} has no version {version}")
+        if stage != "none":
+            for entry in index["versions"]:
+                if entry is not target and entry["stage"] == stage:
+                    entry["stage"] = "none"
+        target["stage"] = stage
+        target[f"{stage}_since"] = datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat()
+        self._write_index(name, index)
 
     def list_versions(self, name: str) -> list[dict[str, Any]]:
         return self._read_index(name)["versions"]

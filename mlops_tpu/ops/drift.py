@@ -131,6 +131,8 @@ def ks_two_sample_masked(
     batch_cdf = jnp.minimum(batch_counts.astype(jnp.float32), n_valid) / n_valid
     finite = jnp.isfinite(pooled)
     statistic = jnp.where(finite, jnp.abs(ref_cdf - batch_cdf), 0.0).max()
+    # All-padded batch: no data, no signal.
+    statistic = jnp.where(mask.any(), statistic, 0.0)
 
     en = jnp.sqrt(r * n_valid / (r + n_valid))
     p_value = _kolmogorov_sf((en + 0.12 + 0.11 / en) * statistic)

@@ -1,0 +1,41 @@
+"""Parallelism: device mesh, shardings, collectives, distributed steps.
+
+The reference has NO distributed compute of any kind (SURVEY.md SS2.7: a
+1-worker Spark cluster, sequential hyperopt, `n_jobs=-1` threads). This
+module is the TPU-native capability the rebuild owes instead:
+
+- ``mesh``        build a ``jax.sharding.Mesh`` with ``('data', 'model')``
+  axes over a v5e slice (or the CPU-simulated 8-device test mesh)
+- ``sharding``    NamedSharding helpers + regex param-partition rules
+  (Megatron-style column/row splits for the dense trunks)
+- ``steps``       pjit train step + batch scorer: annotate shardings, let
+  XLA insert the collectives over ICI (psum for grads, all-gathers for TP)
+- ``collectives`` explicit shard_map building blocks (psum/all_gather/
+  ppermute) for paths that want manual SPMD
+
+Multi-host: ``jax.distributed.initialize`` + the same mesh spanning hosts —
+the DCN story is configuration, not new code (SURVEY.md SS5.8).
+"""
+
+from mlops_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from mlops_tpu.parallel.sharding import (
+    PARAM_RULES,
+    batch_sharding,
+    param_shardings,
+    replicated,
+)
+from mlops_tpu.parallel.steps import (
+    make_sharded_batch_scorer,
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "PARAM_RULES",
+    "batch_sharding",
+    "make_mesh",
+    "make_sharded_batch_scorer",
+    "make_sharded_train_step",
+    "mesh_shape_for",
+    "param_shardings",
+    "replicated",
+]

@@ -1,0 +1,69 @@
+"""Explicit-SPMD building blocks via shard_map.
+
+Most of the framework relies on jit+shardings and lets XLA place
+collectives; these wrappers exist for code that wants manual control (custom
+reductions, ring algorithms, comms/compute overlap experiments) and as the
+tested seam where psum/all_gather/ppermute semantics are pinned down on the
+fake 8-device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pmean_over_data(fn: Callable, mesh: Mesh) -> Callable:
+    """Wrap ``fn(batch_shard) -> scalar`` into a data-parallel mean over the
+    'data' axis (the gradient-reduction primitive, made explicit)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def wrapped(shard):
+        return jax.lax.pmean(fn(shard), axis_name="data")
+
+    return wrapped
+
+
+def all_gather_rows(mesh: Mesh) -> Callable:
+    """Gather row-sharded arrays onto every device (diagnostics, eval)."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def gather(shard):
+        return jax.lax.all_gather(shard, axis_name="data", tiled=True)
+
+    return gather
+
+
+def ring_shift(mesh: Mesh, axis: str = "data") -> Callable:
+    """Rotate shards one step around the mesh axis ring via ppermute — the
+    primitive under ring-attention / ring all-reduce patterns."""
+    n = mesh.devices.shape[list(mesh.axis_names).index(axis)]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    def shift(shard):
+        return jax.lax.ppermute(shard, axis_name=axis, perm=perm)
+
+    return shift

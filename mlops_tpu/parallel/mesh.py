@@ -1,0 +1,37 @@
+"""Device mesh construction for single-host slices and multi-host pods."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "model")
+
+
+def mesh_shape_for(n_devices: int, model_parallel: int = 1) -> tuple[int, int]:
+    """Factor a device count into (data, model) axis sizes."""
+    if n_devices % model_parallel:
+        raise ValueError(
+            f"{n_devices} devices not divisible by model_parallel="
+            f"{model_parallel}"
+        )
+    return n_devices // model_parallel, model_parallel
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallel: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``('data', 'model')`` mesh.
+
+    On a v5e slice the devices enumerate in ICI-adjacent order, so adjacent
+    mesh coordinates ride ICI links; on the CPU-simulated test mesh
+    (``xla_force_host_platform_device_count``) topology is moot.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    dp, mp = mesh_shape_for(n, model_parallel)
+    grid = np.asarray(devices[:n]).reshape(dp, mp)
+    return Mesh(grid, AXES)

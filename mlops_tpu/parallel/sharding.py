@@ -1,0 +1,73 @@
+"""Sharding specs: batch layouts + regex partition rules for param trees.
+
+Megatron-style tensor parallelism for the dense trunks: the first matmul of
+each block is column-split (output features over 'model'), the second is
+row-split (input features over 'model'); XLA inserts the psum on the row-cut
+output. Embeddings and norms replicate (tiny). The same rules serve MLP and
+FT-Transformer because both name their projections accordingly.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec) — first match wins; default replicate.
+PARAM_RULES: tuple[tuple[str, P], ...] = (
+    # MLP residual blocks: a = column-parallel, b = row-parallel.
+    (r"dense_\d+a/kernel", P(None, "model")),
+    (r"dense_\d+b/kernel", P("model", None)),
+    (r"stem/kernel", P(None, None)),
+    # FT-Transformer attention (flax MHA: kernels [embed, heads, head_dim] /
+    # [heads, head_dim, embed]): shard the heads axis.
+    (r"Attention_\d+/(query|key|value)/kernel", P(None, "model", None)),
+    (r"Attention_\d+/out/kernel", P("model", None, None)),
+    # FT-Transformer MLP: Dense_0 widens (column), Dense_1 narrows (row).
+    (r"block_\d+/Dense_0/kernel", P(None, "model")),
+    (r"block_\d+/Dense_1/kernel", P("model", None)),
+)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) axis over 'data'; trailing axes replicated."""
+    return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def param_shardings(
+    mesh: Mesh,
+    params: Any,
+    rules: tuple[tuple[str, P], ...] = PARAM_RULES,
+) -> Any:
+    """Map a param pytree to NamedShardings via regex rules (default:
+    replicate). Specs with more axes than the leaf are right-truncated."""
+
+    def assign(path, leaf):
+        path_s = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, path_s):
+                trimmed = P(*spec[: leaf.ndim])
+                # Drop 'model' axes that don't divide the dim (tiny leaves).
+                sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+                cleaned = []
+                for dim, axis in zip(leaf.shape, trimmed):
+                    if axis is not None and dim % sizes.get(axis, 1):
+                        cleaned.append(None)
+                    else:
+                        cleaned.append(axis)
+                return NamedSharding(mesh, P(*cleaned))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, params)

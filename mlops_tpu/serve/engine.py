@@ -63,6 +63,14 @@ class InferenceEngine:
         self, cat_ids: np.ndarray, numeric: np.ndarray
     ) -> dict[str, Any]:
         n = cat_ids.shape[0]
+        if n == 0:
+            # Empty request: nothing to score, no drift signal (an empty
+            # batch must not poison the drift gauges with statistic=1).
+            return {
+                "predictions": [],
+                "outliers": [],
+                "feature_drift_batch": dict.fromkeys(SCHEMA.feature_names, 0.0),
+            }
         bucket = self._bucket_for(n)
         if bucket is not None:
             pad = bucket - n

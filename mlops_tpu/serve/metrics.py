@@ -26,7 +26,19 @@ class ServingMetrics:
         self.outliers_total = 0
         self.last_drift: dict[str, float] = {}
 
+    # Known routes only: arbitrary request paths must not become unbounded
+    # (and injectable) Prometheus label values.
+    KNOWN_ROUTES = (
+        "/predict",
+        "/",
+        "/healthz/live",
+        "/healthz/ready",
+        "/metrics",
+    )
+
     def observe_request(self, route: str, status: int, latency_ms: float) -> None:
+        if route not in self.KNOWN_ROUTES:
+            route = "<other>"
         with self._lock:
             self.requests[(route, status)] += 1
             self.latency_sum_ms += latency_ms

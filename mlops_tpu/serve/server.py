@@ -45,9 +45,21 @@ returns <code>{{"predictions": [...], "outliers": [...],
 
 
 class HttpServer:
+    MAX_BODY_BYTES = 16 * 1024 * 1024
+    MAX_HEADERS = 100
+
     def __init__(self, engine: InferenceEngine, config: ServeConfig):
         self.engine = engine
         self.config = config
+        # Invariant: the request cap can never exceed the largest warmed
+        # bucket, or steady-state traffic would hit exact-shape recompiles.
+        if config.max_batch > engine.max_bucket:
+            logger.warning(
+                "serve.max_batch=%d exceeds largest warmup bucket %d; clamping",
+                config.max_batch,
+                engine.max_bucket,
+            )
+            config.max_batch = engine.max_bucket
         self.metrics = ServingMetrics()
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="predict"
@@ -69,18 +81,34 @@ class HttpServer:
                     await self._write_response(writer, 400, {"detail": "bad request"})
                     break
                 headers = {}
+                header_error = False
                 while True:
                     line = await reader.readline()
                     if line in (b"\r\n", b"\n", b""):
                         break
+                    if len(headers) >= self.MAX_HEADERS:
+                        header_error = True
+                        break
                     name, _, value = line.decode("latin1").partition(":")
                     headers[name.strip().lower()] = value.strip()
+                if header_error:
+                    await self._write_response(
+                        writer, 400, {"detail": "too many headers"}
+                    )
+                    break
                 body = b""
                 try:
                     length = int(headers.get("content-length", 0) or 0)
                 except ValueError:
                     await self._write_response(
                         writer, 400, {"detail": "bad content-length"}
+                    )
+                    break
+                if length > self.MAX_BODY_BYTES:
+                    await self._write_response(
+                        writer,
+                        413,
+                        {"detail": f"body exceeds {self.MAX_BODY_BYTES} bytes"},
                     )
                     break
                 if length:
@@ -227,14 +255,28 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
     # before binding would make K8s liveness probes connection-refuse through
     # the whole compile window and restart the pod.)
     loop = asyncio.get_running_loop()
-    warmup = loop.run_in_executor(None, engine.warmup)
-    warmup.add_done_callback(
-        lambda f: logger.error("warmup failed: %s", f.exception())
-        if f.exception()
-        else logger.info("warmup complete; ready")
-    )
-    async with srv:
-        await srv.serve_forever()
+    warmup_error: list[BaseException] = []
+
+    async def _warm() -> None:
+        try:
+            await loop.run_in_executor(None, engine.warmup)
+            logger.info("warmup complete; ready")
+        except BaseException as err:  # compile failure/OOM: die loudly so
+            # the orchestrator restarts the pod instead of a forever-503 zombie
+            warmup_error.append(err)
+            logger.error("warmup failed, shutting down: %s", err)
+            srv.close()
+
+    warm_task = asyncio.create_task(_warm())
+    try:
+        async with srv:
+            await srv.serve_forever()
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await warm_task
+    if warmup_error:
+        raise SystemExit(f"warmup failed: {warmup_error[0]}")
 
 
 def serve_forever(engine: InferenceEngine, config: ServeConfig) -> None:

@@ -86,6 +86,20 @@ def test_registry_versioning_and_stages(trained, tmp_path):
     assert registry.resolve_uri("models:/m/1").name == "1"
 
 
+def test_registry_single_stage_holder(trained, tmp_path):
+    _, result = trained
+    registry = ModelRegistry(tmp_path / "reg")
+    registry.register("m", result.bundle_dir)
+    registry.register("m", result.bundle_dir)
+    registry.set_stage("m", 1, "production")
+    registry.set_stage("m", 2, "production")  # archives v1
+    stages = {v["version"]: v["stage"] for v in registry.list_versions("m")}
+    assert stages == {1: "none", 2: "production"}
+    registry.set_stage("m", 2, "staging")  # demotion leaves NO production
+    with pytest.raises(KeyError):
+        registry.resolve("m", "production")
+
+
 def test_registry_recovers_from_orphan_version_dir(trained, tmp_path):
     # A crash between bundle copy and index write leaves an orphan version
     # dir; the next register() must skip past it, not collide.

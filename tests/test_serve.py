@@ -174,6 +174,26 @@ def test_http_malformed_json_rejected(engine):
     assert asyncio.run(go()) == 422
 
 
+def test_http_empty_request_no_drift_poison(engine):
+    # An empty list is valid, returns empty outputs, and must not report
+    # drift (an all-padded batch has no signal).
+    [(status, _, body)] = _run_exchanges(engine, [("POST", "/predict", [])])
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["predictions"] == []
+    assert all(v == 0.0 for v in payload["feature_drift_batch"].values())
+
+
+def test_metrics_unknown_route_bounded(engine):
+    results = _run_exchanges(
+        engine,
+        [("GET", f"/scan-{i}", None) for i in range(5)] + [("GET", "/metrics", None)],
+    )
+    body = results[-1][2].decode()
+    assert 'route="<other>"' in body
+    assert "/scan-0" not in body
+
+
 def test_http_max_batch_cap(engine, sample_request):
     config = ServeConfig(host="127.0.0.1", port=0, max_batch=4)
     server = HttpServer(engine, config)
