@@ -43,6 +43,25 @@ def _train(config) -> int:
     return 0
 
 
+def _tune(config) -> int:
+    from mlops_tpu.train.pipeline import run_tuning
+
+    result, hpo_result = run_tuning(config)
+    print(
+        json.dumps(
+            {
+                "bundle": str(result.bundle_dir),
+                "model_uri": result.model_uri,
+                "best_trial": hpo_result.best_index,
+                "best_hyperparams": hpo_result.best_hyperparams,
+                "metrics": hpo_result.best_metrics,
+                "trials": len(hpo_result.trials),
+            }
+        )
+    )
+    return 0
+
+
 def _register(config) -> int:
     """Register an existing bundle directory (data.train_path doubles as the
     bundle path argument: ``mlops-tpu register data.train_path=<dir>``)."""
@@ -136,6 +155,7 @@ def _serve(config) -> int:
 _HANDLERS = {
     "synth": _synth,
     "train": _train,
+    "tune": _tune,
     "register": _register,
     "predict-file": _predict_file,
     "serve": _serve,

@@ -121,3 +121,82 @@ def run_training(
         train_result=result,
         run_dir=run_dir,
     )
+
+
+def run_tuning(
+    config: Config,
+    register: bool = True,
+    run_name: str | None = None,
+    mesh=None,
+) -> tuple[PipelineResult, "Any"]:
+    """HPO sweep -> package the winning trial (the reference's notebook-01
+    select-best-child-run flow, `01-train-model.ipynb` cells 8-10 +
+    notebook-02 packaging, in one process).
+    """
+    import json
+
+    from mlops_tpu.train.hpo import run_hpo
+    from mlops_tpu.train.loop import TrainResult
+    from mlops_tpu.utils.jsonl import JsonlWriter
+
+    run_name = run_name or time.strftime("%Y%m%d-%H%M%S") + "-tune"
+    run_dir = Path(config.registry.run_root) / run_name
+    run_dir.mkdir(parents=True, exist_ok=True)
+
+    columns, labels = load_training_data(config)
+    preprocessor = Preprocessor.fit(columns)
+    ds = preprocessor.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
+
+    hpo_result = run_hpo(
+        config.model, config.train, config.hpo, train_ds, valid_ds, mesh=mesh
+    )
+    with JsonlWriter(run_dir / "trials.jsonl") as writer:
+        for i, trial in enumerate(hpo_result.trials):
+            writer.write({"trial": i, **trial})
+    (run_dir / "best.json").write_text(
+        json.dumps(
+            {
+                "best_index": hpo_result.best_index,
+                "hyperparams": hpo_result.best_hyperparams,
+                "metrics": hpo_result.best_metrics,
+            },
+            indent=2,
+        )
+    )
+
+    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
+    bundle_dir = run_dir / "bundle"
+    save_bundle(
+        bundle_dir,
+        config.model,
+        hpo_result.best_params,
+        preprocessor,
+        monitor,
+        metrics=hpo_result.best_metrics,
+        tags={
+            "run_name": run_name,
+            "best_trial": str(hpo_result.best_index),
+            **{k: f"{v:.6g}" for k, v in hpo_result.best_hyperparams.items()},
+        },
+    )
+    model_uri = None
+    if register:
+        registry = ModelRegistry(config.registry.root)
+        model_uri = registry.register(
+            config.registry.model_name,
+            bundle_dir,
+            tags={"run_name": run_name, "best_trial": str(hpo_result.best_index)},
+        )
+    result = PipelineResult(
+        bundle_dir=bundle_dir,
+        model_uri=model_uri,
+        train_result=TrainResult(
+            params=hpo_result.best_params,
+            metrics=hpo_result.best_metrics,
+            history=[],
+            steps=config.hpo.steps,
+        ),
+        run_dir=run_dir,
+    )
+    return result, hpo_result
