@@ -1,0 +1,7 @@
+"""``python -m mlops_tpu`` — the CLI entry point."""
+
+import sys
+
+from mlops_tpu.cli import main
+
+sys.exit(main())

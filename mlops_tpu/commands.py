@@ -9,11 +9,33 @@ from mlops_tpu.config import load_config
 
 
 def run(args: argparse.Namespace) -> int:
+    _honor_jax_platforms_env()
     config = load_config(args.config, overrides=getattr(args, "overrides", []))
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
     return handler(config) or 0
+
+
+def _honor_jax_platforms_env() -> None:
+    """Make an explicit ``JAX_PLATFORMS`` env win over site bootstrap.
+
+    This container's TPU bootstrap force-sets ``jax_platforms="axon,cpu"``
+    in every interpreter, which silently overrides the env var — a user who
+    exported ``JAX_PLATFORMS=cpu`` (tests, CI, laptops) would still dial the
+    TPU tunnel. Re-assert the env value at the config level before any
+    backend initializes.
+    """
+    import os
+
+    value = os.environ.get("JAX_PLATFORMS")
+    if value:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", value)
+        except RuntimeError:
+            pass  # backends already initialized; keep what we have
 
 
 def _synth(config) -> int:
@@ -44,9 +66,15 @@ def _train(config) -> int:
 
 
 def _tune(config) -> int:
+    import jax
+
+    from mlops_tpu.parallel import make_mesh
     from mlops_tpu.train.pipeline import run_tuning
 
-    result, hpo_result = run_tuning(config)
+    # Shard the trial axis across every available chip; single-device runs
+    # (laptops, 1-chip CI) skip the mesh and train trials vmapped in-place.
+    mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
+    result, hpo_result = run_tuning(config, mesh=mesh)
     print(
         json.dumps(
             {

@@ -20,6 +20,8 @@ from typing import Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
+from mlops_tpu.models.layers import MultiHeadSelfAttention
+
 
 class FeatureTokenizer(nn.Module):
     """Map (cat_ids, numeric) -> token sequence [N, F+1, D] with CLS first."""
@@ -73,12 +75,11 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads,
+        h = MultiHeadSelfAttention(
+            heads=self.heads,
             dtype=self.dtype,
-            dropout_rate=self.dropout,
-            deterministic=not train,
-        )(h, h)
+            dropout=self.dropout,
+        )(h, deterministic=not train)
         x = x + nn.Dropout(self.dropout, deterministic=not train)(h)
 
         h = nn.LayerNorm(dtype=self.dtype)(x)

@@ -1,0 +1,68 @@
+"""Shared Flax layers: self-attention over the framework's kernel dispatcher.
+
+``MultiHeadSelfAttention`` replaces ``nn.MultiHeadDotProductAttention`` so
+every transformer in the zoo (FT-Transformer, BERT) runs inference through
+``mlops_tpu.ops.attention.attend`` — dense XLA fusion at short sequence,
+the Pallas flash kernel at BERT-length sequence. Attention-weight dropout
+requires the materialized score matrix, so training with dropout uses the
+dense path; eval/serving always goes through the dispatcher.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mlops_tpu.ops.attention import attend, reference_attention
+
+
+class MultiHeadSelfAttention(nn.Module):
+    heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    dropout: float = 0.0
+    use_flash: bool | None = None  # None = dispatch on sequence length
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        deterministic: bool = True,
+        mask: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
+        n, s, dim = x.shape
+        if dim % self.heads:
+            raise ValueError(f"dim {dim} not divisible by heads {self.heads}")
+        head_dim = dim // self.heads
+
+        qkv = nn.DenseGeneral(
+            (3, self.heads, head_dim), dtype=self.dtype, name="qkv"
+        )(x)  # [N, S, 3, H, Dh]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        needs_weight_dropout = self.dropout > 0.0 and not deterministic
+        if mask is not None or needs_weight_dropout:
+            # Dense path: padding masks and attention-weight dropout need the
+            # materialized [B,H,S,S] scores (training-time only for dropout).
+            scale = 1.0 / math.sqrt(head_dim)
+            scores = (
+                jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+            )
+            if mask is not None:  # mask: [N, S] True = attend
+                scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if needs_weight_dropout:
+                probs = nn.Dropout(self.dropout, deterministic=False)(probs)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+        else:
+            out = attend(q, k, v, use_flash=self.use_flash)
+
+        return nn.DenseGeneral(
+            dim, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(out)
+
+
+__all__ = ["MultiHeadSelfAttention", "attend", "reference_attention"]

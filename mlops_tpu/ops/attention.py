@@ -1,0 +1,226 @@
+"""Attention kernels: Pallas flash attention + XLA reference path.
+
+The reference has no attention anywhere (sklearn trees only); attention
+enters this framework through the FT-Transformer (BASELINE.json config 3)
+and the BERT stretch config (config 5). Two execution paths:
+
+- ``reference_attention`` — plain jnp einsum softmax; what XLA already fuses
+  well at short sequence (FT-Transformer runs at seq=24 where this is
+  near-roofline).
+- ``flash_attention`` — a Pallas TPU kernel with online softmax: Q/K/V are
+  streamed through VMEM in (block_q, block_k) tiles, scores never materialize
+  in HBM, so activation memory is O(S·D) instead of O(S²). This is the path
+  for BERT-length sequences (128–512+) and the building block the ring
+  variant (``mlops_tpu.parallel.ring_attention``) reuses per-shard.
+
+Backward: ``flash_attention`` carries a custom VJP whose forward runs the
+Pallas kernel and whose backward rematerializes dense attention with XLA ops
+(O(S²) only inside the backward, standard remat trade). Training at BERT
+scale fits comfortably; the serving hot path is forward-only.
+
+Layout convention matches Flax: ``[batch, seq, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def reference_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float | None = None
+) -> jnp.ndarray:
+    """Dense softmax attention, [B,S,H,D] -> [B,S,H,D]; fp32 softmax."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+# --------------------------------------------------------------------------
+# Pallas kernel
+# --------------------------------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, kv_len, block_k
+):
+    """One (batch*head, q_block) tile; grid axis 2 walks k blocks.
+
+    Online softmax: running max ``m``, normalizer ``l`` and unnormalized
+    accumulator ``acc`` live in VMEM scratch across the k-block loop; the
+    output tile is written once on the final k block.
+    """
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_k, d]
+    v = v_ref[0]
+
+    s = jax.lax.dot_general(
+        q,
+        k,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+
+    # Mask key positions beyond the true sequence length (the wrapper pads
+    # seq up to a block multiple; padded keys must not receive probability).
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, NEG_INF)
+
+    m_prev = m_ref[:, :1]  # [block_q, 1]
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v.dtype),
+        v,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+
+    # [B,S,H,D] -> [B*H, S, D]: fold batch and heads into one parallel axis.
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    block_q = min(block_q, max(8, s_q))
+    block_k = min(block_k, max(8, s_kv))
+    pad_q = (-s_q) % block_q
+    pad_k = (-s_kv) % block_k
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+
+    grid = (b * h, nq, nk)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, kv_len=s_kv, block_k=block_k
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer l
+            pltpu.VMEM((block_q, d), jnp.float32),  # unnormalized accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                pltpu.PARALLEL,
+                pltpu.PARALLEL,
+                pltpu.ARBITRARY,  # k-block loop carries scratch state
+            ),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    return out
+
+
+def _use_interpret() -> bool:
+    """Pallas TPU kernels run in interpret mode on CPU (tests, fake mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, scale, block_q, block_k):
+    return _flash_forward(q, k, v, scale, block_q, block_k, _use_interpret())
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k):
+    out = _flash_forward(q, k, v, scale, block_q, block_k, _use_interpret())
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Fused flash attention, [B,S,H,D] -> [B,S,H,D] (self- or cross-)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    return _flash_attention(q, k, v, scale, block_q, block_k)
+
+
+# Below this sequence length the O(S²) score matrix fits trivially in VMEM
+# and XLA's fused attention beats kernel-launch bookkeeping; above it the
+# streaming kernel wins on HBM traffic.
+FLASH_MIN_SEQ = 128
+
+
+def attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: float | None = None,
+    use_flash: bool | None = None,
+) -> jnp.ndarray:
+    """Dispatch: flash kernel for long sequences, XLA einsum for short."""
+    if use_flash is None:
+        use_flash = q.shape[1] >= FLASH_MIN_SEQ
+    if use_flash:
+        return flash_attention(q, k, v, scale)
+    return reference_attention(q, k, v, scale)

@@ -21,9 +21,10 @@ PARAM_RULES: tuple[tuple[str, P], ...] = (
     (r"dense_\d+a/kernel", P(None, "model")),
     (r"dense_\d+b/kernel", P("model", None)),
     (r"stem/kernel", P(None, None)),
-    # FT-Transformer attention (flax MHA: kernels [embed, heads, head_dim] /
-    # [heads, head_dim, embed]): shard the heads axis.
-    (r"Attention_\d+/(query|key|value)/kernel", P(None, "model", None)),
+    # Transformer attention (MultiHeadSelfAttention: qkv kernel
+    # [embed, 3, heads, head_dim], out kernel [heads, head_dim, embed]):
+    # shard the heads axis.
+    (r"Attention_\d+/qkv/kernel", P(None, None, "model", None)),
     (r"Attention_\d+/out/kernel", P("model", None, None)),
     # FT-Transformer MLP: Dense_0 widens (column), Dense_1 narrows (row).
     (r"block_\d+/Dense_0/kernel", P(None, "model")),

@@ -73,10 +73,22 @@ def run_hpo(
     batch = train_config.batch_size
 
     hp = sample_hyperparams(hpo_config)
-    lrs = jnp.asarray(hp["learning_rate"], jnp.float32)
-    wds = jnp.asarray(hp["weight_decay"], jnp.float32)
-    pws = jnp.asarray(hp["pos_weight"], jnp.float32)
-    rngs = jax.random.split(jax.random.PRNGKey(hpo_config.seed), t)
+    # Pad the trial axis up to a multiple of the mesh's data axis so trial
+    # sharding always engages (a 10-trial default on an 8-chip mesh would
+    # otherwise silently fall back to one device). Padded trials re-run the
+    # leading hyperparams and are dropped before selection.
+    axis = mesh.devices.shape[0] if mesh is not None else 1
+    t_run = ((t + axis - 1) // axis) * axis
+    if t_run != t:
+        hp_run = {
+            k: np.concatenate([v, v[: t_run - t]]) for k, v in hp.items()
+        }
+    else:
+        hp_run = hp
+    lrs = jnp.asarray(hp_run["learning_rate"], jnp.float32)
+    wds = jnp.asarray(hp_run["weight_decay"], jnp.float32)
+    pws = jnp.asarray(hp_run["pos_weight"], jnp.float32)
+    rngs = jax.random.split(jax.random.PRNGKey(hpo_config.seed), t_run)
 
     cat = jnp.asarray(train_ds.cat_ids)
     num = jnp.asarray(train_ds.numeric)
@@ -139,7 +151,7 @@ def run_hpo(
         return params, metrics
 
     vmapped = jax.vmap(train_one)
-    if mesh is not None and t % mesh.devices.shape[0] == 0:
+    if mesh is not None:
         trial_shard = NamedSharding(mesh, P("data"))
         key_shard = NamedSharding(mesh, P("data", None))
         run = jax.jit(
@@ -149,10 +161,18 @@ def run_hpo(
     else:
         run = jax.jit(vmapped)
     stacked_params, stacked_metrics = run(lrs, wds, pws, rngs)
-    stacked_metrics = {k: np.asarray(v) for k, v in stacked_metrics.items()}
+    stacked_metrics = {k: np.asarray(v)[:t] for k, v in stacked_metrics.items()}
 
+    # Parity: order_by objective DESC — but a diverged trial's NaN metric
+    # must never win (np.argmax would return it).
     objective = stacked_metrics[hpo_config.objective]
-    best = int(np.argmax(objective))  # parity: order_by objective DESC
+    finite = np.isfinite(objective)
+    if not finite.any():
+        raise RuntimeError(
+            f"all {t} trials produced non-finite "
+            f"{hpo_config.objective}: {objective.tolist()}"
+        )
+    best = int(np.argmax(np.where(finite, objective, -np.inf)))
     best_params = jax.tree_util.tree_map(
         lambda leaf: np.asarray(leaf[best]), stacked_params
     )

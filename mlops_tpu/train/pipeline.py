@@ -57,6 +57,39 @@ def split_dataset(
     return ds.slice(perm[n_valid:]), ds.slice(perm[:n_valid])
 
 
+def _package_and_register(
+    config: Config,
+    run_dir: Path,
+    params: Any,
+    preprocessor: Preprocessor,
+    train_ds: EncodedDataset,
+    metrics: dict[str, float],
+    bundle_tags: dict[str, str],
+    registry_tags: dict[str, str],
+    register: bool,
+) -> tuple[Path, str | None]:
+    """Shared packaging tail: fit monitors, write the bundle, register it
+    (notebook 02's role — `02-register-model.ipynb` cells 6-15)."""
+    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
+    bundle_dir = run_dir / "bundle"
+    save_bundle(
+        bundle_dir,
+        config.model,
+        params,
+        preprocessor,
+        monitor,
+        metrics=metrics,
+        tags=bundle_tags,
+    )
+    model_uri = None
+    if register:
+        registry = ModelRegistry(config.registry.root)
+        model_uri = registry.register(
+            config.registry.model_name, bundle_dir, tags=registry_tags
+        )
+    return bundle_dir, model_uri
+
+
 def run_training(
     config: Config,
     register: bool = True,
@@ -92,29 +125,23 @@ def run_training(
         checkpoint_dir=run_dir / "checkpoints",
     )
 
-    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
-
-    bundle_dir = run_dir / "bundle"
-    save_bundle(
-        bundle_dir,
-        config.model,
+    bundle_dir, model_uri = _package_and_register(
+        config,
+        run_dir,
         result.params,
         preprocessor,
-        monitor,
+        train_ds,
         metrics=result.metrics,
-        tags={"run_name": run_name, "experiment": config.registry.experiment_name},
+        bundle_tags={
+            "run_name": run_name,
+            "experiment": config.registry.experiment_name,
+        },
+        registry_tags={
+            "run_name": run_name,
+            **{k: f"{v:.6f}" for k, v in result.metrics.items()},
+        },
+        register=register,
     )
-
-    model_uri = None
-    if register:
-        registry = ModelRegistry(config.registry.root)
-        model_uri = registry.register(
-            config.registry.model_name,
-            bundle_dir,
-            tags={"run_name": run_name, **{
-                k: f"{v:.6f}" for k, v in result.metrics.items()
-            }},
-        )
     return PipelineResult(
         bundle_dir=bundle_dir,
         model_uri=model_uri,
@@ -136,7 +163,6 @@ def run_tuning(
     import json
 
     from mlops_tpu.train.hpo import run_hpo
-    from mlops_tpu.train.loop import TrainResult
     from mlops_tpu.utils.jsonl import JsonlWriter
 
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S") + "-tune"
@@ -165,29 +191,24 @@ def run_tuning(
         )
     )
 
-    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
-    bundle_dir = run_dir / "bundle"
-    save_bundle(
-        bundle_dir,
-        config.model,
+    bundle_dir, model_uri = _package_and_register(
+        config,
+        run_dir,
         hpo_result.best_params,
         preprocessor,
-        monitor,
+        train_ds,
         metrics=hpo_result.best_metrics,
-        tags={
+        bundle_tags={
             "run_name": run_name,
             "best_trial": str(hpo_result.best_index),
             **{k: f"{v:.6g}" for k, v in hpo_result.best_hyperparams.items()},
         },
+        registry_tags={
+            "run_name": run_name,
+            "best_trial": str(hpo_result.best_index),
+        },
+        register=register,
     )
-    model_uri = None
-    if register:
-        registry = ModelRegistry(config.registry.root)
-        model_uri = registry.register(
-            config.registry.model_name,
-            bundle_dir,
-            tags={"run_name": run_name, "best_trial": str(hpo_result.best_index)},
-        )
     result = PipelineResult(
         bundle_dir=bundle_dir,
         model_uri=model_uri,

@@ -91,3 +91,45 @@ def test_run_tuning_packages_best(tmp_path):
     engine = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1,))
     out = engine.predict_records([{}])
     assert 0.0 <= out["predictions"][0] <= 1.0
+
+
+def test_run_hpo_pads_trials_to_mesh_multiple(splits):
+    """10 trials on an 8-device mesh: sharding engages via padding and the
+    result still reports exactly 10 trials."""
+    train_ds, valid_ds = splits
+    result = run_hpo(
+        ModelConfig(family="linear"),
+        TrainConfig(batch_size=256),
+        HPOConfig(trials=10, steps=30, seed=4),
+        train_ds,
+        valid_ds,
+        mesh=make_mesh(8, model_parallel=1),
+    )
+    assert len(result.trials) == 10
+    assert 0 <= result.best_index < 10
+    assert np.isfinite(result.best_metrics["validation_roc_auc_score"])
+
+
+def test_run_hpo_never_selects_nan_trial(splits, monkeypatch):
+    """A diverged (NaN-metric) trial must not win selection."""
+    import mlops_tpu.train.hpo as hpo_mod
+
+    real = hpo_mod.sample_hyperparams
+
+    def poisoned(config):
+        hp = real(config)
+        hp["learning_rate"] = hp["learning_rate"].copy()
+        hp["learning_rate"][0] = 1e6  # guaranteed divergence
+        return hp
+
+    monkeypatch.setattr(hpo_mod, "sample_hyperparams", poisoned)
+    train_ds, valid_ds = splits
+    result = run_hpo(
+        ModelConfig(family="linear"),
+        TrainConfig(batch_size=256),
+        HPOConfig(trials=3, steps=40, seed=5),
+        train_ds,
+        valid_ds,
+    )
+    assert result.best_index != 0
+    assert np.isfinite(result.best_metrics["validation_roc_auc_score"])
