@@ -19,19 +19,31 @@ from mlops_tpu.version import __version__
 
 MANIFEST_NAME = "manifest.json"
 PARAMS_NAME = "params.msgpack"
+ESTIMATOR_NAME = "estimator.joblib"
 PREPROCESS_NAME = "preprocess.npz"
 MONITOR_NAME = "monitor.npz"
 
 
 @dataclasses.dataclass
 class Bundle:
-    """A loaded bundle: rebuilt model + fitted state, ready to serve."""
+    """A loaded bundle: rebuilt model + fitted state, ready to serve.
+
+    Two flavors behind one interface (manifest ``flavor``):
+    ``flax`` carries a params pytree for a zoo module; ``sklearn`` carries
+    the CPU tree-ensemble floor (BASELINE config 1) — the reference ships
+    only the sklearn kind (`02-register-model.ipynb:305-353`).
+    """
 
     manifest: dict[str, Any]
-    model: Any  # nn.Module
+    model: Any  # nn.Module (flax flavor) | None
     variables: dict[str, Any]
     preprocessor: Preprocessor
     monitor: MonitorState
+    estimator: Any = None  # SklearnBaseline (sklearn flavor) | None
+
+    @property
+    def flavor(self) -> str:
+        return self.manifest.get("flavor", "flax")
 
     @property
     def model_config(self) -> ModelConfig:
@@ -61,10 +73,14 @@ def save_bundle(
     notebook->notebook ``taskValues`` handoff + conda-env synthesis
     (`02-register-model.ipynb` cells 7, 11; SURVEY.md SS3.2).
     """
+    from mlops_tpu.models.gbm import SKLEARN_FAMILIES
+
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
+    flavor = "sklearn" if model_config.family in SKLEARN_FAMILIES else "flax"
     manifest = {
         "format_version": 1,
+        "flavor": flavor,
         "framework": {"mlops_tpu": __version__, "jax": jax.__version__},
         "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "schema_fingerprint": SCHEMA.fingerprint(),
@@ -72,7 +88,10 @@ def save_bundle(
         "metrics": metrics or {},
         "tags": tags or {},
     }
-    (directory / PARAMS_NAME).write_bytes(tree_bytes(params))
+    if flavor == "sklearn":
+        params.save(directory / ESTIMATOR_NAME)  # a SklearnBaseline
+    else:
+        (directory / PARAMS_NAME).write_bytes(tree_bytes(params))
     preprocessor.save(directory / PREPROCESS_NAME)
     monitor.save(directory / MONITOR_NAME)
     (directory / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
@@ -98,15 +117,36 @@ def load_bundle(directory: str | Path) -> Bundle:
             f"{SCHEMA.fingerprint()}"
         )
     model_config = _model_config_from_manifest(manifest)
+    preprocessor = Preprocessor.load(directory / PREPROCESS_NAME)
+    monitor = MonitorState.load(directory / MONITOR_NAME)
+    if manifest.get("flavor", "flax") == "sklearn":
+        from mlops_tpu.models.gbm import SklearnBaseline
+
+        return Bundle(
+            manifest=manifest,
+            model=None,
+            variables={},
+            preprocessor=preprocessor,
+            monitor=monitor,
+            estimator=SklearnBaseline.load(directory / ESTIMATOR_NAME),
+        )
     model = build_model(model_config)
     template = init_params(model, jax.random.PRNGKey(0))
-    params = restore_tree(
-        template["params"], (directory / PARAMS_NAME).read_bytes()
-    )
+    try:
+        params = restore_tree(
+            template["params"], (directory / PARAMS_NAME).read_bytes()
+        )
+    except ValueError as err:
+        raise ValueError(
+            f"bundle {directory} holds a param tree that no longer matches "
+            f"the {model_config.family!r} module this framework version "
+            "builds — re-train/re-register the model with the current "
+            "framework"
+        ) from err
     return Bundle(
         manifest=manifest,
         model=model,
         variables={"params": params},
-        preprocessor=Preprocessor.load(directory / PREPROCESS_NAME),
-        monitor=MonitorState.load(directory / MONITOR_NAME),
+        preprocessor=preprocessor,
+        monitor=monitor,
     )

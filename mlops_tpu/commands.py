@@ -105,13 +105,11 @@ def _register(config) -> int:
 
 
 def _predict_file(config) -> int:
-    """Batch-score a schema CSV offline with the full fused predict."""
-    import numpy as np
-
+    """Batch-score a schema CSV offline with the full fused predict (works
+    for both bundle flavors — flax on device, sklearn floor on host)."""
     from mlops_tpu.bundle import ModelRegistry, load_bundle
     from mlops_tpu.data import load_csv_columns
-    from mlops_tpu.ops.predict import make_predict_fn
-    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve import InferenceEngine
 
     source = config.data.train_path
     if not source:
@@ -122,22 +120,23 @@ def _predict_file(config) -> int:
         if not _looks_like_dir(config.serve.model_directory)
         else config.serve.model_directory
     )
-    predict = make_predict_fn(bundle.model, bundle.variables, bundle.monitor)
+    engine = InferenceEngine(bundle, buckets=(config.serve.max_batch,))
     columns, _ = load_csv_columns(source)
     ds = bundle.preprocessor.encode(columns)
-    out = predict(ds.cat_ids, ds.numeric)
-    record = {
-        "predictions": np.asarray(out["predictions"]).tolist(),
-        "outliers": np.asarray(out["outliers"]).tolist(),
-        "feature_drift_batch": dict(
-            zip(
-                SCHEMA.feature_names,
-                np.asarray(out["feature_drift_batch"]).round(6).tolist(),
-            )
-        ),
-    }
-    print(json.dumps(record))
+    print(json.dumps(engine.predict_arrays(ds.cat_ids, ds.numeric)))
     return 0
+
+
+def _bench(config) -> int:
+    """Run the repo-root inference benchmark (the driver's headline number)."""
+    import runpy
+    from pathlib import Path
+
+    for candidate in (Path.cwd() / "bench.py", Path(__file__).parents[1] / "bench.py"):
+        if candidate.is_file():
+            runpy.run_path(str(candidate), run_name="__main__")
+            return 0
+    raise SystemExit("bench.py not found (run from the repo root)")
 
 
 def _looks_like_dir(value: str) -> bool:
@@ -186,5 +185,6 @@ _HANDLERS = {
     "tune": _tune,
     "register": _register,
     "predict-file": _predict_file,
+    "bench": _bench,
     "serve": _serve,
 }

@@ -28,7 +28,7 @@ class DataConfig:
 
 @dataclasses.dataclass
 class ModelConfig:
-    family: str = "mlp"  # mlp | ft_transformer | linear
+    family: str = "mlp"  # mlp | ft_transformer | linear | gbm | rf
     hidden_dims: tuple[int, ...] = (256, 256, 128)
     embed_dim: int = 16
     dropout: float = 0.1
@@ -37,6 +37,10 @@ class ModelConfig:
     depth: int = 3
     heads: int = 8
     token_dim: int = 64
+    # CPU tree-baseline specifics (families gbm/rf — BASELINE config 1;
+    # bounds mirror the reference's hyperopt space, `01-train-model.ipynb:342-353`)
+    n_estimators: int = 300
+    max_tree_depth: int = 8
 
 
 @dataclasses.dataclass

@@ -51,6 +51,14 @@ def build_model(config: ModelConfig) -> nn.Module:
             dropout=config.dropout,
             dtype=dtype,
         )
+    from mlops_tpu.models.gbm import SKLEARN_FAMILIES
+
+    if config.family in SKLEARN_FAMILIES:
+        raise ValueError(
+            f"family {config.family!r} is the CPU sklearn baseline (BASELINE "
+            "config 1) — it has no Flax module; train it via `run_training` / "
+            "the `train` CLI, which packages it as a sklearn-flavor bundle"
+        )
     raise ValueError(f"unknown model family {config.family!r}; one of {FAMILIES}")
 
 

@@ -1,0 +1,137 @@
+"""CPU tree-ensemble baseline — BASELINE.json config 1, the comparison floor.
+
+The reference's production model is an sklearn ``RandomForestClassifier``
+behind a ``ColumnTransformer`` (`01-train-model.ipynb:195-227`). Tree
+ensembles don't map onto the MXU, so they are NOT the TPU path — they are the
+shipped CPU fallback and the quality floor every Flax family is measured
+against (SURVEY.md §7 "hard parts": RF is a strong tabular baseline).
+
+Two families, both servable through the exact same bundle + engine interface
+as the Flax models (flavor="sklearn" in the bundle manifest):
+
+- ``gbm`` — ``HistGradientBoostingClassifier`` with native categorical
+  support (the stronger, faster floor; BASELINE config 1 names gradient
+  boosting).
+- ``rf``  — ``RandomForestClassifier``, the reference's stock family, for
+  exact parity comparisons (n_estimators/max_depth match the reference's
+  hyperopt search space, `01-train-model.ipynb:342-353`).
+
+Input convention matches the Flax zoo: ``(cat_ids[int32 N,C],
+numeric[f32 N,M])`` from the shared ``Preprocessor`` — integer category ids
+are consumed natively by HistGBM (``categorical_features``) and ordinally by
+RF (the reference one-hots instead; ordinal trees split the same partitions
+at equal depth).
+"""
+
+from __future__ import annotations
+
+import io
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.config import ModelConfig, TrainConfig
+from mlops_tpu.data.encode import EncodedDataset
+from mlops_tpu.schema.features import SCHEMA
+
+SKLEARN_FAMILIES = ("gbm", "rf")
+
+
+class SklearnBaseline:
+    """Fitted tree-ensemble wrapper with the zoo's predict convention."""
+
+    def __init__(self, estimator: Any, family: str):
+        self.estimator = estimator
+        self.family = family
+
+    # ------------------------------------------------------------------ fit
+    @classmethod
+    def train(
+        cls,
+        model_config: ModelConfig,
+        train_config: TrainConfig,
+        train_ds: EncodedDataset,
+    ) -> "SklearnBaseline":
+        X = _design_matrix(train_ds)
+        y = np.asarray(train_ds.labels)
+        family = model_config.family
+        if family == "gbm":
+            from sklearn.ensemble import HistGradientBoostingClassifier
+
+            est = HistGradientBoostingClassifier(
+                max_iter=model_config.n_estimators,
+                max_depth=model_config.max_tree_depth or None,
+                categorical_features=list(range(SCHEMA.num_categorical)),
+                random_state=train_config.seed,
+            )
+        elif family == "rf":
+            from sklearn.ensemble import RandomForestClassifier
+
+            est = RandomForestClassifier(
+                n_estimators=model_config.n_estimators,
+                max_depth=model_config.max_tree_depth or None,
+                n_jobs=-1,
+                random_state=train_config.seed,
+            )
+        else:
+            raise ValueError(
+                f"unknown sklearn family {family!r}; one of {SKLEARN_FAMILIES}"
+            )
+        est.fit(X, y)
+        return cls(est, family)
+
+    # -------------------------------------------------------------- predict
+    def predict_proba(
+        self, cat_ids: np.ndarray, numeric: np.ndarray
+    ) -> np.ndarray:
+        """P(default) per row — same contract as sigmoid(logits) in the zoo."""
+        X = _design_matrix_arrays(cat_ids, numeric)
+        return self.estimator.predict_proba(X)[:, 1].astype(np.float32)
+
+    def evaluate(self, ds: EncodedDataset) -> dict[str, float]:
+        """Reference-named validation metrics (`01-train-model.ipynb:296-304`)."""
+        import jax.numpy as jnp
+
+        from mlops_tpu.train.metrics import binary_metrics
+
+        probs = self.predict_proba(ds.cat_ids, ds.numeric)
+        # binary_metrics takes raw logits; invert the sigmoid on clipped probs.
+        p = np.clip(probs, 1e-7, 1.0 - 1e-7)
+        logits = jnp.asarray(np.log(p / (1.0 - p)))
+        metrics = binary_metrics(logits, jnp.asarray(ds.labels))
+        return {f"validation_{k}_score": float(v) for k, v in metrics.items()}
+
+    # ------------------------------------------------------------ serialize
+    def to_bytes(self) -> bytes:
+        import joblib
+
+        buf = io.BytesIO()
+        joblib.dump({"family": self.family, "estimator": self.estimator}, buf)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SklearnBaseline":
+        import joblib
+
+        payload = joblib.load(io.BytesIO(data))
+        return cls(payload["estimator"], payload["family"])
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_bytes(self.to_bytes())
+
+    @classmethod
+    def load(cls, path: str | Path) -> "SklearnBaseline":
+        return cls.from_bytes(Path(path).read_bytes())
+
+
+def _design_matrix_arrays(cat_ids: np.ndarray, numeric: np.ndarray) -> np.ndarray:
+    """[cat_ids | numeric] as float64 — one matrix layout, fit AND predict."""
+    return np.concatenate(
+        [np.asarray(cat_ids, np.float64), np.asarray(numeric, np.float64)],
+        axis=1,
+    )
+
+
+def _design_matrix(ds: EncodedDataset) -> np.ndarray:
+    return _design_matrix_arrays(ds.cat_ids, ds.numeric)

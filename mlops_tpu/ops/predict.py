@@ -60,3 +60,38 @@ def make_padded_predict_fn(
         }
 
     return predict
+
+
+def make_hybrid_predict_fn(
+    estimator, monitor: MonitorState
+) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, Any]]:
+    """Fused predict for the sklearn-flavor bundle (BASELINE config 1 floor).
+
+    The tree ensemble scores on host CPU (trees don't map to the MXU) while
+    the drift + outlier monitors stay one jitted device computation — same
+    response contract and padding/mask semantics as the Flax path, so the
+    engine serves both flavors identically.
+    """
+
+    @jax.jit
+    def monitors(cat_ids: jnp.ndarray, numeric: jnp.ndarray, mask: jnp.ndarray):
+        return {
+            "outliers": outlier_flags(monitor, numeric, mask),
+            "feature_drift_batch": drift_scores(monitor, cat_ids, numeric, mask),
+        }
+
+    def predict(cat_ids, numeric, mask):
+        import numpy as np
+
+        out = dict(monitors(cat_ids, numeric, mask))
+        # Score only valid rows on the host (padding would waste tree
+        # inference); scatter back so the output length matches the bucket.
+        valid = np.asarray(mask)
+        probs = np.zeros(valid.shape[0], np.float32)
+        probs[valid] = estimator.predict_proba(
+            np.asarray(cat_ids)[valid], np.asarray(numeric)[valid]
+        )
+        out["predictions"] = probs
+        return out
+
+    return predict

@@ -21,7 +21,7 @@ import jax
 import numpy as np
 
 from mlops_tpu.bundle.bundle import Bundle
-from mlops_tpu.ops.predict import make_padded_predict_fn
+from mlops_tpu.ops.predict import make_hybrid_predict_fn, make_padded_predict_fn
 from mlops_tpu.schema import SCHEMA, records_to_columns
 
 
@@ -36,9 +36,15 @@ class InferenceEngine:
         self.buckets = sorted(buckets)
         self.max_bucket = self.buckets[-1]
         self.service_name = service_name
-        self._predict = make_padded_predict_fn(
-            bundle.model, bundle.variables, bundle.monitor
-        )
+        if bundle.flavor == "sklearn":
+            # CPU tree-ensemble floor: host classifier + device monitors.
+            self._predict = make_hybrid_predict_fn(
+                bundle.estimator, bundle.monitor
+            )
+        else:
+            self._predict = make_padded_predict_fn(
+                bundle.model, bundle.variables, bundle.monitor
+            )
         self.ready = False
 
     # ------------------------------------------------------------- warmup

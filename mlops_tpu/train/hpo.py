@@ -80,9 +80,9 @@ def run_hpo(
     axis = mesh.devices.shape[0] if mesh is not None else 1
     t_run = ((t + axis - 1) // axis) * axis
     if t_run != t:
-        hp_run = {
-            k: np.concatenate([v, v[: t_run - t]]) for k, v in hp.items()
-        }
+        # np.resize cycles the leading trials, so this is correct even when
+        # the pad amount exceeds the trial count (e.g. 3 trials on 8 chips).
+        hp_run = {k: np.resize(v, t_run) for k, v in hp.items()}
     else:
         hp_run = hp
     lrs = jnp.asarray(hp_run["learning_rate"], jnp.float32)

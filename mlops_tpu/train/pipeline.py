@@ -24,6 +24,7 @@ from mlops_tpu.data import (
     load_csv_columns,
 )
 from mlops_tpu.models import build_model
+from mlops_tpu.models.gbm import SKLEARN_FAMILIES, SklearnBaseline
 from mlops_tpu.monitor import fit_monitor
 from mlops_tpu.train.loop import TrainResult, fit
 
@@ -115,15 +116,26 @@ def run_training(
     ds = preprocessor.encode(columns, labels)
     train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
 
-    model = build_model(config.model)
-    result = fit(
-        model,
-        train_ds,
-        valid_ds,
-        config.train,
-        metrics_path=run_dir / "metrics.jsonl",
-        checkpoint_dir=run_dir / "checkpoints",
-    )
+    if config.model.family in SKLEARN_FAMILIES:
+        # BASELINE config 1: the CPU tree-ensemble comparison floor, trained
+        # and packaged through the exact same pipeline tail as the TPU models.
+        baseline = SklearnBaseline.train(config.model, config.train, train_ds)
+        result = TrainResult(
+            params=baseline,
+            metrics=baseline.evaluate(valid_ds),
+            history=[],
+            steps=config.model.n_estimators,
+        )
+    else:
+        model = build_model(config.model)
+        result = fit(
+            model,
+            train_ds,
+            valid_ds,
+            config.train,
+            metrics_path=run_dir / "metrics.jsonl",
+            checkpoint_dir=run_dir / "checkpoints",
+        )
 
     bundle_dir, model_uri = _package_and_register(
         config,
@@ -164,6 +176,12 @@ def run_tuning(
 
     from mlops_tpu.train.hpo import run_hpo
     from mlops_tpu.utils.jsonl import JsonlWriter
+
+    if config.model.family in SKLEARN_FAMILIES:
+        raise ValueError(
+            "sklearn baseline families (gbm/rf) train via `train`; the "
+            "vmapped/sharded `tune` sweep applies to the Flax families only"
+        )
 
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S") + "-tune"
     run_dir = Path(config.registry.run_root) / run_name

@@ -110,6 +110,31 @@ def test_run_hpo_pads_trials_to_mesh_multiple(splits):
     assert np.isfinite(result.best_metrics["validation_roc_auc_score"])
 
 
+def test_run_hpo_fewer_trials_than_devices(splits):
+    """3 trials on an 8-device mesh: the pad amount (5) exceeds the trial
+    count, which must cycle trials rather than under-pad and crash."""
+    train_ds, valid_ds = splits
+    result = run_hpo(
+        ModelConfig(family="linear"),
+        TrainConfig(batch_size=256),
+        HPOConfig(trials=3, steps=30, seed=4),
+        train_ds,
+        valid_ds,
+        mesh=make_mesh(8, model_parallel=1),
+    )
+    assert len(result.trials) == 3
+    assert 0 <= result.best_index < 3
+
+
+def test_sklearn_families_rejected_by_tune(splits):
+    from mlops_tpu.config import Config
+
+    config = Config()
+    config.model.family = "gbm"
+    with pytest.raises(ValueError, match="gbm"):
+        run_tuning(config, register=False)
+
+
 def test_run_hpo_never_selects_nan_trial(splits, monkeypatch):
     """A diverged (NaN-metric) trial must not win selection."""
     import mlops_tpu.train.hpo as hpo_mod
