@@ -30,6 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("serve", "serve a bundle over HTTP"),
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),
+        ("score-batch", "bulk-score 1M-scale rows data-parallel over the mesh"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument(

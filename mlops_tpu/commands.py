@@ -127,6 +127,57 @@ def _predict_file(config) -> int:
     return 0
 
 
+def _score_batch(config) -> int:
+    """Bulk-score a large dataset data-parallel over every chip (BASELINE
+    config 4). Input: ``data.train_path=<csv>`` or synthetic ``data.rows``."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.bundle import ModelRegistry, load_bundle
+    from mlops_tpu.data import generate_synthetic, load_csv_columns
+    from mlops_tpu.parallel import make_mesh
+    from mlops_tpu.parallel.bulk import score_dataset
+
+    bundle = load_bundle(
+        config.serve.model_directory
+        if _looks_like_dir(config.serve.model_directory)
+        else ModelRegistry(config.registry.root).resolve(
+            config.registry.model_name, config.serve.model_directory
+        )
+    )
+    if config.data.train_path:
+        columns, _ = load_csv_columns(config.data.train_path)
+    else:
+        columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
+    ds = bundle.preprocessor.encode(columns)
+
+    mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
+    result = score_dataset(
+        bundle,
+        ds,
+        mesh=mesh,
+        chunk_rows=config.score.chunk_rows,
+        drift_sample=config.score.drift_sample,
+        seed=config.data.seed,
+    )
+    if config.score.output_path:
+        np.savez(
+            config.score.output_path,
+            predictions=result.predictions,
+            outliers=result.outliers,
+        )
+    print(
+        json.dumps(
+            {
+                "devices": jax.device_count(),
+                "mesh": list(mesh.devices.shape) if mesh is not None else [1],
+                **result.summary(),
+            }
+        )
+    )
+    return 0
+
+
 def _bench(config) -> int:
     """Run the repo-root inference benchmark (the driver's headline number)."""
     import runpy
@@ -185,6 +236,7 @@ _HANDLERS = {
     "tune": _tune,
     "register": _register,
     "predict-file": _predict_file,
+    "score-batch": _score_batch,
     "bench": _bench,
     "serve": _serve,
 }

@@ -98,6 +98,15 @@ class RegistryConfig:
 
 
 @dataclasses.dataclass
+class ScoreConfig:
+    """Bulk scoring (BASELINE config 4: 1M rows over the data mesh)."""
+
+    chunk_rows: int = 131_072  # rows per compiled chunk (rounded to mesh axis)
+    drift_sample: int = 65_536  # bounded sample for dataset-level drift
+    output_path: str = ""  # optional .npz with predictions/outliers
+
+
+@dataclasses.dataclass
 class MeshConfig:
     data_axis: int = 0  # 0 -> use all devices on the data axis
     model_axis: int = 1
@@ -112,6 +121,7 @@ class Config:
     monitor: MonitorConfig = dataclasses.field(default_factory=MonitorConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     registry: RegistryConfig = dataclasses.field(default_factory=RegistryConfig)
+    score: ScoreConfig = dataclasses.field(default_factory=ScoreConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
 
