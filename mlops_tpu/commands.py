@@ -107,22 +107,16 @@ def _register(config) -> int:
 def _predict_file(config) -> int:
     """Batch-score a schema CSV offline with the full fused predict (works
     for both bundle flavors — flax on device, sklearn floor on host)."""
-    from mlops_tpu.bundle import ModelRegistry, load_bundle
-    from mlops_tpu.data import load_csv_columns
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.native import encode_csv
     from mlops_tpu.serve import InferenceEngine
 
     source = config.data.train_path
     if not source:
         raise SystemExit("pass the input csv via data.train_path=<csv>")
-    registry = ModelRegistry(config.registry.root)
-    bundle = load_bundle(
-        registry.resolve(config.registry.model_name, config.serve.model_directory)
-        if not _looks_like_dir(config.serve.model_directory)
-        else config.serve.model_directory
-    )
+    bundle = load_bundle(_resolve_bundle(config))
     engine = InferenceEngine(bundle, buckets=(config.serve.max_batch,))
-    columns, _ = load_csv_columns(source)
-    ds = bundle.preprocessor.encode(columns)
+    ds = encode_csv(source, bundle.preprocessor)
     print(json.dumps(engine.predict_arrays(ds.cat_ids, ds.numeric)))
     return 0
 
@@ -133,23 +127,20 @@ def _score_batch(config) -> int:
     import jax
     import numpy as np
 
-    from mlops_tpu.bundle import ModelRegistry, load_bundle
-    from mlops_tpu.data import generate_synthetic, load_csv_columns
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.data import generate_synthetic
+    from mlops_tpu.native import encode_csv
     from mlops_tpu.parallel import make_mesh
     from mlops_tpu.parallel.bulk import score_dataset
 
-    bundle = load_bundle(
-        config.serve.model_directory
-        if _looks_like_dir(config.serve.model_directory)
-        else ModelRegistry(config.registry.root).resolve(
-            config.registry.model_name, config.serve.model_directory
-        )
-    )
+    bundle = load_bundle(_resolve_bundle(config))
     if config.data.train_path:
-        columns, _ = load_csv_columns(config.data.train_path)
+        # Native one-pass parse+encode when built (the 1M-row hot path);
+        # transparent Python fallback otherwise.
+        ds = encode_csv(config.data.train_path, bundle.preprocessor)
     else:
         columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
-    ds = bundle.preprocessor.encode(columns)
+        ds = bundle.preprocessor.encode(columns)
 
     mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
     result = score_dataset(
@@ -196,6 +187,20 @@ def _looks_like_dir(value: str) -> bool:
     return Path(value).is_dir()
 
 
+def _resolve_bundle(config, model_dir: str | None = None) -> str:
+    """One rule for every command: a value that is an existing directory is
+    the bundle itself; anything else (version number, stage, "latest")
+    resolves through the registry."""
+    from mlops_tpu.bundle import ModelRegistry
+
+    model_dir = model_dir or config.serve.model_directory
+    if _looks_like_dir(model_dir):
+        return model_dir
+    return ModelRegistry(config.registry.root).resolve(
+        config.registry.model_name, model_dir
+    )
+
+
 def _serve(config) -> int:
     """Serve a bundle over HTTP.
 
@@ -206,7 +211,7 @@ def _serve(config) -> int:
     import logging
     import os
 
-    from mlops_tpu.bundle import ModelRegistry, load_bundle
+    from mlops_tpu.bundle import load_bundle
     from mlops_tpu.serve import InferenceEngine, serve_forever
 
     logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -214,13 +219,7 @@ def _serve(config) -> int:
     config.serve.service_name = os.environ.get(
         "SERVICE_NAME", config.serve.service_name
     )
-    if _looks_like_dir(model_dir):
-        bundle_path = model_dir
-    else:
-        bundle_path = ModelRegistry(config.registry.root).resolve(
-            config.registry.model_name, model_dir
-        )
-    bundle = load_bundle(bundle_path)
+    bundle = load_bundle(_resolve_bundle(config, model_dir))
     engine = InferenceEngine(
         bundle,
         buckets=tuple(config.serve.warmup_batch_sizes),

@@ -28,7 +28,7 @@ class DataConfig:
 
 @dataclasses.dataclass
 class ModelConfig:
-    family: str = "mlp"  # mlp | ft_transformer | linear | gbm | rf
+    family: str = "mlp"  # mlp | ft_transformer | linear | bert | gbm | rf
     hidden_dims: tuple[int, ...] = (256, 256, 128)
     embed_dim: int = 16
     dropout: float = 0.1

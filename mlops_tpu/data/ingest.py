@@ -28,7 +28,10 @@ def load_csv_columns(
     with path.open(newline="") as f:
         reader = csv.reader(f)
         header = next(reader)
-        rows = list(reader)
+        # Malformed-row semantics are pinned to the native kernel's
+        # (`native/encoder.cpp`, parity-tested): blank lines are skipped,
+        # short rows read missing cells as empty (-> OOV / median).
+        rows = [row for row in reader if row and row != [""]]
 
     col_index = {name: i for i, name in enumerate(header)}
     missing = [n for n in schema.feature_names if n not in col_index]
@@ -37,21 +40,29 @@ def load_csv_columns(
     if require_target and schema.target not in col_index:
         raise ValueError(f"{path}: missing target column {schema.target!r}")
 
+    def cell(row: list, i: int) -> str:
+        return row[i] if i < len(row) else ""
+
+    def to_float(raw: str) -> float:
+        try:
+            return float(raw)
+        except ValueError:
+            return float("nan")
+
     columns: dict[str, list] = {}
     for feat in schema.categorical:
         i = col_index[feat.name]
-        columns[feat.name] = [row[i] for row in rows]
+        columns[feat.name] = [cell(row, i) for row in rows]
     for feat in schema.numeric:
         i = col_index[feat.name]
-        columns[feat.name] = [
-            float(row[i]) if row[i] not in ("", "null", "NaN") else float("nan")
-            for row in rows
-        ]
+        columns[feat.name] = [to_float(cell(row, i)) for row in rows]
 
     labels = None
     if schema.target in col_index:
         i = col_index[schema.target]
-        labels = np.asarray([int(float(row[i])) for row in rows], dtype=np.int8)
+        raw = np.asarray([to_float(cell(row, i)) for row in rows])
+        # Unparseable labels coerce to 0 (never NaN into the loss).
+        labels = np.where(np.isfinite(raw), raw, 0.0).astype(np.int8)
     return columns, labels
 
 

@@ -8,6 +8,8 @@ the TPU-native zoo is:
 - ``linear``          embedding-sum logistic regression (fast floor)
 - ``mlp``             embeddings + residual MLP (flagship for serving)
 - ``ft_transformer``  feature-tokenized transformer (BASELINE.json config 3)
+- ``bert``            tabular-as-text BERT encoder with jit-fused
+  tokenization (BASELINE.json config 5, the stretch)
 
 All families share one calling convention:
 ``model.apply(vars, cat_ids[int32 N,C], numeric[f32 N,M], train=...) ->
@@ -21,11 +23,12 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from mlops_tpu.config import ModelConfig
+from mlops_tpu.models.bert import BertEncoder
 from mlops_tpu.models.ft_transformer import FTTransformer
 from mlops_tpu.models.mlp import MLP, LinearModel
 from mlops_tpu.schema.features import SCHEMA
 
-FAMILIES = ("linear", "mlp", "ft_transformer")
+FAMILIES = ("linear", "mlp", "ft_transformer", "bert")
 
 
 def build_model(config: ModelConfig) -> nn.Module:
@@ -51,6 +54,16 @@ def build_model(config: ModelConfig) -> nn.Module:
             dropout=config.dropout,
             dtype=dtype,
         )
+    if config.family == "bert":
+        return BertEncoder(
+            cards=SCHEMA.cards,
+            num_numeric=SCHEMA.num_numeric,
+            hidden=config.token_dim,
+            depth=config.depth,
+            heads=config.heads,
+            dropout=config.dropout,
+            dtype=dtype,
+        )
     from mlops_tpu.models.gbm import SKLEARN_FAMILIES
 
     if config.family in SKLEARN_FAMILIES:
@@ -69,4 +82,12 @@ def init_params(model: nn.Module, rng: jax.Array, batch: int = 2):
     return model.init({"params": rng}, cat, num, train=False)
 
 
-__all__ = ["FAMILIES", "FTTransformer", "LinearModel", "MLP", "build_model", "init_params"]
+__all__ = [
+    "FAMILIES",
+    "BertEncoder",
+    "FTTransformer",
+    "LinearModel",
+    "MLP",
+    "build_model",
+    "init_params",
+]

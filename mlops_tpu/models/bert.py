@@ -1,0 +1,202 @@
+"""BERT-style tabular-as-text encoder (BASELINE.json config 5, the stretch).
+
+The reference never goes near language models; this family exists because
+the rebuild's baseline contract lists "BERT-base tabular-as-text fine-tune
+(full TPU training loop, data-parallel on v5e-8)" as its stretch config.
+Design is TPU-first rather than a port of any HF pipeline:
+
+- **Tokenization is part of the jitted forward pass.** A record renders as
+  the token sequence ``[CLS] name_1 value_1 ... name_23 value_23 [SEP]``
+  (48 tokens, static shape). Categorical values map to per-feature vocab
+  tokens by integer offset; numeric values (already standardized by the
+  data pipeline) land in per-feature quantile-bin tokens via
+  ``searchsorted`` over fixed standard-normal bin edges. No strings, no
+  host-side tokenizer, no dynamic shapes — the "text" rendering is pure
+  int32 arithmetic fused into the same XLA program as the encoder.
+- **Same calling convention as every other family**
+  (``apply(vars, cat_ids, numeric, train) -> logits[N]``), so the trainer,
+  vmapped HPO, sharded train step, bundle format, and serving engine all
+  work on BERT unchanged.
+- Encoder blocks are the shared pre-LN ``TransformerBlock`` (GELU FFN at
+  4x hidden, attention through ``ops.attention.attend`` which dispatches to
+  the Pallas flash kernel at long sequence). Blocks are named ``block_i``
+  and projections follow the zoo's naming, so the Megatron-style
+  ``PARAM_RULES`` tensor-parallel layouts apply to BERT with zero new
+  rules; DP x TP runs through ``parallel.make_sharded_train_step`` as-is.
+- For sequence lengths beyond one record (multi-record documents), the
+  sequence-parallel path is ``parallel.ring_attention`` — same online
+  softmax, sharded over the 'seq' mesh axis.
+
+``BERT_BASE`` is the true-scale preset (hidden 768, 12 layers, 12 heads,
+FFN 3072, ~86M params + vocab). Tests and HPO use scaled-down instances.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from statistics import NormalDist
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from mlops_tpu.models.ft_transformer import TransformerBlock
+
+PAD_ID, CLS_ID, SEP_ID, MASK_ID = 0, 1, 2, 3
+_SPECIAL = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenLayout:
+    """Static vocabulary layout derived from the feature schema.
+
+    Token id space: ``[PAD][CLS][SEP][MASK]`` | one name token per feature |
+    per-categorical-feature value blocks (card each, OOV included) |
+    per-numeric-feature bin blocks (num_bins each).
+    """
+
+    cards: tuple[int, ...]
+    num_numeric: int
+    num_bins: int
+
+    @property
+    def num_features(self) -> int:
+        return len(self.cards) + self.num_numeric
+
+    @property
+    def name_offset(self) -> int:
+        return _SPECIAL
+
+    @property
+    def cat_offsets(self) -> tuple[int, ...]:
+        base = _SPECIAL + self.num_features
+        offsets = []
+        for card in self.cards:
+            offsets.append(base)
+            base += card
+        return tuple(offsets)
+
+    @property
+    def bin_offsets(self) -> tuple[int, ...]:
+        base = _SPECIAL + self.num_features + sum(self.cards)
+        return tuple(
+            base + j * self.num_bins for j in range(self.num_numeric)
+        )
+
+    @property
+    def vocab_size(self) -> int:
+        return (
+            _SPECIAL
+            + self.num_features
+            + sum(self.cards)
+            + self.num_numeric * self.num_bins
+        )
+
+    @property
+    def seq_len(self) -> int:
+        # [CLS] + (name, value) per feature + [SEP]
+        return 2 + 2 * self.num_features
+
+    def bin_edges(self) -> np.ndarray:
+        """Interior standard-normal quantile edges (num_bins - 1 of them).
+
+        Numeric features arrive standardized (mean 0 / std 1 under the
+        train distribution), so fixed N(0,1) quantiles give near-uniform
+        bin occupancy without any data-dependent state in the model.
+        """
+        nd = NormalDist()
+        qs = [i / self.num_bins for i in range(1, self.num_bins)]
+        return np.asarray([nd.inv_cdf(q) for q in qs], np.float32)
+
+
+def tokenize(
+    cat_ids: jnp.ndarray, numeric: jnp.ndarray, layout: TokenLayout
+) -> jnp.ndarray:
+    """Render records as token ids: (int32[N,C], f32[N,M]) -> int32[N,S].
+
+    Pure jnp integer math — traces into the encoder's XLA program.
+    """
+    n = cat_ids.shape[0]
+    f = layout.num_features
+
+    names = jnp.arange(
+        layout.name_offset, layout.name_offset + f, dtype=jnp.int32
+    )
+    cat_tok = jnp.asarray(layout.cat_offsets, jnp.int32)[None, :] + cat_ids
+    bins = jnp.searchsorted(
+        jnp.asarray(layout.bin_edges()), numeric, side="right"
+    ).astype(jnp.int32)
+    num_tok = jnp.asarray(layout.bin_offsets, jnp.int32)[None, :] + bins
+
+    values = jnp.concatenate([cat_tok, num_tok], axis=1)  # [N, F]
+    pairs = jnp.stack(
+        [jnp.broadcast_to(names[None, :], (n, f)), values], axis=2
+    ).reshape(n, 2 * f)
+    cls = jnp.full((n, 1), CLS_ID, jnp.int32)
+    sep = jnp.full((n, 1), SEP_ID, jnp.int32)
+    return jnp.concatenate([cls, pairs, sep], axis=1)
+
+
+class BertEncoder(nn.Module):
+    """Pre-LN BERT-style encoder over the tabular token rendering.
+
+    ``apply(vars, cat_ids, numeric, train) -> logits[f32 N]`` — the zoo
+    convention (`mlops_tpu.models`), classifier head reading [CLS].
+    """
+
+    cards: Sequence[int]
+    num_numeric: int
+    hidden: int = 768
+    depth: int = 12
+    heads: int = 12
+    dropout: float = 0.1
+    num_bins: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def layout(self) -> TokenLayout:
+        return TokenLayout(tuple(self.cards), self.num_numeric, self.num_bins)
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        layout = self.layout
+        tokens = tokenize(cat_ids, numeric, layout)  # [N, S]
+
+        x = nn.Embed(
+            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (layout.seq_len, self.hidden),
+        )
+        x = x + pos.astype(self.dtype)[None]
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        for i in range(self.depth):
+            x = TransformerBlock(
+                heads=self.heads,
+                token_dim=self.hidden,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, train=train)
+
+        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x[:, 0])
+        # BERT-style tanh pooler, then the classifier head.
+        pooled = nn.tanh(
+            nn.Dense(self.hidden, dtype=self.dtype, name="pooler")(cls)
+        )
+        logit = nn.Dense(1, dtype=self.dtype, name="head")(pooled)
+        return logit[:, 0].astype(jnp.float32)
+
+
+def bert_base_config():
+    """ModelConfig preset at true BERT-base scale (v5e-8 data-parallel)."""
+    from mlops_tpu.config import ModelConfig
+
+    return ModelConfig(family="bert", token_dim=768, depth=12, heads=12)

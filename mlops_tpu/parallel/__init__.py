@@ -12,12 +12,19 @@ module is the TPU-native capability the rebuild owes instead:
   XLA insert the collectives over ICI (psum for grads, all-gathers for TP)
 - ``collectives`` explicit shard_map building blocks (psum/all_gather/
   ppermute) for paths that want manual SPMD
+- ``ring_attention`` exact sequence-parallel attention: K/V shards rotate
+  the ICI ring via ppermute with online-softmax accumulation (long-context
+  path for the BERT config; differentiable, so usable in training)
 
 Multi-host: ``jax.distributed.initialize`` + the same mesh spanning hosts —
 the DCN story is configuration, not new code (SURVEY.md SS5.8).
 """
 
-from mlops_tpu.parallel.mesh import make_mesh, mesh_shape_for
+from mlops_tpu.parallel.mesh import make_mesh, make_nd_mesh, mesh_shape_for
+from mlops_tpu.parallel.ring_attention import (
+    make_ring_attention,
+    ring_attention_shard,
+)
 from mlops_tpu.parallel.sharding import (
     PARAM_RULES,
     batch_sharding,
@@ -33,9 +40,12 @@ __all__ = [
     "PARAM_RULES",
     "batch_sharding",
     "make_mesh",
+    "make_nd_mesh",
+    "make_ring_attention",
     "make_sharded_batch_scorer",
     "make_sharded_train_step",
     "mesh_shape_for",
+    "ring_attention_shard",
     "param_shardings",
     "replicated",
 ]

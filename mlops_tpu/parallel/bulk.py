@@ -63,9 +63,11 @@ class BulkScoreResult:
         }
 
 
-def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, chunk: int):
+def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
     """One compiled program: (cat[chunk,C], num[chunk,M], mask[chunk]) ->
-    (probs, outlier_flags). Sharded over 'data' when a mesh is given."""
+    (probs, outlier_flags), fixed-shape per call site (the caller feeds
+    equal-sized chunks so a single compile serves the whole sweep).
+    Sharded over 'data' when a mesh is given."""
     monitor = bundle.monitor
 
     if bundle.flavor == "sklearn":
@@ -130,7 +132,7 @@ def score_dataset(
         )
     axis = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     chunk = max(axis, (chunk_rows // axis) * axis)
-    scorer = make_chunk_scorer(bundle, mesh, chunk)
+    scorer = make_chunk_scorer(bundle, mesh)
 
     predictions = np.empty(n, np.float32)
     outliers = np.empty(n, np.float32)

@@ -19,6 +19,29 @@ def mesh_shape_for(n_devices: int, model_parallel: int = 1) -> tuple[int, int]:
     return n_devices // model_parallel, model_parallel
 
 
+def make_nd_mesh(
+    axis_sizes: dict[str, int], devices: list | None = None
+) -> Mesh:
+    """Build a mesh with arbitrary named axes, e.g.
+    ``{'data': 2, 'seq': 4}`` for combined DP x sequence-parallel or
+    ``{'data': 2, 'seq': 2, 'model': 2}`` for 3-way hybrid layouts.
+
+    Axis order is the order of ``axis_sizes``; put the fastest-varying
+    (most-communicating) axis LAST so its neighbors are ICI-adjacent in the
+    default device enumeration.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = 1
+    for size in axis_sizes.values():
+        n *= size
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {axis_sizes} needs {n} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:n]).reshape(tuple(axis_sizes.values()))
+    return Mesh(grid, tuple(axis_sizes.keys()))
+
+
 def make_mesh(
     n_devices: int | None = None,
     model_parallel: int = 1,

@@ -1,0 +1,136 @@
+"""Ring attention: exact sequence-parallel attention over a mesh axis.
+
+The reference has no sequence workloads at all (SURVEY.md SS5.7 — 23 fixed
+tabular features), so long-context capability is a build obligation of the
+TPU rebuild, not a port. This module provides it the TPU-native way:
+
+Each device in the ring holds a ``[B, S/n, H, D]`` shard of Q, K and V.
+K/V shards rotate around the mesh axis with ``ppermute`` while every device
+folds the visiting chunk into an online-softmax accumulator for its local
+Q block. After ``n`` hops each Q position has attended over the FULL
+sequence, yet neither the complete K/V nor any ``S x S`` score matrix ever
+materializes on a single chip:
+
+- HBM per chip: O(B * S/n * H * D) activations + one transient
+  ``[B, H, S/n, S/n]`` score tile per hop.
+- Comms: ``n-1`` neighbor hops of the K/V shard riding the ICI ring
+  (``ppermute`` with the +1 cyclic permutation); XLA overlaps the send of
+  chunk ``i+1`` with the matmuls of chunk ``i``.
+
+The accumulation is the same online softmax the Pallas flash kernel uses
+(``mlops_tpu.ops.attention``), lifted one level up: flash streams K/V
+*blocks through VMEM*, the ring streams K/V *shards across chips*. The loop
+is a ``lax.scan`` with static length so the whole thing is reverse-mode
+differentiable (``ppermute`` transposes to the inverse permutation), making
+it usable for long-sequence *training*, not just inference.
+
+Attention here is bidirectional (non-causal) — the consumers are the
+FT-Transformer feature tokens and BERT-style encoders (BASELINE.json
+configs 3 and 5), both bidirectional.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def ring_attention_shard(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    axis_size: int,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Per-device body, to be called INSIDE shard_map.
+
+    Args:
+      q, k, v: local sequence shards ``[B, S_local, H, D]``.
+      axis_name: mesh axis the sequence is sharded over.
+      axis_size: number of devices in the ring (static).
+      scale: score scale, default ``1/sqrt(D)``.
+
+    Returns the local output shard ``[B, S_local, H, D]``.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    b, s_q, h, _ = q.shape
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(carry, k_cur, v_cur):
+        """Fold one K/V chunk into the online-softmax accumulator."""
+        m, l, acc = carry
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32)
+            * scale
+        )
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur
+        ).astype(jnp.float32)
+        return m_new, l_new, acc * jnp.moveaxis(alpha, 1, 2) + pv
+
+    # Local chunk first (no communication), then exactly axis_size - 1
+    # permute-then-fold hops — no wasted final rotation.
+    m0 = jnp.full((b, h, s_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q, 1), jnp.float32)
+    acc0 = jnp.zeros((b, s_q, h, d), jnp.float32)
+    carry0 = fold((m0, l0, acc0), k, v)
+
+    def hop(carry, _):
+        m, l, acc, k_cur, v_cur = carry
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        m, l, acc = fold((m, l, acc), k_nxt, v_nxt)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        hop, (*carry0, k, v), None, length=axis_size - 1
+    )
+    return (acc / jnp.moveaxis(l, 1, 2)).astype(q.dtype)
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: str | None = None,
+    scale: float | None = None,
+) -> Callable:
+    """Host-level ring attention over global ``[B, S, H, D]`` arrays.
+
+    Returns ``fn(q, k, v) -> out`` with S sharded over ``seq_axis`` and,
+    when ``batch_axis`` is given, B sharded over it too (combined DP x SP —
+    each data-parallel ring runs independently). S must divide evenly by
+    the seq axis size — pad upstream; for BERT-style fixed-length inputs
+    even division is the normal case.
+    """
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
+    spec = P(batch_axis, seq_axis, None, None)
+
+    body = partial(
+        ring_attention_shard, axis_name=seq_axis, axis_size=n, scale=scale
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def ring(q, k, v):
+        return body(q, k, v)
+
+    return ring

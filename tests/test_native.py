@@ -1,0 +1,89 @@
+"""Native C++ encoder vs the pure-Python path: exact parity required."""
+
+import numpy as np
+import pytest
+
+from mlops_tpu.data import Preprocessor
+from mlops_tpu.data.ingest import write_csv_columns
+from mlops_tpu import native
+
+
+@pytest.fixture(scope="module")
+def csv_file(tmp_path_factory):
+    from mlops_tpu.data import generate_synthetic
+
+    columns, labels = generate_synthetic(500, seed=11)
+    path = tmp_path_factory.mktemp("native") / "data.csv"
+    write_csv_columns(path, columns, labels)
+    return path, columns, labels
+
+
+def test_native_builds():
+    assert native.native_available(), (
+        "g++ is in the image; the native encoder must build"
+    )
+
+
+def test_native_matches_python_exactly(csv_file):
+    path, columns, labels = csv_file
+    prep = Preprocessor.fit(columns)
+    got = native.encode_csv_native(path, prep, require_target=True)
+    want = prep.encode(columns, labels)
+    np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
+    np.testing.assert_allclose(got.numeric, want.numeric, atol=1e-5)
+    np.testing.assert_array_equal(got.labels, np.asarray(want.labels, np.int8))
+
+
+def test_native_handles_oov_missing_and_quotes(tmp_path):
+    from mlops_tpu.schema import SCHEMA
+
+    header = (
+        ",".join(f.name for f in SCHEMA.categorical)
+        + ","
+        + ",".join(f.name for f in SCHEMA.numeric)
+    )
+    cat_row1 = ['"male"'] + ["NEVER_SEEN"] * (SCHEMA.num_categorical - 1)
+    num_row1 = ["", "null"] + ["1.5"] * (SCHEMA.num_numeric - 2)
+    path = tmp_path / "edge.csv"
+    path.write_text(
+        header + "\n" + ",".join(cat_row1 + num_row1) + "\n"
+    )
+
+    columns = {f.name: ["male"] for f in SCHEMA.categorical}
+    for f in SCHEMA.numeric:
+        columns[f.name] = [1.0]
+    prep = Preprocessor.fit(columns)
+
+    got = native.encode_csv_native(path, prep)
+    assert got.labels is None
+    assert got.cat_ids.shape == (1, SCHEMA.num_categorical)
+    # Quoted "male" decodes to id 0; unseen values hit each feature's OOV id.
+    assert got.cat_ids[0, 0] == 0
+    for j, feat in enumerate(SCHEMA.categorical[1:], start=1):
+        assert got.cat_ids[0, j] == feat.oov_id
+    # Missing numerics -> median (=1.0) -> standardized 0 (std floor 1.0).
+    np.testing.assert_allclose(got.numeric[0, :2], 0.0, atol=1e-6)
+
+
+def test_native_missing_column_errors(tmp_path):
+    path = tmp_path / "bad.csv"
+    path.write_text("only_one_column\nx\n")
+    columns = {"credit_limit": [1.0]}
+    from mlops_tpu.schema import SCHEMA
+
+    full = {f.name: ["male"] for f in SCHEMA.categorical}
+    for f in SCHEMA.numeric:
+        full[f.name] = [1.0]
+    prep = Preprocessor.fit(full)
+    with pytest.raises(ValueError, match="missing"):
+        native.encode_csv_native(path, prep)
+
+
+def test_fallback_path_matches(csv_file, monkeypatch):
+    path, columns, labels = csv_file
+    prep = Preprocessor.fit(columns)
+    monkeypatch.setattr(native, "_lib_cache", False)
+    got = native.encode_csv(path, prep, require_target=True)
+    want = prep.encode(columns, labels)
+    np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
+    np.testing.assert_allclose(got.numeric, want.numeric, atol=1e-5)

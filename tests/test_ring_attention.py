@@ -1,0 +1,76 @@
+"""Ring attention vs dense reference on the fake 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlops_tpu.ops.attention import reference_attention
+from mlops_tpu.parallel import make_nd_mesh, make_ring_attention
+
+
+def _qkv(key, b=2, s=64, h=4, d=16):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (
+        jax.random.normal(kq, shape, jnp.float32),
+        jax.random.normal(kk, shape, jnp.float32),
+        jax.random.normal(kv, shape, jnp.float32),
+    )
+
+
+def test_matches_dense_reference_seq8():
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    mesh = make_nd_mesh({"seq": 8})
+    ring = make_ring_attention(mesh, "seq")
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(reference_attention(q, k, v)),
+        atol=1e-5,
+        rtol=1e-5,
+    )
+
+
+def test_combined_data_and_sequence_parallel():
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=4, s=32)
+    mesh = make_nd_mesh({"data": 2, "seq": 4})
+    ring = make_ring_attention(mesh, "seq", batch_axis="data")
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(reference_attention(q, k, v)),
+        atol=1e-5,
+        rtol=1e-5,
+    )
+
+
+def test_gradients_match_dense():
+    """scan + ppermute path must be reverse-differentiable (training use)."""
+    q, k, v = _qkv(jax.random.PRNGKey(2), b=1, s=16, h=2, d=8)
+    mesh = make_nd_mesh({"seq": 4})
+    ring = make_ring_attention(mesh, "seq")
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gd), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_uneven_seq_rejected():
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=20)
+    mesh = make_nd_mesh({"seq": 8})
+    ring = make_ring_attention(mesh, "seq")
+    with pytest.raises(Exception):
+        ring(q, k, v)
+
+
+def test_nd_mesh_too_many_devices():
+    with pytest.raises(ValueError):
+        make_nd_mesh({"data": 4, "seq": 4})
