@@ -1,0 +1,72 @@
+# GKE clusters with TPU v5e node pools — analogue of
+# `infrastructure/modules/kubernetes-service.bicep` (AKS Free tier, 2x
+# Standard_B2s, omsagent->Log Analytics), rebuilt for TPU serving:
+# staging/production pair selected by label (the reference selects AKS
+# clusters by an `environment` tag, `deploy-kubernetes.yml:231-232`).
+
+resource "google_container_cluster" "env" {
+  for_each = var.deploy_kubernetes_service ? toset(var.environments) : []
+
+  name     = "mlops-tpu-${each.key}-${local.suffix}"
+  location = var.zone
+
+  # Separately-managed node pools; the default pool hosts system pods and
+  # the CPU side of the workload (ingress, metrics).
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  resource_labels = merge(local.labels, { environment = each.key })
+
+  # Cloud Logging/Monitoring replace the omsagent->Log Analytics wiring
+  # (`kubernetes-service.bicep:53-61`); on GKE they are first-party.
+  logging_service    = "logging.googleapis.com/kubernetes"
+  monitoring_service = "monitoring.googleapis.com/kubernetes"
+
+  workload_identity_config {
+    workload_pool = "${var.project_id}.svc.id.goog"
+  }
+}
+
+resource "google_container_node_pool" "system" {
+  for_each = google_container_cluster.env
+
+  name       = "system"
+  cluster    = each.value.name
+  location   = var.zone
+  node_count = 1
+
+  node_config {
+    machine_type = "e2-standard-4"
+    labels       = local.labels
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+  }
+}
+
+# The TPU pool: ct5lp-hightpu-1t = one v5e chip per node; the serving
+# Deployment lands here via google.com/tpu requests + the
+# gke-tpu-accelerator/topology node selectors (kubernetes/manifest.yml).
+resource "google_container_node_pool" "tpu" {
+  for_each = google_container_cluster.env
+
+  name     = "tpu-v5e"
+  cluster  = each.value.name
+  location = var.zone
+
+  autoscaling {
+    min_node_count = 1
+    max_node_count = each.key == "production" ? 4 : 2
+  }
+
+  node_config {
+    machine_type = "ct5lp-hightpu-1t"
+    labels       = merge(local.labels, { environment = each.key })
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+
+    # Preemption-tolerant serving: checkpointed bundles reload in seconds
+    # and the PDB keeps one replica up (staging only; prod on-demand).
+    spot = each.key != "production"
+  }
+
+  # GKE injects the TPU device plugin + topology labels automatically for
+  # ct5lp machine types; var.tpu_topology documents the slice shape.
+}

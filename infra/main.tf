@@ -1,0 +1,59 @@
+# GCP estate for the TPU-native stack — analogue of the reference's
+# subscription-scope `infrastructure/main.bicep` (SURVEY.md §2.4), re-based
+# from Azure (ACR/AKS/ACA/Databricks/Log Analytics) onto GCP:
+#
+#   ACR                     -> Artifact Registry        (registry.tf)
+#   AKS staging+production  -> GKE + TPU node pools     (gke.tf)
+#   Log Analytics + omsagent-> Cloud Logging/Monitoring (built into GKE)
+#   Databricks workspace    -> none: training runs in-cluster on the TPU
+#                              pool via the framework's own trainer
+#   user-assigned identity  -> service accounts + workload identity (iam.tf)
+#   storage account         -> GCS bucket for datasets + model registry
+#
+# Same shape as the reference: one orchestrating entry point, staging and
+# production pairs behind a flag, all names exported as outputs.
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = "~> 6.0"
+    }
+    random = {
+      source  = "hashicorp/random"
+      version = "~> 3.6"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+# Deterministic short suffix (parity with `main.bicep:29`'s uniqueString).
+resource "random_id" "suffix" {
+  byte_length = 3
+}
+
+locals {
+  suffix = random_id.suffix.hex
+  labels = {
+    workload = "credit-default-mlops"
+    stack    = "mlops-tpu"
+  }
+}
+
+# Dataset + registry bucket (reference: storage-account.bicep + DBFS upload,
+# `deploy-infrastructure.yml:195-198`).
+resource "google_storage_bucket" "data" {
+  name                        = "${var.project_id}-mlops-tpu-${local.suffix}"
+  location                    = var.region
+  uniform_bucket_level_access = true
+  labels                      = local.labels
+
+  versioning {
+    enabled = true # model-registry bundles are immutable versions
+  }
+}

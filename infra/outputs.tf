@@ -1,0 +1,22 @@
+# Outputs — analogue of `infrastructure/main.bicep:188-198` (all resource
+# names + the Databricks hostname; here: everything CI needs to deploy).
+
+output "artifact_registry" {
+  value = "${var.region}-docker.pkg.dev/${var.project_id}/${google_artifact_registry_repository.images.repository_id}"
+}
+
+output "data_bucket" {
+  value = google_storage_bucket.data.name
+}
+
+output "gke_clusters" {
+  value = { for env, c in google_container_cluster.env : env => c.name }
+}
+
+output "deploy_service_account" {
+  value = google_service_account.deploy.email
+}
+
+output "workload_identity_provider" {
+  value = google_iam_workload_identity_pool_provider.github.name
+}

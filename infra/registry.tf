@@ -1,0 +1,54 @@
+# Artifact Registry — analogue of `infrastructure/modules/
+# container-registry.bicep` (ACR Standard with AcrPull role to the managed
+# identity; `main.bicep:117-123`). On GKE, image pull auth is the node
+# service account's artifactregistry.reader binding — no admin user,
+# no attach-acr step (`deploy-infrastructure.yml:252-260` has no analogue).
+
+resource "google_artifact_registry_repository" "images" {
+  repository_id = "mlops-tpu-${local.suffix}"
+  location      = var.region
+  format        = "DOCKER"
+  labels        = local.labels
+}
+
+resource "google_service_account" "deploy" {
+  account_id   = "mlops-tpu-deploy-${local.suffix}"
+  display_name = "CI deploy identity (GitHub OIDC federated)"
+}
+
+resource "google_artifact_registry_repository_iam_member" "ci_push" {
+  repository = google_artifact_registry_repository.images.name
+  location   = var.region
+  role       = "roles/artifactregistry.writer"
+  member     = "serviceAccount:${google_service_account.deploy.email}"
+}
+
+resource "google_project_iam_member" "ci_gke" {
+  project = var.project_id
+  role    = "roles/container.developer"
+  member  = "serviceAccount:${google_service_account.deploy.email}"
+}
+
+resource "google_storage_bucket_iam_member" "ci_data" {
+  bucket = google_storage_bucket.data.name
+  role   = "roles/storage.objectAdmin"
+  member = "serviceAccount:${google_service_account.deploy.email}"
+}
+
+# GitHub OIDC federation — analogue of the reference's Azure federated
+# credentials setup (`.github/docs/step-by-step-setup.md:43-120`).
+resource "google_iam_workload_identity_pool" "github" {
+  workload_identity_pool_id = "github-${local.suffix}"
+}
+
+resource "google_iam_workload_identity_pool_provider" "github" {
+  workload_identity_pool_id          = google_iam_workload_identity_pool.github.workload_identity_pool_id
+  workload_identity_pool_provider_id = "github-oidc"
+  attribute_mapping = {
+    "google.subject"       = "assertion.sub"
+    "attribute.repository" = "assertion.repository"
+  }
+  oidc {
+    issuer_uri = "https://token.actions.githubusercontent.com"
+  }
+}

@@ -1,0 +1,39 @@
+# Parameters — analogue of `infrastructure/main.bicep:8-23` (resource group,
+# location, deploy flags).
+
+variable "project_id" {
+  type        = string
+  description = "GCP project to deploy into"
+}
+
+variable "region" {
+  type        = string
+  default     = "us-west4" # v5e availability
+  description = "Region for GKE, Artifact Registry and the data bucket"
+}
+
+variable "zone" {
+  type        = string
+  default     = "us-west4-1"
+  description = "Zone for the TPU node pools (v5e zones only)"
+}
+
+# Parity with the reference's deployKubernetesService flag
+# (`main.bicep:16-23`); container-apps has no GCP analogue — Cloud Run
+# cannot schedule TPUs, so GKE is the single serving target.
+variable "deploy_kubernetes_service" {
+  type    = bool
+  default = true
+}
+
+variable "tpu_topology" {
+  type        = string
+  default     = "1x1" # one v5e chip per serving node
+  description = "TPU podslice topology for the serving node pools"
+}
+
+variable "environments" {
+  type        = list(string)
+  default     = ["staging", "production"] # parity: main.bicep:140-182 pairs
+  description = "One GKE cluster + TPU pool per environment"
+}

@@ -85,6 +85,10 @@ class ServeConfig:
     max_batch: int = 256  # request-size cap; must equal the largest warmed
     # bucket so steady-state serving never compiles a novel shape
     warmup_batch_sizes: tuple[int, ...] = (1, 8, 64, 256)
+    profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
+    # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
+    # are unauthenticated, so tracing is opt-in per deployment — enable
+    # with serve.profile_dir=/tmp/profile when debugging a pod
 
 
 @dataclasses.dataclass

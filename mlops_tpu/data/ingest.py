@@ -61,8 +61,18 @@ def load_csv_columns(
     if schema.target in col_index:
         i = col_index[schema.target]
         raw = np.asarray([to_float(cell(row, i)) for row in rows])
-        # Unparseable labels coerce to 0 (never NaN into the loss).
-        labels = np.where(np.isfinite(raw), raw, 0.0).astype(np.int8)
+        bad = ~np.isfinite(raw)
+        if bad.any():
+            # Features degrade gracefully (OOV/median) but corrupt LABELS
+            # fail fast — silently training on garbage would surface only
+            # as mysteriously bad AUC. Native kernel mirrors this
+            # (MLOPS_ERR_BAD_LABEL).
+            raise ValueError(
+                f"{path}: {int(bad.sum())} unparseable value(s) in target "
+                f"column {schema.target!r} (first at data row "
+                f"{int(np.argmax(bad))})"
+            )
+        labels = raw.astype(np.int8)
     return columns, labels
 
 

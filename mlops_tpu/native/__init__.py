@@ -39,6 +39,7 @@ _ERRORS = {
     -1: "required schema column missing from CSV header",
     -2: "row count exceeded the preallocated buffer",
     -3: "target column required but absent",
+    -4: "unparseable value in the target column",
 }
 
 _lib_cache: ctypes.CDLL | None | bool = None  # False = tried and failed
@@ -142,8 +143,9 @@ def encode_csv_native(
         raise RuntimeError("native encoder unavailable")
 
     data = Path(path).read_bytes()
-    # Upper bound on data rows; the kernel returns the true count.
-    max_rows = max(1, data.count(b"\n") + 1)
+    # Upper bound on data rows; the kernel returns the true count. max()
+    # covers every record-terminator convention (LF, CRLF, bare CR).
+    max_rows = max(1, data.count(b"\n"), data.count(b"\r")) + 1
 
     names = "\x1e".join(
         [f.name for f in schema.categorical]

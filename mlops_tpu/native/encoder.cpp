@@ -35,6 +35,7 @@ const char* split_record(const char* p, const char* end,
   fields.clear();
   std::string cur;
   bool quoted = false;
+  bool at_field_start = true;
   while (p < end) {
     char c = *p;
     if (quoted) {
@@ -44,14 +45,21 @@ const char* split_record(const char* p, const char* end,
       }
       cur.push_back(c); ++p; continue;
     }
-    if (c == '"') { quoted = true; ++p; continue; }
-    if (c == ',') { fields.push_back(cur); cur.clear(); ++p; continue; }
+    if (c == '"' && at_field_start) {
+      // Only a quote at field start opens quoted mode; a stray quote
+      // mid-field stays literal (csv.reader parity).
+      quoted = true; at_field_start = false; ++p; continue;
+    }
+    if (c == ',') {
+      fields.push_back(cur); cur.clear();
+      at_field_start = true; ++p; continue;
+    }
     if (c == '\n' || c == '\r') {
       while (p < end && (*p == '\n' || *p == '\r')) ++p;
       fields.push_back(cur);
       return p;
     }
-    cur.push_back(c); ++p;
+    cur.push_back(c); at_field_start = false; ++p;
   }
   fields.push_back(cur);
   return p;
@@ -69,12 +77,18 @@ std::vector<std::string> split_on(const std::string& s, char sep) {
   return out;
 }
 
+// Python-float() parity: the WHOLE trimmed cell must parse (reject
+// trailing garbage like "1.5abc") and hex literals are rejected (strtof
+// accepts "0x1A"; Python float() does not).
 float parse_numeric(const std::string& s) {
-  if (s.empty() || s == "null" || s == "NaN" || s == "nan")
+  if (s.empty()) return NAN;
+  if (s.find('x') != std::string::npos || s.find('X') != std::string::npos)
     return NAN;
   char* endp = nullptr;
   float v = std::strtof(s.c_str(), &endp);
   if (endp == s.c_str()) return NAN;  // unparseable -> treated as missing
+  while (*endp == ' ' || *endp == '\t') ++endp;  // float() strips whitespace
+  if (*endp != '\0') return NAN;  // trailing garbage -> missing
   return v;
 }
 
@@ -87,6 +101,7 @@ enum {
   MLOPS_ERR_MISSING_COLUMN = -1,
   MLOPS_ERR_TOO_MANY_ROWS = -2,
   MLOPS_ERR_MISSING_TARGET = -3,
+  MLOPS_ERR_BAD_LABEL = -4,
 };
 
 // Parse `csv[0..csv_len)` (header + records) and encode into the caller's
@@ -132,7 +147,8 @@ long mlops_encode_csv(const char* csv, long csv_len,
   p = split_record(p, end, header);
   std::unordered_map<std::string, int> col_index;
   for (size_t i = 0; i < header.size(); ++i)
-    col_index.emplace(header[i], static_cast<int>(i));
+    col_index[header[i]] = static_cast<int>(i);  // duplicate names: last wins
+                                                 // (Python dict parity)
 
   std::vector<int> cat_col(n_cat), num_col(n_num);
   for (int j = 0; j < n_cat + n_num; ++j) {
@@ -176,7 +192,10 @@ long mlops_encode_csv(const char* csv, long csv_len,
       float v = label_col < static_cast<int>(fields.size())
                     ? parse_numeric(fields[label_col])
                     : NAN;
-      lab_out[row] = std::isfinite(v) ? v : 0.0f;
+      // Corrupt labels fail fast — silently training on garbage labels is
+      // the one place lenient coercion is wrong (ingest.py mirrors this).
+      if (!std::isfinite(v)) return MLOPS_ERR_BAD_LABEL;
+      lab_out[row] = v;
     }
     ++row;
   }

@@ -40,6 +40,8 @@ returns <code>{{"predictions": [...], "outliers": [...],
 <li><code>GET /healthz/live</code> — liveness probe</li>
 <li><code>GET /healthz/ready</code> — readiness probe (model loaded + jit warm)</li>
 <li><code>GET /metrics</code> — Prometheus metrics</li>
+<li><code>POST /debug/profile/start</code>, <code>POST /debug/profile/stop</code>
+— capture a <code>jax.profiler</code> device trace (view in TensorBoard)</li>
 </ul>
 </body></html>"""
 
@@ -65,6 +67,7 @@ class HttpServer:
             max_workers=4, thread_name_prefix="predict"
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
+        self._profiling = False
 
     # ----------------------------------------------------------- HTTP layer
     async def handle_connection(
@@ -148,8 +151,8 @@ class HttpServer:
         keep_alive: bool = True,
     ) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  413: "Payload Too Large", 422: "Unprocessable Entity",
-                  500: "Internal Server Error",
+                  409: "Conflict", 413: "Payload Too Large",
+                  422: "Unprocessable Entity", 500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "OK")
         if isinstance(payload, (dict, list)):
             body = json.dumps(payload).encode()
@@ -170,6 +173,8 @@ class HttpServer:
     async def _route(self, method: str, path: str, body: bytes):
         if path == "/predict" and method == "POST":
             return await self._predict(body)
+        if path.startswith("/debug/profile/") and method == "POST":
+            return self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":
             if path == "/":
                 return 200, _DOCS_HTML.format(title=self.config.service_name), "text/html"
@@ -181,6 +186,28 @@ class HttpServer:
                 return 503, {"status": "warming"}, "application/json"
             if path == "/metrics":
                 return 200, self.metrics.render(), "text/plain; version=0.0.4"
+        return 404, {"detail": "not found"}, "application/json"
+
+    def _profile(self, action: str):
+        """On-demand device tracing (SURVEY.md SS5.1: the reference has no
+        profiler at all; here the serving process can capture a
+        ``jax.profiler`` trace of live traffic for TensorBoard)."""
+        if not self.config.profile_dir:
+            return 404, {"detail": "profiling disabled"}, "application/json"
+        import jax
+
+        if action == "start":
+            if self._profiling:
+                return 409, {"detail": "trace already running"}, "application/json"
+            jax.profiler.start_trace(self.config.profile_dir)
+            self._profiling = True
+            return 200, {"status": "tracing", "dir": self.config.profile_dir}, "application/json"
+        if action == "stop":
+            if not self._profiling:
+                return 409, {"detail": "no trace running"}, "application/json"
+            jax.profiler.stop_trace()
+            self._profiling = False
+            return 200, {"status": "stopped", "dir": self.config.profile_dir}, "application/json"
         return 404, {"detail": "not found"}, "application/json"
 
     async def _predict(self, body: bytes):

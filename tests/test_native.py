@@ -87,3 +87,103 @@ def test_fallback_path_matches(csv_file, monkeypatch):
     want = prep.encode(columns, labels)
     np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
     np.testing.assert_allclose(got.numeric, want.numeric, atol=1e-5)
+
+
+def _tiny_prep():
+    from mlops_tpu.schema import SCHEMA
+
+    columns = {f.name: ["male"] for f in SCHEMA.categorical}
+    for f in SCHEMA.numeric:
+        columns[f.name] = [1.0]
+    return Preprocessor.fit(columns)
+
+
+def _edge_csv(tmp_path, rows, header=None, name="edge.csv"):
+    from mlops_tpu.schema import SCHEMA
+
+    if header is None:
+        header = ",".join(f.name for f in SCHEMA.categorical) + "," + ",".join(
+            f.name for f in SCHEMA.numeric
+        )
+    path = tmp_path / name
+    path.write_text(header + "\n" + "\n".join(rows) + "\n")
+    return path
+
+
+def _both_paths(path, prep, require_target=False):
+    from mlops_tpu.data.ingest import load_csv_columns
+
+    got = native.encode_csv_native(path, prep, require_target=require_target)
+    columns, labels = load_csv_columns(path, require_target=require_target)
+    want = prep.encode(columns, labels)
+    np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
+    np.testing.assert_allclose(got.numeric, want.numeric, atol=1e-5)
+    return got, want
+
+
+def test_parity_stray_quote_and_garbage_numerics(tmp_path):
+    """csv.reader semantics: mid-field quotes stay literal; float() ones:
+    '1.5abc' and hex reject -> median. Native must match Python exactly."""
+    from mlops_tpu.schema import SCHEMA
+
+    cats = ['5\'6" tall'] + ["male"] * (SCHEMA.num_categorical - 1)
+    nums = ["1.5abc", "0x1A"] + ["2.0"] * (SCHEMA.num_numeric - 2)
+    path = _edge_csv(tmp_path, [",".join(cats + nums)])
+    got, _ = _both_paths(path, _tiny_prep())
+    # Both garbage numerics impute to the median (=1.0 -> standardized 0).
+    np.testing.assert_allclose(got.numeric[0, :2], 0.0, atol=1e-6)
+
+
+def test_parity_duplicate_header_last_wins(tmp_path):
+    from mlops_tpu.schema import SCHEMA
+
+    names = [f.name for f in SCHEMA.categorical] + [
+        f.name for f in SCHEMA.numeric
+    ]
+    header = ",".join(names) + ",credit_limit"  # duplicate numeric column
+    row = ",".join(
+        ["male"] * SCHEMA.num_categorical
+        + ["7.0"] * SCHEMA.num_numeric
+        + ["9.0"]
+    )
+    path = _edge_csv(tmp_path, [row], header=header)
+    prep = _tiny_prep()
+    got, want = _both_paths(path, prep)
+    # Last occurrence (9.0) must win on both paths.
+    j = [f.name for f in SCHEMA.numeric].index("credit_limit")
+    assert got.numeric[0, j] == want.numeric[0, j] == 9.0 - 1.0
+
+
+def test_parity_cr_only_line_endings(tmp_path):
+    from mlops_tpu.schema import SCHEMA
+
+    header = ",".join(f.name for f in SCHEMA.categorical) + "," + ",".join(
+        f.name for f in SCHEMA.numeric
+    )
+    row = ",".join(["male"] * SCHEMA.num_categorical + ["3.0"] * SCHEMA.num_numeric)
+    path = tmp_path / "cr.csv"
+    path.write_bytes((header + "\r" + row + "\r" + row + "\r").encode())
+    got = native.encode_csv_native(path, _tiny_prep())
+    assert got.cat_ids.shape[0] == 2
+
+
+def test_corrupt_labels_fail_fast_both_paths(tmp_path):
+    from mlops_tpu.data.ingest import load_csv_columns
+    from mlops_tpu.schema import SCHEMA
+
+    header = (
+        ",".join(f.name for f in SCHEMA.categorical)
+        + ","
+        + ",".join(f.name for f in SCHEMA.numeric)
+        + f",{SCHEMA.target}"
+    )
+    row = ",".join(
+        ["male"] * SCHEMA.num_categorical
+        + ["1.0"] * SCHEMA.num_numeric
+        + ["oops"]
+    )
+    path = _edge_csv(tmp_path, [row], header=header)
+    with pytest.raises(ValueError, match="target"):
+        native.encode_csv_native(path, _tiny_prep(), require_target=True)
+    with pytest.raises(ValueError, match="target"):
+        load_csv_columns(path, require_target=True)

@@ -231,3 +231,27 @@ def test_readiness_gate(tiny_pipeline):
     cold = InferenceEngine(bundle, buckets=(1,))  # no warmup
     [(status, _, body)] = _run_exchanges(cold, [("GET", "/healthz/ready", None)])
     assert status == 503
+
+
+def test_profile_endpoints(engine, tmp_path):
+    """jax.profiler trace start/stop over the socket (SURVEY.md SS5.1)."""
+    config = ServeConfig(host="127.0.0.1", port=0, profile_dir=str(tmp_path))
+    server = HttpServer(engine, config)
+    exchanges = [
+        ("POST", "/debug/profile/stop", None),   # nothing running -> 409
+        ("POST", "/debug/profile/start", None),  # -> 200 tracing
+        ("POST", "/debug/profile/start", None),  # already running -> 409
+        ("POST", "/debug/profile/stop", None),   # -> 200 stopped
+    ]
+    results = asyncio.run(_http((server, exchanges)))
+    assert [s for s, _, _ in results] == [409, 200, 409, 200]
+    assert any(tmp_path.iterdir()), "trace output expected in profile_dir"
+
+
+def test_profile_disabled(engine):
+    config = ServeConfig(host="127.0.0.1", port=0, profile_dir="")
+    server = HttpServer(engine, config)
+    [(status, _, _)] = asyncio.run(
+        _http((server, [("POST", "/debug/profile/start", None)]))
+    )
+    assert status == 404
