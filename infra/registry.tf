@@ -48,7 +48,20 @@ resource "google_iam_workload_identity_pool_provider" "github" {
     "google.subject"       = "assertion.sub"
     "attribute.repository" = "assertion.repository"
   }
+  # GCP requires a condition on new GitHub OIDC providers; scope the trust
+  # to this repository only.
+  attribute_condition = "attribute.repository == \"${var.github_repository}\""
   oidc {
     issuer_uri = "https://token.actions.githubusercontent.com"
   }
+}
+
+# The binding that makes federation actually work: GitHub workflows from
+# this repo may mint tokens AS the deploy service account (the GCP
+# analogue of the reference's federated-credential subject entries,
+# `.github/docs/step-by-step-setup.md:43-120` there).
+resource "google_service_account_iam_member" "github_federation" {
+  service_account_id = google_service_account.deploy.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "principalSet://iam.googleapis.com/${google_iam_workload_identity_pool.github.name}/attribute.repository/${var.github_repository}"
 }

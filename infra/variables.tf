@@ -14,8 +14,13 @@ variable "region" {
 
 variable "zone" {
   type        = string
-  default     = "us-west4-1"
+  default     = "us-west4-a"
   description = "Zone for the TPU node pools (v5e zones only)"
+}
+
+variable "github_repository" {
+  type        = string
+  description = "owner/repo allowed to federate onto the deploy identity"
 }
 
 # Parity with the reference's deployKubernetesService flag

@@ -25,6 +25,7 @@ def build_parser() -> argparse.ArgumentParser:
     for name, help_text in [
         ("synth", "generate a synthetic schema-conforming CSV"),
         ("train", "train a model and write a bundle"),
+        ("pretrain", "masked-feature pretraining on unlabeled rows (bert)"),
         ("tune", "hyperparameter search (vmapped + sharded trials)"),
         ("register", "register a bundle in the model registry"),
         ("serve", "serve a bundle over HTTP"),

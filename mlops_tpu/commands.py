@@ -10,6 +10,11 @@ from mlops_tpu.config import load_config
 
 def run(args: argparse.Namespace) -> int:
     _honor_jax_platforms_env()
+    # Multi-host launches (GKE JobSet / TPU pod) wire up DCN before any
+    # backend use; single-host is a no-op (parallel/distributed.py).
+    from mlops_tpu.parallel.distributed import initialize as distributed_init
+
+    distributed_init()
     config = load_config(args.config, overrides=getattr(args, "overrides", []))
     handler = _HANDLERS.get(args.command)
     if handler is None:
@@ -60,6 +65,41 @@ def _train(config) -> int:
                 "steps": result.train_result.steps,
                 "metrics": result.train_result.metrics,
             }
+        )
+    )
+    return 0
+
+
+def _pretrain(config) -> int:
+    """Masked-feature pretraining on unlabeled rows (BASELINE config 5's
+    'fine-tune' implies a pretrain stage; labels are never read). Output:
+    a params file consumable via ``train train.init_params=<path>``."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic, load_csv_columns
+    from mlops_tpu.train.pipeline import new_run_dir
+    from mlops_tpu.train.pretrain import pretrain_bert, save_pretrained
+
+    if config.model.family != "bert":
+        raise SystemExit("pretrain supports model.family=bert")
+    if config.data.train_path:
+        columns, _ = load_csv_columns(config.data.train_path)
+    else:
+        columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns)
+
+    result = pretrain_bert(
+        config.model,
+        ds,
+        steps=config.train.steps,
+        batch_size=config.train.batch_size,
+        learning_rate=config.train.learning_rate,
+        seed=config.train.seed,
+    )
+    out = new_run_dir(config) / "pretrained.msgpack"
+    save_pretrained(result, out)
+    print(
+        json.dumps(
+            {"pretrained": str(out), "rows": ds.n, "loss_curve": result.losses}
         )
     )
     return 0
@@ -232,6 +272,7 @@ def _serve(config) -> int:
 _HANDLERS = {
     "synth": _synth,
     "train": _train,
+    "pretrain": _pretrain,
     "tune": _tune,
     "register": _register,
     "predict-file": _predict_file,

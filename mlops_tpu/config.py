@@ -54,6 +54,8 @@ class TrainConfig:
     eval_every: int = 200
     checkpoint_every: int = 500
     pos_weight: float = 1.0  # class-imbalance weight on the positive class
+    init_params: str = ""  # path to pretrained masked-LM params (`pretrain`
+    # CLI output) to graft into the bert trunk before fine-tuning
 
 
 @dataclasses.dataclass

@@ -63,16 +63,21 @@ def load_csv_columns(
         raw = np.asarray([to_float(cell(row, i)) for row in rows])
         bad = ~np.isfinite(raw)
         if bad.any():
-            # Features degrade gracefully (OOV/median) but corrupt LABELS
-            # fail fast — silently training on garbage would surface only
-            # as mysteriously bad AUC. Native kernel mirrors this
-            # (MLOPS_ERR_BAD_LABEL).
-            raise ValueError(
-                f"{path}: {int(bad.sum())} unparseable value(s) in target "
-                f"column {schema.target!r} (first at data row "
-                f"{int(np.argmax(bad))})"
-            )
-        labels = raw.astype(np.int8)
+            if require_target:
+                # Features degrade gracefully (OOV/median) but corrupt
+                # TRAINING labels fail fast — silently training on garbage
+                # would surface only as mysteriously bad AUC. Native
+                # kernel mirrors this (MLOPS_ERR_BAD_LABEL).
+                raise ValueError(
+                    f"{path}: {int(bad.sum())} unparseable value(s) in "
+                    f"target column {schema.target!r} (first at data row "
+                    f"{int(np.argmax(bad))})"
+                )
+            # Scoring/pretrain paths: a partially-blank target column just
+            # means the file is unlabeled — labels are never read there.
+            labels = None
+        else:
+            labels = raw.astype(np.int8)
     return columns, labels
 
 

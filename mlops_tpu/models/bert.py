@@ -195,6 +195,89 @@ class BertEncoder(nn.Module):
         return logit[:, 0].astype(jnp.float32)
 
 
+class BertMaskedLM(nn.Module):
+    """Masked-feature pretraining head over the same encoder trunk.
+
+    Tabular analogue of BERT's MLM objective: mask a fraction of VALUE
+    tokens (never names/CLS/SEP) and predict the original token id from
+    context — self-supervised pretraining on unlabeled rows, no target
+    column needed. The trunk modules carry the same names as
+    ``BertEncoder`` (tok_embed, pos_embed, ln_embed, block_i, ln_final),
+    so pretrained params transfer into the classifier via
+    ``transfer_encoder_params`` and fine-tuning proceeds with the standard
+    trainer.
+    """
+
+    cards: Sequence[int]
+    num_numeric: int
+    hidden: int = 768
+    depth: int = 12
+    heads: int = 12
+    dropout: float = 0.1
+    num_bins: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def layout(self) -> TokenLayout:
+        return TokenLayout(tuple(self.cards), self.num_numeric, self.num_bins)
+
+    def value_positions(self) -> np.ndarray:
+        """Sequence indices holding value tokens (maskable positions):
+        every second slot after CLS — [2, 4, ..., 2F]."""
+        f = self.layout.num_features
+        return np.arange(2, 2 * f + 1, 2)
+
+    @nn.compact
+    def __call__(
+        self,
+        cat_ids: jnp.ndarray,
+        numeric: jnp.ndarray,
+        mask: jnp.ndarray,
+        *,
+        train: bool = True,
+    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """mask: bool [N, S], True = replace with [MASK] and predict.
+
+        Returns (logits [N, S, vocab], original token ids [N, S]).
+        """
+        layout = self.layout
+        targets = tokenize(cat_ids, numeric, layout)
+        tokens = jnp.where(mask, MASK_ID, targets)
+
+        x = nn.Embed(
+            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (layout.seq_len, self.hidden),
+        )
+        x = x + pos.astype(self.dtype)[None]
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                heads=self.heads,
+                token_dim=self.hidden,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        logits = nn.Dense(layout.vocab_size, dtype=self.dtype, name="mlm_head")(x)
+        return logits.astype(jnp.float32), targets
+
+
+def transfer_encoder_params(pretrained: dict, target: dict) -> dict:
+    """Graft pretrained trunk params into a freshly-initialized classifier
+    param tree (same-named subtrees copy; heads keep their fresh init)."""
+    merged = dict(target)
+    for key, value in pretrained.items():
+        if key in merged and key != "mlm_head":
+            merged[key] = value
+    return merged
+
+
 def bert_base_config():
     """ModelConfig preset at true BERT-base scale (v5e-8 data-parallel)."""
     from mlops_tpu.config import ModelConfig

@@ -192,10 +192,17 @@ long mlops_encode_csv(const char* csv, long csv_len,
       float v = label_col < static_cast<int>(fields.size())
                     ? parse_numeric(fields[label_col])
                     : NAN;
-      // Corrupt labels fail fast — silently training on garbage labels is
-      // the one place lenient coercion is wrong (ingest.py mirrors this).
-      if (!std::isfinite(v)) return MLOPS_ERR_BAD_LABEL;
-      lab_out[row] = v;
+      if (!std::isfinite(v)) {
+        // Corrupt TRAINING labels fail fast — silently training on
+        // garbage is the one place lenient coercion is wrong (ingest.py
+        // mirrors this). On scoring paths a partially-blank target
+        // column just means the file is unlabeled.
+        if (require_label) return MLOPS_ERR_BAD_LABEL;
+        label_col = -1;
+        *has_label_out = 0;
+      } else {
+        lab_out[row] = v;
+      }
     }
     ++row;
   }

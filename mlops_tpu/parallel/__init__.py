@@ -16,10 +16,17 @@ module is the TPU-native capability the rebuild owes instead:
   the ICI ring via ppermute with online-softmax accumulation (long-context
   path for the BERT config; differentiable, so usable in training)
 
-Multi-host: ``jax.distributed.initialize`` + the same mesh spanning hosts —
-the DCN story is configuration, not new code (SURVEY.md SS5.8).
+- ``distributed``  multi-host (DCN) bring-up: env-detecting, idempotent
+  ``jax.distributed.initialize`` wrapper + coordinator predicate; the same
+  mesh code then spans hosts (SURVEY.md SS5.8)
+- ``ring_attention`` (below) and ``distributed`` together are the
+  long-context / multi-host capability the reference never had
 """
 
+from mlops_tpu.parallel.distributed import (
+    initialize as distributed_initialize,
+    is_coordinator,
+)
 from mlops_tpu.parallel.mesh import make_mesh, make_nd_mesh, mesh_shape_for
 from mlops_tpu.parallel.ring_attention import (
     make_ring_attention,
@@ -39,6 +46,8 @@ from mlops_tpu.parallel.steps import (
 __all__ = [
     "PARAM_RULES",
     "batch_sharding",
+    "distributed_initialize",
+    "is_coordinator",
     "make_mesh",
     "make_nd_mesh",
     "make_ring_attention",

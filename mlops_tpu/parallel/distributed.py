@@ -1,0 +1,86 @@
+"""Multi-host initialization — the DCN side of the comms story.
+
+The reference has no distributed communication backend at all (SURVEY.md
+SS5.8: HTTPS to managed control planes). The TPU-native answer has two
+layers, and this module is the second:
+
+1. **Within a slice (ICI)**: nothing to initialize — XLA lowers the
+   collectives in pjit/shard_map programs onto the ICI ring directly.
+2. **Across hosts (DCN)**: ``jax.distributed.initialize`` wires the
+   per-host JAX runtimes into one logical device set, after which the very
+   same ``Mesh``/``NamedSharding`` code spans all hosts' chips (data
+   arrives per-host; meshes built from ``jax.devices()`` are global).
+
+On Cloud TPU (GKE TPU podslices, TPU VMs) the coordinator address, process
+id, and process count are discoverable from the runtime environment, so
+``initialize()`` here is argument-free in the common case and an explicit
+escape hatch otherwise. Idempotent and single-host-safe: calling it on a
+laptop, in tests, or on a 1-host v5e slice is a no-op.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def multihost_env() -> dict | None:
+    """Detect a multi-host launch from the environment, if any.
+
+    Recognized conventions, in order:
+    - explicit ``MLOPS_TPU_COORDINATOR`` / ``MLOPS_TPU_PROCESS_ID`` /
+      ``MLOPS_TPU_NUM_PROCESSES`` (our own contract, set by the K8s JobSet
+      or mpirun wrapper),
+    - Cloud TPU pod env (``TPU_WORKER_HOSTNAMES``/``TPU_WORKER_ID``), which
+      ``jax.distributed.initialize()`` also auto-detects natively.
+    """
+    if "MLOPS_TPU_COORDINATOR" in os.environ:
+        return {
+            "coordinator_address": os.environ["MLOPS_TPU_COORDINATOR"],
+            "process_id": int(os.environ.get("MLOPS_TPU_PROCESS_ID", "0")),
+            "num_processes": int(os.environ.get("MLOPS_TPU_NUM_PROCESSES", "1")),
+        }
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) >= 2:
+        return {}  # >=2 workers: native auto-detection path
+    # A single-entry TPU_WORKER_HOSTNAMES (e.g. "localhost" on 1-host
+    # slices and dev containers) is NOT a pod launch.
+    return None
+
+
+def initialize(force: bool = False) -> bool:
+    """Initialize the DCN layer when the environment calls for it.
+
+    Returns True when ``jax.distributed.initialize`` ran (multi-host),
+    False when single-host (no-op). Safe to call more than once.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    env = multihost_env()
+    if env is None and not force:
+        logger.debug("single-host launch: skipping jax.distributed")
+        return False
+    if env and env.get("num_processes", 2) <= 1 and not force:
+        return False
+    jax.distributed.initialize(**(env or {}))
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.device_count(),
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write artifacts / registry entries
+    (in single-host runs: always)."""
+    return jax.process_index() == 0

@@ -196,18 +196,24 @@ class HttpServer:
             return 404, {"detail": "profiling disabled"}, "application/json"
         import jax
 
-        if action == "start":
-            if self._profiling:
-                return 409, {"detail": "trace already running"}, "application/json"
-            jax.profiler.start_trace(self.config.profile_dir)
-            self._profiling = True
-            return 200, {"status": "tracing", "dir": self.config.profile_dir}, "application/json"
-        if action == "stop":
-            if not self._profiling:
-                return 409, {"detail": "no trace running"}, "application/json"
-            jax.profiler.stop_trace()
+        try:
+            if action == "start":
+                if self._profiling:
+                    return 409, {"detail": "trace already running"}, "application/json"
+                jax.profiler.start_trace(self.config.profile_dir)
+                self._profiling = True
+                return 200, {"status": "tracing", "dir": self.config.profile_dir}, "application/json"
+            if action == "stop":
+                if not self._profiling:
+                    return 409, {"detail": "no trace running"}, "application/json"
+                jax.profiler.stop_trace()
+                self._profiling = False
+                return 200, {"status": "stopped", "dir": self.config.profile_dir}, "application/json"
+        except Exception as err:  # unwritable dir, profiler state errors:
+            # report, don't drop the connection
+            logger.exception("profiler %s failed", action)
             self._profiling = False
-            return 200, {"status": "stopped", "dir": self.config.profile_dir}, "application/json"
+            return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
         return 404, {"detail": "not found"}, "application/json"
 
     async def _predict(self, body: bytes):

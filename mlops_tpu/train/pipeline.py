@@ -13,6 +13,7 @@ import time
 from pathlib import Path
 from typing import Any
 
+import jax
 import numpy as np
 
 from mlops_tpu.bundle import ModelRegistry, save_bundle
@@ -35,6 +36,17 @@ class PipelineResult:
     model_uri: str | None
     train_result: TrainResult
     run_dir: Path
+
+
+def new_run_dir(config: Config, run_name: str | None = None) -> Path:
+    """The one place the run-directory convention lives:
+    ``<registry.run_root>/<timestamp-or-name>/`` (used by train, tune and
+    pretrain alike)."""
+    run_dir = Path(config.registry.run_root) / (
+        run_name or time.strftime("%Y%m%d-%H%M%S")
+    )
+    run_dir.mkdir(parents=True, exist_ok=True)
+    return run_dir
 
 
 def load_training_data(config: Config) -> tuple[dict[str, list], np.ndarray]:
@@ -108,8 +120,7 @@ def run_training(
          ``models:/<name>/<version>`` URI
     """
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S")
-    run_dir = Path(config.registry.run_root) / run_name
-    run_dir.mkdir(parents=True, exist_ok=True)
+    run_dir = new_run_dir(config, run_name)
 
     columns, labels = load_training_data(config)
     preprocessor = Preprocessor.fit(columns)
@@ -128,11 +139,24 @@ def run_training(
         )
     else:
         model = build_model(config.model)
+        init_variables = None
+        if config.train.init_params:
+            # Fine-tune from masked-feature pretraining (`pretrain` CLI):
+            # trunk comes from the MLM run, heads stay freshly initialized.
+            from mlops_tpu.models import init_params as fresh_init
+            from mlops_tpu.train.pretrain import load_pretrained_variables
+
+            init_variables = load_pretrained_variables(
+                config.train.init_params,
+                config.model,
+                fresh_init(model, jax.random.PRNGKey(config.train.seed)),
+            )
         result = fit(
             model,
             train_ds,
             valid_ds,
             config.train,
+            init_variables=init_variables,
             metrics_path=run_dir / "metrics.jsonl",
             checkpoint_dir=run_dir / "checkpoints",
         )

@@ -1,0 +1,49 @@
+"""Multi-host bring-up logic (env detection only — real DCN needs hosts)."""
+
+import jax
+
+from mlops_tpu.parallel import distributed
+
+
+def test_single_host_is_noop(monkeypatch):
+    monkeypatch.delenv("MLOPS_TPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert distributed.multihost_env() is None
+    assert distributed.initialize() is False
+
+
+def test_explicit_env_contract(monkeypatch):
+    monkeypatch.setenv("MLOPS_TPU_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("MLOPS_TPU_PROCESS_ID", "3")
+    monkeypatch.setenv("MLOPS_TPU_NUM_PROCESSES", "4")
+    env = distributed.multihost_env()
+    assert env == {
+        "coordinator_address": "10.0.0.1:8476",
+        "process_id": 3,
+        "num_processes": 4,
+    }
+
+
+def test_num_processes_one_stays_local(monkeypatch):
+    monkeypatch.setenv("MLOPS_TPU_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("MLOPS_TPU_NUM_PROCESSES", "1")
+    assert distributed.initialize() is False
+
+
+def test_tpu_pod_env_uses_native_autodetect(monkeypatch):
+    monkeypatch.delenv("MLOPS_TPU_COORDINATOR", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    assert distributed.multihost_env() == {}
+
+
+def test_single_worker_hostnames_is_not_a_pod(monkeypatch):
+    """1-host slices/dev containers export TPU_WORKER_HOSTNAMES=localhost;
+    that must NOT trigger jax.distributed (its autodetect would fail)."""
+    monkeypatch.delenv("MLOPS_TPU_COORDINATOR", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert distributed.multihost_env() is None
+    assert distributed.initialize() is False
+
+
+def test_is_coordinator_single_host():
+    assert distributed.is_coordinator() == (jax.process_index() == 0)

@@ -187,3 +187,27 @@ def test_corrupt_labels_fail_fast_both_paths(tmp_path):
         native.encode_csv_native(path, _tiny_prep(), require_target=True)
     with pytest.raises(ValueError, match="target"):
         load_csv_columns(path, require_target=True)
+
+
+def test_blank_labels_on_scoring_path_mean_unlabeled(tmp_path):
+    """Scoring files keeping an empty target column score fine (labels
+    -> None) on BOTH paths; only require_target fails fast."""
+    from mlops_tpu.data.ingest import load_csv_columns
+    from mlops_tpu.schema import SCHEMA
+
+    header = (
+        ",".join(f.name for f in SCHEMA.categorical)
+        + ","
+        + ",".join(f.name for f in SCHEMA.numeric)
+        + f",{SCHEMA.target}"
+    )
+    rows = [
+        ",".join(["male"] * SCHEMA.num_categorical + ["1.0"] * SCHEMA.num_numeric + ["1"]),
+        ",".join(["male"] * SCHEMA.num_categorical + ["1.0"] * SCHEMA.num_numeric + [""]),
+    ]
+    path = _edge_csv(tmp_path, rows, header=header)
+    prep = _tiny_prep()
+    got = native.encode_csv_native(path, prep)
+    assert got.labels is None and got.cat_ids.shape[0] == 2
+    _, labels = load_csv_columns(path)
+    assert labels is None

@@ -1,0 +1,82 @@
+"""Masked-feature pretraining: objective learns, trunk transfers."""
+
+import jax
+import numpy as np
+
+from mlops_tpu.config import ModelConfig
+from mlops_tpu.models import build_model, init_params
+from mlops_tpu.train.pretrain import (
+    build_mlm,
+    fine_tune_params,
+    pretrain_bert,
+)
+
+SMALL = ModelConfig(family="bert", token_dim=32, depth=2, heads=4, dropout=0.0)
+
+
+def test_mlm_loss_decreases(encoded_small):
+    _, ds = encoded_small
+    result = pretrain_bert(SMALL, ds, steps=120, batch_size=128, seed=0)
+    assert result.losses[-1] < result.losses[0] * 0.8, result.losses
+    assert np.isfinite(result.losses[-1])
+
+
+def test_value_positions_are_value_tokens():
+    model = build_mlm(SMALL)
+    layout = model.layout
+    pos = model.value_positions()
+    assert len(pos) == layout.num_features
+    assert pos[0] == 2 and pos[-1] == layout.seq_len - 2
+
+
+def test_trunk_transfer_into_classifier(encoded_small):
+    _, ds = encoded_small
+    pre = pretrain_bert(SMALL, ds, steps=20, batch_size=64, seed=1)
+
+    classifier = build_model(SMALL)
+    fresh = init_params(classifier, jax.random.PRNGKey(0))
+    grafted = fine_tune_params(pre, fresh)
+
+    # Trunk params must be the pretrained ones, heads the fresh ones.
+    np.testing.assert_array_equal(
+        np.asarray(grafted["params"]["tok_embed"]["embedding"]),
+        np.asarray(pre.params["tok_embed"]["embedding"]),
+    )
+    assert "mlm_head" not in grafted["params"]
+    assert "pooler" in grafted["params"]
+
+    # And the classifier must run with the grafted tree.
+    rng = np.random.default_rng(0)
+    cat = np.asarray(ds.cat_ids[:4])
+    num = np.asarray(ds.numeric[:4])
+    logits = classifier.apply(grafted, cat, num, train=False)
+    assert logits.shape == (4,)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_pretrain_cli_to_finetune_roundtrip(tmp_path):
+    """pretrain CLI output feeds train train.init_params end-to-end."""
+    from mlops_tpu.config import Config, TrainConfig
+    from mlops_tpu.train.pipeline import run_training
+    from mlops_tpu.train.pretrain import pretrain_bert, save_pretrained
+    from mlops_tpu.data import generate_synthetic, Preprocessor
+
+    columns, _ = generate_synthetic(800, seed=5)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns)
+    pre = pretrain_bert(SMALL, ds, steps=15, batch_size=64, seed=2)
+    path = tmp_path / "pretrained.msgpack"
+    save_pretrained(pre, path)
+
+    config = Config()
+    config.data.rows = 800
+    config.model = SMALL
+    config.train = TrainConfig(
+        steps=15, eval_every=15, batch_size=64, init_params=str(path)
+    )
+    config.registry.root = str(tmp_path / "registry")
+    config.registry.run_root = str(tmp_path / "runs")
+    result = run_training(config, register=False)
+    assert np.isfinite(
+        result.train_result.metrics["validation_roc_auc_score"]
+    )
