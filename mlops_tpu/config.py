@@ -87,6 +87,9 @@ class ServeConfig:
     max_batch: int = 256  # request-size cap; must equal the largest warmed
     # bucket so steady-state serving never compiles a novel shape
     warmup_batch_sizes: tuple[int, ...] = (1, 8, 64, 256)
+    batch_window_ms: float = 1.0  # micro-batching window: concurrent small
+    # requests arriving within it coalesce into one vmapped dispatch
+    # (serve/batcher.py); 0 disables coalescing
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable

@@ -68,7 +68,15 @@ def initialize(force: bool = False) -> bool:
         logger.debug("single-host launch: skipping jax.distributed")
         return False
     if env and env.get("num_processes", 2) <= 1 and not force:
-        return False
+        # A coordinator with <2 processes is an inconsistent launch env
+        # (e.g. MLOPS_TPU_NUM_PROCESSES forgotten). Running each host as an
+        # independent job would silently train N divergent models — fail
+        # fast instead.
+        raise ValueError(
+            "MLOPS_TPU_COORDINATOR is set but MLOPS_TPU_NUM_PROCESSES is "
+            f"{env.get('num_processes')}; a multi-host launch needs >= 2 "
+            "(unset the coordinator for single-host runs)"
+        )
     jax.distributed.initialize(**(env or {}))
     _initialized = True
     logger.info(

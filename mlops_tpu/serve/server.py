@@ -23,6 +23,7 @@ import pydantic
 
 from mlops_tpu.config import ServeConfig
 from mlops_tpu.schema import LoanApplicant
+from mlops_tpu.serve.batcher import MicroBatcher
 from mlops_tpu.serve.engine import InferenceEngine
 from mlops_tpu.serve.metrics import ServingMetrics
 
@@ -68,6 +69,9 @@ class HttpServer:
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
+        self.batcher = MicroBatcher(
+            engine, self._executor, window_ms=config.batch_window_ms
+        )
 
     # ----------------------------------------------------------- HTTP layer
     async def handle_connection(
@@ -249,11 +253,10 @@ class HttpServer:
                 }
             )
         )
-        loop = asyncio.get_running_loop()
         try:
-            response = await loop.run_in_executor(
-                self._executor, self.engine.predict_records, record_dicts
-            )
+            # Small concurrent requests coalesce into one vmapped dispatch
+            # (serve/batcher.py); everything else runs solo in the pool.
+            response = await self.batcher.predict(record_dicts)
         except Exception:
             logger.exception("prediction failed request_id=%s", request_id)
             return 500, {"detail": "prediction failed"}, "application/json"

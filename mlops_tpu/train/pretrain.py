@@ -121,11 +121,11 @@ def pretrain_bert(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, rng), loss
 
-    @partial(jax.jit, static_argnums=2)
-    def run(carry, rng, n_steps):
+    @partial(jax.jit, static_argnums=1)
+    def run(carry, n_steps):
         return jax.lax.scan(step, carry, None, length=n_steps)
 
-    (params, opt_state, rng), losses = run((params, opt_state, rng), rng, steps)
+    (params, opt_state, rng), losses = run((params, opt_state, rng), steps)
     losses = np.asarray(jax.device_get(losses))
     # Coarse loss curve (10 buckets) for logging/tests.
     chunks = np.array_split(losses, min(10, len(losses)))

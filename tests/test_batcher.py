@@ -1,0 +1,116 @@
+"""Micro-batching: grouped dispatch must be invisible to each request."""
+
+import asyncio
+import concurrent.futures
+
+import numpy as np
+import pytest
+
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.serve.batcher import MicroBatcher
+from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_pipeline):
+    _, result = tiny_pipeline
+    eng = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8, 64))
+    eng.warmup()
+    return eng
+
+
+def _requests(sample_request, k):
+    reqs = []
+    for i in range(k):
+        rec = dict(sample_request[0])
+        rec["age"] = 20.0 + i
+        rec["credit_limit"] = 1000.0 * (i + 1)
+        reqs.append([rec] * ((i % 3) + 1))  # sizes 1..3
+    return reqs
+
+
+def test_grouped_matches_solo(engine, sample_request):
+    reqs = _requests(sample_request, 5)
+    grouped = engine.predict_group(reqs)
+    for req, got in zip(reqs, grouped):
+        solo = engine.predict_records(req)
+        assert len(got["predictions"]) == len(req)
+        np.testing.assert_allclose(
+            got["predictions"], solo["predictions"], atol=1e-5
+        )
+        np.testing.assert_allclose(got["outliers"], solo["outliers"], atol=1e-6)
+        for k in solo["feature_drift_batch"]:
+            assert (
+                abs(got["feature_drift_batch"][k] - solo["feature_drift_batch"][k])
+                < 1e-4
+            ), k
+
+
+def test_batcher_coalesces_concurrent_requests(engine, sample_request):
+    calls = {"group": 0, "solo": 0}
+    real_group = engine.predict_group
+
+    def counting_group(reqs):
+        calls["group"] += 1
+        calls["last_size"] = len(reqs)
+        return real_group(reqs)
+
+    engine_proxy = engine
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+
+    async def drive():
+        batcher = MicroBatcher(engine_proxy, executor, window_ms=20.0)
+        batcher.engine.predict_group = counting_group
+        try:
+            reqs = _requests(sample_request, 6)
+            return await asyncio.gather(*(batcher.predict(r) for r in reqs))
+        finally:
+            batcher.engine.predict_group = real_group
+
+    responses = asyncio.run(drive())
+    assert len(responses) == 6
+    assert calls["group"] >= 1
+    assert calls["last_size"] > 1, "concurrent requests should coalesce"
+    for req, got in zip(_requests(sample_request, 6), responses):
+        assert len(got["predictions"]) == len(req)
+
+
+def test_large_requests_bypass_batcher(engine, sample_request):
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+
+    async def drive():
+        batcher = MicroBatcher(engine, executor, window_ms=5.0)
+        big = [dict(sample_request[0])] * (GROUP_ROW_BUCKET + 5)
+        return await batcher.predict(big)
+
+    response = asyncio.run(drive())
+    assert len(response["predictions"]) == GROUP_ROW_BUCKET + 5
+
+
+def test_disabled_window_runs_solo(engine, sample_request):
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+
+    async def drive():
+        batcher = MicroBatcher(engine, executor, window_ms=0.0)
+        assert not batcher.enabled
+        return await batcher.predict(sample_request)
+
+    response = asyncio.run(drive())
+    assert len(response["predictions"]) == 1
+
+
+def test_sklearn_flavor_has_no_group_path(tmp_path):
+    from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.train.pipeline import run_training
+
+    config = Config()
+    config.data.rows = 1200
+    config.model = ModelConfig(family="gbm", n_estimators=10, max_tree_depth=3)
+    config.train = TrainConfig(steps=1)
+    config.registry.root = str(tmp_path / "reg")
+    config.registry.run_root = str(tmp_path / "runs")
+    result = run_training(config, register=False)
+    eng = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8))
+    assert not eng.supports_grouping
+    out = eng.predict_group([[{"age": 30.0}], [{"age": 40.0}]])
+    assert len(out) == 2

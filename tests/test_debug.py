@@ -1,0 +1,58 @@
+"""Numeric sanitizers (SURVEY.md SS5.2 build stance)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import checkify
+
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.utils.debug import check_encoded_inputs, checked
+
+
+def test_checked_passes_clean_fn():
+    fn = checked(lambda x: jnp.log(x + 1.0))
+    out = fn(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(out), np.log(2.0), rtol=1e-6)
+
+
+def test_checked_raises_on_nan():
+    fn = checked(lambda x: jnp.log(x))  # log(-1) -> NaN
+    with pytest.raises(checkify.JaxRuntimeError):
+        fn(-jnp.ones(4))
+
+
+def test_checked_predict_fn_on_bundle(tiny_pipeline):
+    """The served fused predict is NaN-clean under float_checks."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.ops.predict import make_padded_predict_fn
+
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+    predict = make_padded_predict_fn(
+        bundle.model, bundle.variables, bundle.monitor
+    )
+    wrapped = checked(predict.__wrapped__, jit=True)
+    cat = np.zeros((4, SCHEMA.num_categorical), np.int32)
+    num = np.zeros((4, SCHEMA.num_numeric), np.float32)
+    out = wrapped(cat, num, np.ones(4, bool))
+    assert np.isfinite(np.asarray(out["predictions"])).all()
+
+
+def test_check_encoded_inputs():
+    n = 3
+    cat = np.zeros((n, SCHEMA.num_categorical), np.int32)
+    num = np.zeros((n, SCHEMA.num_numeric), np.float32)
+    check_encoded_inputs(cat, num)  # clean
+
+    bad_cat = cat.copy()
+    bad_cat[1, 2] = 10_000
+    with pytest.raises(ValueError, match="out of range"):
+        check_encoded_inputs(bad_cat, num)
+
+    bad_num = num.copy()
+    bad_num[0, 0] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        check_encoded_inputs(cat, bad_num)
+
+    with pytest.raises(ValueError, match="shape"):
+        check_encoded_inputs(cat[:, :3], num)

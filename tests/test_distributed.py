@@ -24,10 +24,18 @@ def test_explicit_env_contract(monkeypatch):
     }
 
 
-def test_num_processes_one_stays_local(monkeypatch):
+def test_coordinator_without_process_count_fails_fast(monkeypatch):
+    """A coordinator with <2 processes is an inconsistent launch env;
+    running on silently would train N divergent models."""
+    import pytest
+
     monkeypatch.setenv("MLOPS_TPU_COORDINATOR", "10.0.0.1:8476")
     monkeypatch.setenv("MLOPS_TPU_NUM_PROCESSES", "1")
-    assert distributed.initialize() is False
+    with pytest.raises(ValueError, match="NUM_PROCESSES"):
+        distributed.initialize()
+    monkeypatch.delenv("MLOPS_TPU_NUM_PROCESSES", raising=False)
+    with pytest.raises(ValueError, match="NUM_PROCESSES"):
+        distributed.initialize()
 
 
 def test_tpu_pod_env_uses_native_autodetect(monkeypatch):
