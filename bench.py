@@ -21,6 +21,12 @@ import time
 
 
 def main() -> None:
+    # Honor an explicit JAX_PLATFORMS env (the container bootstrap otherwise
+    # pins the TPU backend, hanging CPU-only runs on the tunnel dial).
+    from mlops_tpu.commands import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+
     import jax
     import numpy as np
 
@@ -42,7 +48,9 @@ def main() -> None:
     result = run_training(config, register=False, run_name="bench")
     bundle = load_bundle(result.bundle_dir)
 
-    engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256))
+    # Grouping off: the bench measures sequential batch-1 latency and bulk
+    # throughput; the 3 grouped-shape compiles would be dead weight.
+    engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256), enable_grouping=False)
     engine.warmup()
 
     # --- batch-1 latency through the full serving path -------------------

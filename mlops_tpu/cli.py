@@ -28,6 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
         ("pretrain", "masked-feature pretraining on unlabeled rows (bert)"),
         ("tune", "hyperparameter search (vmapped + sharded trials)"),
         ("register", "register a bundle in the model registry"),
+        ("promote", "move a registered version between stages"),
+        ("versions", "list registered versions, stages, tags"),
         ("serve", "serve a bundle over HTTP"),
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),

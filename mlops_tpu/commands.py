@@ -144,6 +144,40 @@ def _register(config) -> int:
     return 0
 
 
+def _promote(config) -> int:
+    """Stage promotion (`mlops-tpu promote registry.promote_version=3
+    registry.promote_stage=production`) — the registry-level half of the
+    reference's staging->production gate (the image-level half lives in the
+    deploy workflow's Production environment review)."""
+    from mlops_tpu.bundle import ModelRegistry
+
+    version = config.registry.promote_version
+    stage = config.registry.promote_stage
+    if not version:
+        raise SystemExit(
+            "pass registry.promote_version=<n> [registry.promote_stage=staging]"
+        )
+    registry = ModelRegistry(config.registry.root)
+    registry.set_stage(config.registry.model_name, int(version), stage)
+    print(
+        json.dumps(
+            {"model": config.registry.model_name, "version": int(version),
+             "stage": stage}
+        )
+    )
+    return 0
+
+
+def _versions(config) -> int:
+    from mlops_tpu.bundle import ModelRegistry
+
+    registry = ModelRegistry(config.registry.root)
+    print(
+        json.dumps(registry.list_versions(config.registry.model_name), indent=2)
+    )
+    return 0
+
+
 def _predict_file(config) -> int:
     """Batch-score a schema CSV offline with the full fused predict (works
     for both bundle flavors — flax on device, sklearn floor on host)."""
@@ -264,6 +298,7 @@ def _serve(config) -> int:
         bundle,
         buckets=tuple(config.serve.warmup_batch_sizes),
         service_name=config.serve.service_name,
+        enable_grouping=config.serve.batch_window_ms > 0,
     )
     serve_forever(engine, config.serve)
     return 0
@@ -275,6 +310,8 @@ _HANDLERS = {
     "pretrain": _pretrain,
     "tune": _tune,
     "register": _register,
+    "promote": _promote,
+    "versions": _versions,
     "predict-file": _predict_file,
     "score-batch": _score_batch,
     "bench": _bench,

@@ -104,6 +104,8 @@ class RegistryConfig:
     experiment_name: str = "credit-default-uci-train"  # parity: parent
     # MLflow run name (`01-train-model.ipynb` cell 8)
     run_root: str = "runs"  # per-run artifacts: metrics.jsonl, checkpoints
+    promote_version: str = ""  # `promote` CLI: version to move
+    promote_stage: str = "staging"  # `promote` CLI: target stage
 
 
 @dataclasses.dataclass

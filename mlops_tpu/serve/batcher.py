@@ -19,7 +19,11 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
-from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, InferenceEngine
+from mlops_tpu.serve.engine import (
+    GROUP_ROW_BUCKET,
+    GROUP_SLOT_BUCKETS,
+    InferenceEngine,
+)
 
 
 class MicroBatcher:
@@ -40,7 +44,9 @@ class MicroBatcher:
         self.engine = engine
         self._executor = executor
         self.window_s = window_ms / 1e3
-        self.max_group = max_group
+        # A group can never exceed the largest warmed slot bucket — beyond
+        # it predict_group would have no compiled shape to run.
+        self.max_group = min(max_group, GROUP_SLOT_BUCKETS[-1])
         self._pending: list[tuple[list[dict], asyncio.Future]] = []
         self._drain_task: asyncio.Task | None = None
         self._full = asyncio.Event()  # set when a full group is waiting
@@ -79,10 +85,10 @@ class MicroBatcher:
                     await asyncio.wait_for(self._full.wait(), self.window_s)
                 except asyncio.TimeoutError:
                     pass
+            # The loop guard + single-consumer invariant guarantee batch is
+            # non-empty (predict() only appends).
             batch = self._pending[: self.max_group]
-            del self._pending[: len(batch)]
-            if not batch:
-                continue
+            del self._pending[: self.max_group]
             requests = [records for records, _ in batch]
             try:
                 responses = await loop.run_in_executor(

@@ -144,7 +144,11 @@ class InferenceEngine:
         enforces this); responses are exactly what each request would get
         from ``predict_records`` alone — per-request drift included.
         """
-        if self._predict_group is None or len(requests) == 1:
+        if (
+            self._predict_group is None
+            or len(requests) == 1
+            or len(requests) > GROUP_SLOT_BUCKETS[-1]
+        ):
             return [self.predict_records(r) for r in requests]
         sizes = [len(r) for r in requests]
         assert all(1 <= n <= GROUP_ROW_BUCKET for n in sizes)
