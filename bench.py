@@ -20,14 +20,54 @@ import json
 import time
 
 
+def _acquire_device(timeout_s: int):
+    """First device, with a watchdog: probe TPU init in a SUBPROCESS (the
+    tunnel dial blocks in C++ where in-process alarms can't interrupt);
+    if the probe doesn't come back healthy in time, pin this process to
+    CPU so the bench always emits its one JSON line instead of hanging a
+    round. An explicit JAX_PLATFORMS env skips the probe."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    if not os.environ.get("JAX_PLATFORMS"):
+        try:
+            # DEVNULL, not pipes: the TPU plugin forks tunnel helpers that
+            # inherit stdio; after the timeout-kill a captured pipe would
+            # keep subprocess.run blocked on EOF forever.
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            healthy = probe.returncode == 0
+        except subprocess.TimeoutExpired:
+            healthy = False
+        if not healthy:
+            print(
+                f"# tpu backend not healthy within {timeout_s}s; "
+                "benchmarking on cpu",
+                flush=True,
+            )
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass
+    return jax.devices()[0]
+
+
 def main() -> None:
     # Honor an explicit JAX_PLATFORMS env (the container bootstrap otherwise
     # pins the TPU backend, hanging CPU-only runs on the tunnel dial).
+    import os
+
     from mlops_tpu.commands import _honor_jax_platforms_env
 
     _honor_jax_platforms_env()
 
-    import jax
     import numpy as np
 
     from mlops_tpu.bundle import load_bundle
@@ -36,7 +76,7 @@ def main() -> None:
     from mlops_tpu.train.pipeline import run_training
     from mlops_tpu.utils.timing import percentile
 
-    device = jax.devices()[0]
+    device = _acquire_device(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "300")))
 
     config = Config()
     config.data.rows = 50_000
