@@ -6,81 +6,234 @@ CPU if forced). Flow: train the flagship serving model briefly, build the
 warmed engine, then measure:
 
 - batch-1 end-to-end latency through the full serving path
-  (records -> encode -> device -> classifier+drift+outlier -> host), and
-- bulk throughput at the largest serving bucket.
+  (records -> encode -> device -> classifier+drift+outlier -> host),
+  decomposed into encode / dispatch / fetch stages,
+- bulk throughput at buckets {256, 4096, 16384} plus a pipelined sweep
+  (dispatch all chunks, one batched fetch), and
+- HTTP-level req/s through the real asyncio server + micro-batcher at
+  client concurrency {1, 8, 32}.
 
-Prints ONE JSON line:
+Prints ONE JSON line no matter what:
 ``{"metric", "value", "unit", "vs_baseline", ...extras}`` where
 ``vs_baseline`` = (5 ms target) / (measured p50) — >1.0 beats the target.
+A crash prints the same shape with an ``"error"`` field (exit code 1).
+
+Env knobs: ``BENCH_MODEL`` (mlp|gbm, default mlp), ``BENCH_TPU_TIMEOUT_S``
+(TPU health-probe watchdog, default 300), ``JAX_PLATFORMS`` (force a
+backend; honored via mlops_tpu's config re-assert before backend init).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
+_REEXEC_FLAG = "BENCH_FORCED_CPU"
 
-def _acquire_device(timeout_s: int):
-    """First device, with a watchdog: probe TPU init in a SUBPROCESS (the
-    tunnel dial blocks in C++ where in-process alarms can't interrupt);
-    if the probe doesn't come back healthy in time, pin this process to
-    CPU so the bench always emits its one JSON line instead of hanging a
-    round. An explicit JAX_PLATFORMS env skips the probe."""
-    import os
+
+def _ensure_healthy_backend(timeout_s: int) -> None:
+    """Probe TPU init in a SUBPROCESS (the tunnel dial blocks in C++ where
+    in-process alarms can't interrupt). If the probe doesn't come back
+    healthy in time, RE-EXEC this process under ``JAX_PLATFORMS=cpu`` —
+    the in-process ``jax.config.update`` fallback is shadowed whenever the
+    site bootstrap already initialized the backend (BENCH_r01 failure
+    mode), while a fresh process + the env re-assert in
+    ``_honor_jax_platforms_env`` cannot be. An explicit ``JAX_PLATFORMS``
+    env (or a prior re-exec) skips the probe."""
     import subprocess
-    import sys
 
+    if os.environ.get("JAX_PLATFORMS") or os.environ.get(_REEXEC_FLAG):
+        return
+    try:
+        # DEVNULL, not pipes: the TPU plugin forks tunnel helpers that
+        # inherit stdio; after the timeout-kill a captured pipe would
+        # keep subprocess.run blocked on EOF forever.
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        healthy = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        healthy = False
+    if not healthy:
+        print(
+            f"# tpu backend not healthy within {timeout_s}s; "
+            "re-exec on cpu",
+            flush=True,
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **{_REEXEC_FLAG: "1"})
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def _percentile(sorted_ms: list[float], q: float) -> float:
+    from mlops_tpu.utils.timing import percentile
+
+    return percentile(sorted_ms, q)
+
+
+def _batch1_stage(engine, record) -> dict:
+    """p50/p99 of the full serving path + a stage breakdown."""
     import jax
+    import numpy as np
 
-    if not os.environ.get("JAX_PLATFORMS"):
-        try:
-            # DEVNULL, not pipes: the TPU plugin forks tunnel helpers that
-            # inherit stdio; after the timeout-kill a captured pipe would
-            # keep subprocess.run blocked on EOF forever.
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            )
-            healthy = probe.returncode == 0
-        except subprocess.TimeoutExpired:
-            healthy = False
-        if not healthy:
-            print(
-                f"# tpu backend not healthy within {timeout_s}s; "
-                "benchmarking on cpu",
-                flush=True,
-            )
+    from mlops_tpu.schema import records_to_columns
+
+    for _ in range(20):  # post-warmup steady state
+        engine.predict_records([record])
+    lat = []
+    for _ in range(150):
+        t0 = time.perf_counter()
+        engine.predict_records([record])
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+
+    # Stage decomposition (medians over 50 reps): host encode, async
+    # dispatch (call returns futures), blocking fetch of the result tree.
+    enc, disp, fetch = [], [], []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        columns = records_to_columns([record])
+        ds = engine.bundle.preprocessor.encode(columns)
+        t1 = time.perf_counter()
+        mask = np.ones((1,), bool)
+        out = engine._predict(ds.cat_ids, ds.numeric, mask)
+        t2 = time.perf_counter()
+        jax.device_get(out)
+        t3 = time.perf_counter()
+        enc.append((t1 - t0) * 1e3)
+        disp.append((t2 - t1) * 1e3)
+        fetch.append((t3 - t2) * 1e3)
+    mid = len(enc) // 2
+    return {
+        "p50_ms": _percentile(lat, 50),
+        "p99_ms": _percentile(lat, 99),
+        "breakdown_ms": {
+            "encode": round(sorted(enc)[mid], 3),
+            "dispatch": round(sorted(disp)[mid], 3),
+            "fetch": round(sorted(fetch)[mid], 3),
+        },
+    }
+
+
+def _bulk_stage(engine, bundle) -> dict:
+    """rows/s at fixed buckets (sequential, one blocking call per batch)
+    and pipelined (dispatch all chunks, single batched device_get)."""
+    import numpy as np
+
+    from mlops_tpu.data.encode import EncodedDataset
+    from mlops_tpu.parallel.bulk import score_dataset
+    from mlops_tpu.schema import SCHEMA
+
+    rng = np.random.default_rng(0)
+    out: dict[str, float] = {}
+    for n, reps in ((256, 20), (4096, 10), (16384, 5)):
+        cat = rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
+        num = rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32)
+        engine.predict_arrays(cat, num)  # warm this bucket
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            engine.predict_arrays(cat, num)
+        dt = time.perf_counter() - t0
+        out[f"bulk_rows_per_s_b{n}"] = round(reps * n / dt, 1)
+
+    # Pipelined sweep: 262,144 rows through the chunked bulk scorer.
+    n = 262_144
+    ds = EncodedDataset(
+        cat_ids=rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32),
+        numeric=rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32),
+        labels=None,
+    )
+    result = score_dataset(bundle, ds, mesh=None, chunk_rows=16_384)
+    out["bulk_rows_per_s_pipelined"] = round(result.rows_per_s, 1)
+    return out
+
+
+def _http_stage(engine, record) -> dict:
+    """req/s through the real HTTP server + micro-batcher at client
+    concurrency {1, 8, 32} (keep-alive, batch-1 bodies)."""
+    import asyncio
+
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.serve.server import HttpServer
+
+    body = json.dumps([record]).encode()
+
+    async def run() -> dict:
+        config = ServeConfig(host="127.0.0.1", port=0)
+        server = HttpServer(engine, config)
+        srv = await asyncio.start_server(server.handle_connection, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        results = {}
+
+        async def client(n_requests: int) -> None:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            head = (
+                "POST /predict HTTP/1.1\r\nhost: x\r\n"
+                "content-type: application/json\r\n"
+                f"content-length: {len(body)}\r\n\r\n"
+            ).encode()
+            for _ in range(n_requests):
+                writer.write(head + body)
+                await writer.drain()
+                # Read status + headers, then exactly content-length bytes.
+                line = await reader.readline()
+                assert b"200" in line, line
+                length = 0
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n"):
+                        break
+                    if h.lower().startswith(b"content-length:"):
+                        length = int(h.split(b":")[1])
+                await reader.readexactly(length)
+            writer.close()
             try:
-                jax.config.update("jax_platforms", "cpu")
-            except RuntimeError:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
                 pass
-    return jax.devices()[0]
+
+        for concurrency, per_client in ((1, 20), (8, 15), (32, 10)):
+            await asyncio.gather(*[client(3) for _ in range(min(concurrency, 4))])
+            t0 = time.perf_counter()
+            await asyncio.gather(*[client(per_client) for _ in range(concurrency)])
+            dt = time.perf_counter() - t0
+            results[f"http_req_per_s_c{concurrency}"] = round(
+                concurrency * per_client / dt, 1
+            )
+        srv.close()
+        await srv.wait_closed()
+        return results
+
+    return asyncio.run(run())
 
 
 def main() -> None:
     # Honor an explicit JAX_PLATFORMS env (the container bootstrap otherwise
     # pins the TPU backend, hanging CPU-only runs on the tunnel dial).
-    import os
+    _ensure_healthy_backend(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "300")))
 
     from mlops_tpu.commands import _honor_jax_platforms_env
 
     _honor_jax_platforms_env()
 
-    import numpy as np
+    import jax
 
     from mlops_tpu.bundle import load_bundle
     from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.schema import LoanApplicant
     from mlops_tpu.serve.engine import InferenceEngine
     from mlops_tpu.train.pipeline import run_training
-    from mlops_tpu.utils.timing import percentile
 
-    device = _acquire_device(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "300")))
+    device = jax.devices()[0]
+    family = os.environ.get("BENCH_MODEL", "mlp")
 
     config = Config()
     config.data.rows = 50_000
-    config.model = ModelConfig(family="mlp")
+    config.model = ModelConfig(family=family)
     config.train = TrainConfig(
         batch_size=1024, steps=600, eval_every=600, warmup_steps=60
     )
@@ -88,41 +241,15 @@ def main() -> None:
     result = run_training(config, register=False, run_name="bench")
     bundle = load_bundle(result.bundle_dir)
 
-    # Grouping off: the bench measures sequential batch-1 latency and bulk
-    # throughput; the 3 grouped-shape compiles would be dead weight.
-    engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256), enable_grouping=False)
+    engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256, 4096, 16384))
     engine.warmup()
 
-    # --- batch-1 latency through the full serving path -------------------
-    from mlops_tpu.schema import LoanApplicant
-
     record = LoanApplicant().model_dump()
-    for _ in range(20):  # post-warmup steady state
-        engine.predict_records([record])
-    latencies = []
-    for _ in range(300):
-        t0 = time.perf_counter()
-        engine.predict_records([record])
-        latencies.append((time.perf_counter() - t0) * 1e3)
-    latencies.sort()
-    p50 = percentile(latencies, 50)
-    p99 = percentile(latencies, 99)
+    batch1 = _batch1_stage(engine, record)
+    bulk = _bulk_stage(engine, bundle)
+    http = _http_stage(engine, record)
 
-    # --- bulk throughput at the largest bucket ---------------------------
-    rng = np.random.default_rng(0)
-    from mlops_tpu.schema import SCHEMA
-
-    n = 256
-    cat = rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
-    num = rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32)
-    engine.predict_arrays(cat, num)
-    t0 = time.perf_counter()
-    reps = 50
-    for _ in range(reps):
-        engine.predict_arrays(cat, num)
-    dt = time.perf_counter() - t0
-    rows_per_s = reps * n / dt
-
+    p50 = batch1["p50_ms"]
     print(
         json.dumps(
             {
@@ -130,10 +257,13 @@ def main() -> None:
                 "value": round(p50, 4),
                 "unit": "ms",
                 "vs_baseline": round(5.0 / p50, 3),
-                "p99_ms": round(p99, 4),
+                "p99_ms": round(batch1["p99_ms"], 4),
                 "batch1_req_per_s": round(1e3 / p50, 1),
-                "bulk_rows_per_s": round(rows_per_s, 1),
+                "breakdown_ms": batch1["breakdown_ms"],
+                **bulk,
+                **http,
                 "device": str(device),
+                "model": family,
                 "model_auc": round(
                     result.train_result.metrics["validation_roc_auc_score"], 4
                 ),
@@ -143,4 +273,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as err:  # the one-JSON-line contract survives
+        # crashes: emit a parseable line with the failure, then exit 1.
+        print(
+            json.dumps(
+                {
+                    "metric": "inference_p50_latency_ms",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(err).__name__}: {err}",
+                }
+            ),
+            flush=True,
+        )
+        raise SystemExit(1)

@@ -90,6 +90,9 @@ class ServeConfig:
     batch_window_ms: float = 1.0  # micro-batching window: concurrent small
     # requests arriving within it coalesce into one vmapped dispatch
     # (serve/batcher.py); 0 disables coalescing
+    max_group: int = 64  # most requests one vmapped dispatch may carry;
+    # clamped to the largest warmed slot bucket. Large groups are what
+    # amortize the flat per-dispatch transport round trip into req/s
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable

@@ -17,6 +17,7 @@
 // C ABI only (called via ctypes from mlops_tpu.native); no Python.h, no
 // external deps; builds with plain `g++ -O3 -shared -fPIC`.
 
+#include <cctype>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
@@ -79,14 +80,32 @@ std::vector<std::string> split_on(const std::string& s, char sep) {
 
 // Python-float() parity: the WHOLE trimmed cell must parse (reject
 // trailing garbage like "1.5abc") and hex literals are rejected (strtof
-// accepts "0x1A"; Python float() does not).
+// accepts "0x1A"; Python float() does not). Underscore separators follow
+// Python's numeric-literal rule — float("1_000") == 1000.0, but an
+// underscore is only valid BETWEEN two digits ("_1", "1_", "1__0",
+// "1_.5" all raise) — so validate placement, strip, then parse.
 float parse_numeric(const std::string& s) {
   if (s.empty()) return NAN;
   if (s.find('x') != std::string::npos || s.find('X') != std::string::npos)
     return NAN;
+  std::string cleaned;
+  if (s.find('_') != std::string::npos) {
+    for (size_t i = 0; i < s.size(); ++i) {
+      if (s[i] == '_') {
+        bool digit_before = i > 0 && std::isdigit((unsigned char)s[i - 1]);
+        bool digit_after =
+            i + 1 < s.size() && std::isdigit((unsigned char)s[i + 1]);
+        if (!digit_before || !digit_after) return NAN;
+      } else {
+        cleaned.push_back(s[i]);
+      }
+    }
+  } else {
+    cleaned = s;
+  }
   char* endp = nullptr;
-  float v = std::strtof(s.c_str(), &endp);
-  if (endp == s.c_str()) return NAN;  // unparseable -> treated as missing
+  float v = std::strtof(cleaned.c_str(), &endp);
+  if (endp == cleaned.c_str()) return NAN;  // unparseable -> missing
   while (*endp == ' ' || *endp == '\t') ++endp;  // float() strips whitespace
   if (*endp != '\0') return NAN;  // trailing garbage -> missing
   return v;

@@ -146,7 +146,14 @@ def score_dataset(
         scorer(cat0, num0, np.arange(chunk) < warm_rows)[0]
     )
 
+    # Pipeline the sweep: dispatch every chunk first (JAX queues the
+    # host->device copies and kernels asynchronously), then fetch ALL
+    # results in one batched device_get. Blocking per chunk would pay one
+    # full transport round trip each (~70 ms on a tunnel-attached chip);
+    # a single batched fetch pays one round trip total plus bandwidth.
     t0 = time.perf_counter()
+    spans: list[tuple[int, int]] = []
+    device_outs = []
     for start in range(0, n, chunk):
         stop = min(start + chunk, n)
         size = stop - start
@@ -156,9 +163,12 @@ def score_dataset(
             cat = np.pad(cat, ((0, chunk - size), (0, 0)))
             num = np.pad(num, ((0, chunk - size), (0, 0)))
         mask = np.arange(chunk) < size
-        probs, flags = scorer(cat, num, mask)
-        predictions[start:stop] = np.asarray(probs)[:size]
-        outliers[start:stop] = np.asarray(flags)[:size]
+        spans.append((start, stop))
+        device_outs.append(scorer(cat, num, mask))
+    for (start, stop), (probs, flags) in zip(spans, jax.device_get(device_outs)):
+        size = stop - start
+        predictions[start:stop] = probs[:size]
+        outliers[start:stop] = flags[:size]
     elapsed = time.perf_counter() - t0
 
     # Dataset-level drift on a bounded uniform sample (see module docstring).

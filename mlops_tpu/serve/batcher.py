@@ -39,7 +39,7 @@ class MicroBatcher:
         engine: InferenceEngine,
         executor,
         window_ms: float = 1.0,
-        max_group: int = 8,
+        max_group: int = GROUP_SLOT_BUCKETS[-1],
     ):
         self.engine = engine
         self._executor = executor

@@ -70,7 +70,10 @@ class HttpServer:
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
         self.batcher = MicroBatcher(
-            engine, self._executor, window_ms=config.batch_window_ms
+            engine,
+            self._executor,
+            window_ms=config.batch_window_ms,
+            max_group=config.max_group,
         )
 
     # ----------------------------------------------------------- HTTP layer

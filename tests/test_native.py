@@ -134,6 +134,29 @@ def test_parity_stray_quote_and_garbage_numerics(tmp_path):
     np.testing.assert_allclose(got.numeric[0, :2], 0.0, atol=1e-6)
 
 
+def test_parity_underscore_numeric_literals(tmp_path):
+    """Python's float() accepts underscore separators between digits
+    (float("1_000") == 1000.0) and rejects every other placement; the
+    native parser must agree cell-for-cell."""
+    from mlops_tpu.schema import SCHEMA
+
+    cats = ["male"] * SCHEMA.num_categorical
+    pad = ["2.0"] * (SCHEMA.num_numeric - 4)
+    valid = "1_000"        # -> 1000.0
+    bad_lead = "_1"        # -> median
+    bad_trail = "1_"       # -> median
+    bad_double = "1__0"    # -> median
+    path = _edge_csv(
+        tmp_path,
+        [",".join(cats + [valid, bad_lead, bad_trail, bad_double] + pad)],
+    )
+    got, want = _both_paths(path, _tiny_prep())
+    # Underscored thousands parse like the plain literal would; the three
+    # malformed ones impute to the median (=1.0 -> standardized 0).
+    np.testing.assert_allclose(got.numeric[0, 1:4], 0.0, atol=1e-6)
+    assert float("1_000") == 1000.0  # the contract being mirrored
+
+
 def test_parity_duplicate_header_last_wins(tmp_path):
     from mlops_tpu.schema import SCHEMA
 

@@ -1,0 +1,103 @@
+"""Golden cross-check on the reference's REAL inference rows.
+
+The reference ships 81 UCI-derived applicant rows
+(`/root/reference/databricks/data/inference.csv`) used for ad-hoc testing
+of its deployed endpoint. Everything else in this suite runs on the repo's
+own synthetic generator, so this file is the proof that the schema,
+categorical vocabularies, encoder, and serving path are compatible with
+the reference's actual data — vocab mismatches fail loudly here instead
+of silently scoring OOV garbage in production.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.data.ingest import load_csv_columns
+from mlops_tpu.schema import SCHEMA, FEATURE_NAMES, LoanApplicant
+from mlops_tpu.serve import InferenceEngine
+
+REFERENCE_CSV = Path("/root/reference/databricks/data/inference.csv")
+
+pytestmark = pytest.mark.skipif(
+    not REFERENCE_CSV.exists(), reason="reference mount not available"
+)
+
+
+@pytest.fixture(scope="module")
+def reference_columns():
+    columns, labels = load_csv_columns(REFERENCE_CSV, require_target=False)
+    assert labels is None
+    return columns
+
+
+def test_reference_rows_load_and_cover_vocab(reference_columns):
+    """All 81 rows parse; every categorical value is IN VOCAB (OOV on the
+    reference's own data would mean the schema diverged from the task)."""
+    n = len(next(iter(reference_columns.values())))
+    assert n == 81  # 81 data rows (the file has no trailing newline)
+    for feat in SCHEMA.categorical:
+        values = set(reference_columns[feat.name])
+        unknown = values - set(feat.vocab)
+        assert not unknown, (
+            f"reference data contains {feat.name} values outside the "
+            f"schema vocabulary: {sorted(unknown)}"
+        )
+    for feat in SCHEMA.numeric:
+        raw = np.asarray(reference_columns[feat.name], np.float32)
+        assert np.isfinite(raw).all(), f"non-numeric cells in {feat.name}"
+
+
+def test_reference_rows_validate_as_requests(reference_columns):
+    """Row dicts pass the pydantic wire contract (`app/model.py:8-34`)."""
+    n = len(next(iter(reference_columns.values())))
+    for i in range(n):
+        record = {name: reference_columns[name][i] for name in FEATURE_NAMES}
+        LoanApplicant.model_validate(record)
+
+
+def test_reference_rows_through_serving_path(tiny_pipeline, reference_columns):
+    """encode -> engine -> full response contract on all 81 real rows."""
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+    engine = InferenceEngine(bundle, buckets=(1, 128), enable_grouping=False)
+    engine.warmup()
+
+    n = len(next(iter(reference_columns.values())))
+    records = [
+        {name: reference_columns[name][i] for name in FEATURE_NAMES}
+        for i in range(n)
+    ]
+    response = engine.predict_records(records)
+
+    predictions = np.asarray(response["predictions"])
+    outliers = np.asarray(response["outliers"])
+    assert predictions.shape == (len(records),)
+    assert np.isfinite(predictions).all()
+    assert ((predictions >= 0.0) & (predictions <= 1.0)).all()
+    assert outliers.shape == (len(records),)
+    assert set(np.unique(outliers)) <= {0.0, 1.0}
+    drift = response["feature_drift_batch"]
+    assert set(drift) == set(FEATURE_NAMES) and len(drift) == 23
+    for score in drift.values():
+        assert 0.0 <= score <= 1.0
+
+    # Real rows are in-distribution-ish for the synthetic trainer, but the
+    # contract here is softer: the monitors must not flag EVERYTHING.
+    assert outliers.mean() < 1.0
+
+
+def test_reference_csv_native_encoder_parity(reference_columns):
+    """The C++ CSV kernel produces bit-identical encodings on the real file."""
+    from mlops_tpu import native
+    from mlops_tpu.data import Preprocessor
+
+    if not native.native_available():
+        pytest.skip("native encoder unavailable")
+    prep = Preprocessor.fit(reference_columns)
+    got = native.encode_csv_native(REFERENCE_CSV, prep, require_target=False)
+    want = prep.encode(reference_columns)
+    np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
+    np.testing.assert_allclose(got.numeric, want.numeric, atol=1e-5)
