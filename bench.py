@@ -10,8 +10,9 @@ warmed engine, then measure:
   decomposed into encode / dispatch / fetch stages,
 - bulk throughput at buckets {256, 4096, 16384} plus a pipelined sweep
   (dispatch all chunks, one batched fetch), and
+- direct engine grouped-dispatch capability (no HTTP layer), and
 - HTTP-level req/s through the real asyncio server + micro-batcher at
-  client concurrency {1, 8, 32}.
+  client concurrency {1, 8, 32, 128}.
 
 Prints ONE JSON line no matter what:
 ``{"metric", "value", "unit", "vs_baseline", ...extras}`` where
@@ -152,9 +153,35 @@ def _bulk_stage(engine, bundle) -> dict:
     return out
 
 
+def _engine_stage(engine, record) -> dict:
+    """Chip-serving capability without the HTTP layer: concurrent grouped
+    dispatches from a small thread pool (what replica processes would
+    drive). Separates the device ceiling from server-side Python cost."""
+    import threading
+
+    if not engine.supports_grouping:
+        return {}
+    reqs = [[record]] * 64
+    engine.predict_group(reqs)  # warm
+    n_threads, reps = 4, 5
+
+    def worker():
+        for _ in range(reps):
+            engine.predict_group(reqs)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    return {"engine_group_req_per_s": round(n_threads * reps * 64 / dt, 1)}
+
+
 def _http_stage(engine, record) -> dict:
     """req/s through the real HTTP server + micro-batcher at client
-    concurrency {1, 8, 32} (keep-alive, batch-1 bodies)."""
+    concurrency {1, 8, 32, 128} (keep-alive, batch-1 bodies)."""
     import asyncio
 
     from mlops_tpu.config import ServeConfig
@@ -196,7 +223,7 @@ def _http_stage(engine, record) -> dict:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
-        for concurrency, per_client in ((1, 20), (8, 15), (32, 10)):
+        for concurrency, per_client in ((1, 20), (8, 15), (32, 10), (128, 8)):
             await asyncio.gather(*[client(3) for _ in range(min(concurrency, 4))])
             t0 = time.perf_counter()
             await asyncio.gather(*[client(per_client) for _ in range(concurrency)])
@@ -247,7 +274,7 @@ def main() -> None:
     record = LoanApplicant().model_dump()
     batch1 = _batch1_stage(engine, record)
     bulk = _bulk_stage(engine, bundle)
-    http = _http_stage(engine, record)
+    http = {**_engine_stage(engine, record), **_http_stage(engine, record)}
 
     p50 = batch1["p50_ms"]
     print(

@@ -22,6 +22,7 @@ import uuid
 from pathlib import Path
 from typing import Any
 
+from mlops_tpu.utils import storage
 from mlops_tpu.utils.io import atomic_write
 
 STAGES = ("none", "staging", "production")
@@ -38,23 +39,73 @@ def parse_model_uri(uri: str) -> tuple[str, str]:
 
 
 class ModelRegistry:
-    def __init__(self, root: str | Path):
-        self.root = Path(root)
+    """Registry over a local directory OR a ``gs://bucket/prefix`` root.
+
+    The GCS flavor is the analogue of the reference registering models in
+    a workspace-scoped MLflow registry reachable from every estate
+    component (`02-register-model.ipynb:461-470`): CI trains on one
+    machine, the serving image build and the GKE training Job resolve the
+    same ``models:/`` URI from the bucket. Bundle versions are immutable,
+    so GCS resolves download into a content-stable local cache.
+    """
+
+    def __init__(
+        self,
+        root: str | Path,
+        client: "storage.GCSClient | None" = None,
+        cache_dir: str | Path | None = None,
+    ):
+        self._gcs = storage.is_gcs(root)
+        self.root = str(root).rstrip("/") if self._gcs else Path(root)
+        self._client = client
+        # Per-user cache (0700): a world-writable shared temp dir would let
+        # another local user pre-plant a "cached" bundle that resolve()
+        # trusts as immutable.
+        self._cache_dir = Path(
+            cache_dir or Path.home() / ".cache" / "mlops_tpu" / "registry"
+        )
 
     # ---------------------------------------------------------------- index
-    def _index_path(self, name: str) -> Path:
-        return self.root / name / "index.json"
+    def _index_path(self, name: str) -> str | Path:
+        return storage.join(self.root, name, "index.json")
 
     def _read_index(self, name: str) -> dict[str, Any]:
-        path = self._index_path(name)
-        if not path.exists():
+        try:
+            return json.loads(
+                storage.read_bytes(self._index_path(name), self._client)
+            )
+        except FileNotFoundError:
             return {"name": name, "versions": []}
-        return json.loads(path.read_text())
 
     def _write_index(self, name: str, index: dict[str, Any]) -> None:
-        path = self._index_path(name)
-        path.parent.mkdir(parents=True, exist_ok=True)
-        atomic_write(path, json.dumps(index, indent=2).encode())
+        storage.write_bytes(
+            self._index_path(name),
+            json.dumps(index, indent=2).encode(),
+            self._client,
+        )
+
+    def _stored_versions(self, name: str) -> list[int]:
+        """Version numbers physically present under versions/ (orphan scan)."""
+        if self._gcs:
+            prefix = f"{self.root}/{name}/versions/"
+            _, key_prefix = storage.split_gcs(prefix)
+            found = set()
+            # A listing failure must FAIL the register: numbering from the
+            # index alone could collide with a crashed upload's orphan and
+            # merge two bundles under one version (the orphan scan is the
+            # collision protection).
+            keys = (self._client or storage.gcs_client()).list_keys(prefix)
+            for key in keys:
+                head = key[len(key_prefix) :].split("/", 1)[0]
+                if head.isdigit():
+                    found.add(int(head))
+            return sorted(found)
+        versions_dir = self.root / name / "versions"
+        return sorted(
+            int(p.name)
+            for p in versions_dir.glob("[0-9]*")
+            if p.is_dir() and p.name.isdigit()
+        )
 
     # ------------------------------------------------------------------ api
     def register(
@@ -70,30 +121,42 @@ class ModelRegistry:
         (`02-register-model.ipynb:504`).
         """
         index = self._read_index(name)
-        versions_dir = self.root / name / "versions"
-        # Next version = 1 + max over index AND on-disk dirs, so an orphan
-        # directory from a crash between copy and index write can never
+        # Next version = 1 + max over index AND already-stored dirs, so an
+        # orphan from a crash between copy and index write can never
         # collide with a later registration.
-        on_disk = (
-            int(p.name)
-            for p in versions_dir.glob("[0-9]*")
-            if p.is_dir() and p.name.isdigit()
-        )
         version = 1 + max(
-            [0, *(v["version"] for v in index["versions"]), *on_disk]
+            [
+                0,
+                *(v["version"] for v in index["versions"]),
+                *self._stored_versions(name),
+            ]
         )
-        dest = versions_dir / str(version)
-        # Copy to a temp sibling then rename: a partial copy is never visible
-        # under a version number. Single-writer assumption: concurrent
-        # registers of the same name are not coordinated (CI serializes the
-        # release pipeline, as the reference's workflow jobs do via `needs:`).
-        staging = versions_dir / f".incoming-{uuid.uuid4().hex}"
-        try:
-            shutil.copytree(bundle_dir, staging)
-            staging.replace(dest)
-        except BaseException:
-            shutil.rmtree(staging, ignore_errors=True)
-            raise
+        if self._gcs:
+            # Objects upload under the final prefix directly: GCS has no
+            # rename, but the version only becomes resolvable once the
+            # index write lands (single-writer assumption below), and a
+            # crashed partial upload is shadowed by the orphan scan above.
+            storage.upload_dir(
+                bundle_dir,
+                f"{self.root}/{name}/versions/{version}",
+                self._client,
+            )
+        else:
+            versions_dir = self.root / name / "versions"
+            dest = versions_dir / str(version)
+            # Copy to a temp sibling then rename: a partial copy is never
+            # visible under a version number. Single-writer assumption:
+            # concurrent registers of the same name are not coordinated (CI
+            # serializes the release pipeline, as the reference's workflow
+            # jobs do via `needs:`).
+            versions_dir.mkdir(parents=True, exist_ok=True)
+            staging = versions_dir / f".incoming-{uuid.uuid4().hex}"
+            try:
+                shutil.copytree(bundle_dir, staging)
+                staging.replace(dest)
+            except BaseException:
+                shutil.rmtree(staging, ignore_errors=True)
+                raise
         index["versions"].append(
             {
                 "version": version,
@@ -129,7 +192,34 @@ class ModelRegistry:
             version = max(v["version"] for v in staged)
         else:
             raise KeyError(f"unknown version or stage {version_or_stage!r}")
-        return self.root / name / "versions" / str(version)
+        if not self._gcs:
+            return self.root / name / "versions" / str(version)
+        # GCS: download into the local cache (bundle versions are
+        # immutable, so a populated cache dir is authoritative). Download
+        # into a temp sibling and rename so an interrupted download can
+        # never masquerade as a complete cached bundle.
+        local = self._cache_dir / name / str(version)
+        if not local.exists():
+            local.parent.mkdir(parents=True, exist_ok=True)
+            incoming = local.parent / f".incoming-{uuid.uuid4().hex}"
+            try:
+                storage.download_dir(
+                    f"{self.root}/{name}/versions/{version}",
+                    incoming,
+                    self._client,
+                )
+                try:
+                    incoming.replace(local)
+                except OSError:
+                    # Concurrent resolver won the rename; its copy of the
+                    # immutable bundle is as good as ours.
+                    if not (local / "manifest.json").exists():
+                        raise
+                    shutil.rmtree(incoming, ignore_errors=True)
+            except BaseException:
+                shutil.rmtree(incoming, ignore_errors=True)
+                raise
+        return local
 
     def resolve_uri(self, uri: str) -> Path:
         return self.resolve(*parse_model_uri(uri))

@@ -11,11 +11,45 @@ keyed by the canonical schema, with header validation. A native C++ fast path
 from __future__ import annotations
 
 import csv
+import io
 from pathlib import Path
 
 import numpy as np
 
 from mlops_tpu.schema.features import SCHEMA, FeatureSchema
+from mlops_tpu.utils import storage
+
+
+def fetch_local(path: str | Path, workdir: str | Path | None = None) -> Path:
+    """Materialize ``path`` as a local file. Local paths pass through;
+    ``gs://`` objects download into ``workdir`` (default: a per-user
+    cache under ``~/.cache/mlops_tpu``) so byte-oriented consumers — the
+    native C++ CSV kernel above all — can run on remote datasets too. The
+    analogue of the reference's DBFS staging
+    (`deploy-infrastructure.yml:195-198`).
+
+    The cache key includes the object's generation (or md5/size when the
+    server omits it), so a re-staged dataset at the same URI is re-fetched
+    instead of silently served stale.
+    """
+    if not storage.is_gcs(path):
+        return Path(path)
+    import hashlib
+
+    workdir = Path(workdir or Path.home() / ".cache" / "mlops_tpu" / "data")
+    workdir.mkdir(parents=True, exist_ok=True)
+    client = storage.gcs_client()
+    meta = client.stat(str(path))
+    stamp = str(
+        meta.get("generation") or meta.get("md5Hash") or meta.get("size", "")
+    )
+    tag = hashlib.sha256(f"{path}\x00{stamp}".encode()).hexdigest()[:16]
+    local = workdir / f"{tag}-{str(path).rsplit('/', 1)[-1]}"
+    if not local.exists():
+        from mlops_tpu.utils.io import atomic_write
+
+        atomic_write(local, client.read_bytes(str(path)))
+    return local
 
 
 def load_csv_columns(
@@ -23,9 +57,16 @@ def load_csv_columns(
     schema: FeatureSchema = SCHEMA,
     require_target: bool = False,
 ) -> tuple[dict[str, list], np.ndarray | None]:
-    """Read a schema-conforming CSV into columnar lists (+labels if present)."""
-    path = Path(path)
-    with path.open(newline="") as f:
+    """Read a schema-conforming CSV into columnar lists (+labels if present).
+
+    Accepts local paths and ``gs://`` URIs (the uploaded-dataset contract:
+    `deploy-infrastructure.yml` stages curated.csv into the estate bucket).
+    """
+    if storage.is_gcs(path):
+        f = io.StringIO(storage.read_bytes(path).decode("utf-8"), newline="")
+    else:
+        f = Path(path).open(newline="")
+    with f:
         reader = csv.reader(f)
         header = next(reader)
         # Malformed-row semantics are pinned to the native kernel's

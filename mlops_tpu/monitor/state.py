@@ -11,7 +11,12 @@ from flax import struct
 
 from mlops_tpu.config import MonitorConfig
 from mlops_tpu.data.encode import EncodedDataset
-from mlops_tpu.ops.drift import chi2_two_sample, ks_two_sample, ks_two_sample_masked
+from mlops_tpu.ops.drift import (
+    chi2_two_sample,
+    ks_two_sample,
+    ks_two_sample_masked,
+    ks_two_sample_small_masked,
+)
 from mlops_tpu.ops.outlier import fit_mahalanobis, mahalanobis_sq
 from mlops_tpu.schema.features import SCHEMA
 
@@ -23,11 +28,15 @@ class MonitorState(struct.PyTreeNode):
       categorical feature, zero-padded to the max cardinality.
     - ``num_ref_sorted``  f32 [M, R]: sorted training reference sample per
       numeric feature (subsampled to ``drift_ref_size``).
+    - ``num_ref_cdf``     f32 [M, R]: each reference's own right-continuous
+      ECDF values (tie-aware) — a fit-time constant that lets the grouped
+      serving path run K-S without per-slot sorts (`ops/drift.py`).
     - ``out_mean/out_precision/out_threshold``: Mahalanobis detector.
     """
 
     cat_ref_counts: jnp.ndarray
     num_ref_sorted: jnp.ndarray
+    num_ref_cdf: jnp.ndarray
     out_mean: jnp.ndarray
     out_precision: jnp.ndarray
     out_threshold: jnp.ndarray
@@ -37,6 +46,7 @@ class MonitorState(struct.PyTreeNode):
         return {
             "cat_ref_counts": np.asarray(self.cat_ref_counts),
             "num_ref_sorted": np.asarray(self.num_ref_sorted),
+            "num_ref_cdf": np.asarray(self.num_ref_cdf),
             "out_mean": np.asarray(self.out_mean),
             "out_precision": np.asarray(self.out_precision),
             "out_threshold": np.asarray(self.out_threshold),
@@ -44,10 +54,16 @@ class MonitorState(struct.PyTreeNode):
 
     @classmethod
     def from_arrays(cls, arrays: dict[str, np.ndarray]) -> "MonitorState":
+        arrays = dict(arrays)
+        if "num_ref_cdf" not in arrays:  # bundles saved before the field
+            arrays["num_ref_cdf"] = _ref_cdf(
+                np.asarray(arrays["num_ref_sorted"])
+            )
         return cls(
             **{k: jnp.asarray(arrays[k]) for k in (
                 "cat_ref_counts",
                 "num_ref_sorted",
+                "num_ref_cdf",
                 "out_mean",
                 "out_precision",
                 "out_threshold",
@@ -61,6 +77,19 @@ class MonitorState(struct.PyTreeNode):
     def load(cls, path: str | Path) -> "MonitorState":
         with np.load(Path(path).with_suffix(".npz")) as data:
             return cls.from_arrays({k: data[k] for k in data.files})
+
+
+def _ref_cdf(ref_sorted: np.ndarray) -> np.ndarray:
+    """Right-continuous ECDF of each sorted reference row at its own
+    points (ties collapse to the last occurrence, matching
+    ``searchsorted(..., side="right")``)."""
+    m, r = ref_sorted.shape
+    out = np.empty((m, r), dtype=np.float32)
+    for j in range(m):
+        out[j] = np.searchsorted(
+            ref_sorted[j], ref_sorted[j], side="right"
+        ) / float(r)
+    return out
 
 
 def fit_monitor(
@@ -91,6 +120,7 @@ def fit_monitor(
     return MonitorState(
         cat_ref_counts=jnp.asarray(counts),
         num_ref_sorted=jnp.asarray(ref),
+        num_ref_cdf=jnp.asarray(_ref_cdf(ref)),
         out_mean=jnp.asarray(mean),
         out_precision=jnp.asarray(precision),
         out_threshold=jnp.asarray(threshold, dtype=jnp.float32),
@@ -119,6 +149,13 @@ def drift_scores(
 
     if mask is None:
         _, num_p = jax.vmap(ks_two_sample)(state.num_ref_sorted, numeric.T)
+    elif numeric.shape[0] <= 64:
+        # Small (serving / grouped) batches: dense-comparison K-S — no
+        # per-call sorts or gathers, which dominate vmapped-per-request
+        # dispatches on TPU (see ops/drift.py).
+        _, num_p = jax.vmap(
+            ks_two_sample_small_masked, in_axes=(0, 0, 0, None)
+        )(state.num_ref_sorted, state.num_ref_cdf, numeric.T, mask)
     else:
         _, num_p = jax.vmap(ks_two_sample_masked, in_axes=(0, 0, None))(
             state.num_ref_sorted, numeric.T, mask

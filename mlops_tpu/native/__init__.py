@@ -192,11 +192,17 @@ def encode_csv(
     schema: FeatureSchema = SCHEMA,
     require_target: bool = False,
 ) -> EncodedDataset:
-    """Encode a CSV with the native kernel when available, else pure Python."""
+    """Encode a CSV with the native kernel when available, else pure Python.
+
+    ``gs://`` sources are materialized locally first (`data/ingest.py`
+    ``fetch_local``) so the byte-oriented native kernel serves remote
+    datasets too.
+    """
+    from mlops_tpu.data.ingest import fetch_local, load_csv_columns
+
+    path = fetch_local(path)
     if native_available():
         return encode_csv_native(path, prep, schema, require_target)
-    from mlops_tpu.data.ingest import load_csv_columns
-
     columns, labels = load_csv_columns(path, schema, require_target)
     return prep.encode(columns, labels, schema)
 

@@ -105,6 +105,50 @@ def ks_two_sample(
     return statistic, p_value
 
 
+def ks_two_sample_small_masked(
+    ref_sorted: jnp.ndarray,  # f32 [R] ascending
+    ref_cdf: jnp.ndarray,  # f32 [R] ECDF_ref at its own points (right-cont.)
+    batch: jnp.ndarray,  # f32 [B] possibly padded, B small
+    mask: jnp.ndarray,  # bool [B] True for real rows
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """K-S for SMALL batches as dense comparisons — the grouped-serving
+    hot path.
+
+    ``ks_two_sample_masked`` sorts the batch and runs ``searchsorted``
+    over the pooled R+B points; vmapped per request-slot that lowers to
+    per-slot sorts/gathers, which are slow on TPU (~4-5 ms per slot
+    measured on v5e — it dominated grouped dispatch). For B << R the
+    supremum over pooled points splits into batch points and reference
+    points, and every ECDF evaluation becomes a ``<=`` outer comparison
+    ([B,R] and [R,B] elementwise reductions, MXU/VPU-friendly), with
+    ECDF_ref at reference points a fit-time constant (``ref_cdf``).
+    Identical statistics to the pooled form, including ties and padding
+    (+inf rows contribute 0 everywhere).
+    """
+    r = ref_sorted.shape[0]
+    ref_sorted = ref_sorted.astype(jnp.float32)
+    bvals = jnp.where(mask, batch.astype(jnp.float32), jnp.inf)
+    n_valid = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
+
+    # ECDFs at batch points ([B,R] and [B,B] comparisons).
+    f_ref_b = (ref_sorted[None, :] <= bvals[:, None]).sum(axis=1) / r
+    cnt_b = (bvals[None, :] <= bvals[:, None]).sum(axis=1).astype(jnp.float32)
+    f_b_b = jnp.minimum(cnt_b, n_valid) / n_valid
+    d_b = jnp.where(
+        jnp.isfinite(bvals), jnp.abs(f_ref_b - f_b_b), 0.0
+    ).max()
+
+    # ECDFs at reference points ([R,B] comparisons; ECDF_ref precomputed).
+    cnt_r = (bvals[None, :] <= ref_sorted[:, None]).sum(axis=1)
+    f_b_r = jnp.minimum(cnt_r.astype(jnp.float32), n_valid) / n_valid
+    d_r = jnp.abs(ref_cdf - f_b_r).max()
+
+    statistic = jnp.where(mask.any(), jnp.maximum(d_b, d_r), 0.0)
+    en = jnp.sqrt(r * n_valid / (r + n_valid))
+    p_value = _kolmogorov_sf((en + 0.12 + 0.11 / en) * statistic)
+    return statistic, p_value
+
+
 def ks_two_sample_masked(
     ref_sorted: jnp.ndarray,  # f32 [R] ascending
     batch: jnp.ndarray,  # f32 [B] possibly padded

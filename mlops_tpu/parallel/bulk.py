@@ -146,14 +146,29 @@ def score_dataset(
         scorer(cat0, num0, np.arange(chunk) < warm_rows)[0]
     )
 
-    # Pipeline the sweep: dispatch every chunk first (JAX queues the
-    # host->device copies and kernels asynchronously), then fetch ALL
-    # results in one batched device_get. Blocking per chunk would pay one
-    # full transport round trip each (~70 ms on a tunnel-attached chip);
-    # a single batched fetch pays one round trip total plus bandwidth.
+    # Pipeline the sweep in bounded waves: dispatch up to ``wave`` chunks
+    # (JAX queues the host->device copies and kernels asynchronously),
+    # then fetch the wave's results in one batched device_get. Blocking
+    # per chunk would pay a full transport round trip each (~70 ms on a
+    # tunnel-attached chip); batching fetches amortizes that to one round
+    # trip per wave, while the bound keeps in-flight input buffers from
+    # growing with dataset size (unbounded dispatch of a 10M-row sweep
+    # would hold every chunk's buffers live on the device at once).
+    wave = 32
     t0 = time.perf_counter()
     spans: list[tuple[int, int]] = []
     device_outs = []
+
+    def drain() -> None:
+        for (start, stop), (probs, flags) in zip(
+            spans, jax.device_get(device_outs)
+        ):
+            size = stop - start
+            predictions[start:stop] = probs[:size]
+            outliers[start:stop] = flags[:size]
+        spans.clear()
+        device_outs.clear()
+
     for start in range(0, n, chunk):
         stop = min(start + chunk, n)
         size = stop - start
@@ -165,10 +180,9 @@ def score_dataset(
         mask = np.arange(chunk) < size
         spans.append((start, stop))
         device_outs.append(scorer(cat, num, mask))
-    for (start, stop), (probs, flags) in zip(spans, jax.device_get(device_outs)):
-        size = stop - start
-        predictions[start:stop] = probs[:size]
-        outliers[start:stop] = flags[:size]
+        if len(device_outs) >= wave:
+            drain()
+    drain()
     elapsed = time.perf_counter() - t0
 
     # Dataset-level drift on a bounded uniform sample (see module docstring).

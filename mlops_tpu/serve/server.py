@@ -65,7 +65,7 @@ class HttpServer:
             config.max_batch = engine.max_bucket
         self.metrics = ServingMetrics()
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="predict"
+            max_workers=8, thread_name_prefix="predict"
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
@@ -246,16 +246,20 @@ class HttpServer:
 
         request_id = uuid.uuid4().hex
         record_dicts = [r.model_dump() for r in records]
-        logger.info(
-            json.dumps(
-                {
-                    "service_name": self.config.service_name,
-                    "type": "InferenceData",
-                    "request_id": request_id,
-                    "data": record_dicts,
-                }
+        # isEnabledFor guards: the two-event monitoring contract serializes
+        # full payloads per request — skip the dumps work entirely when the
+        # deployment silences INFO (it is the request hot path).
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                json.dumps(
+                    {
+                        "service_name": self.config.service_name,
+                        "type": "InferenceData",
+                        "request_id": request_id,
+                        "data": record_dicts,
+                    }
+                )
             )
-        )
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
@@ -264,16 +268,17 @@ class HttpServer:
             logger.exception("prediction failed request_id=%s", request_id)
             return 500, {"detail": "prediction failed"}, "application/json"
         self.metrics.observe_prediction(response)
-        logger.info(
-            json.dumps(
-                {
-                    "service_name": self.config.service_name,
-                    "type": "ModelOutput",
-                    "request_id": request_id,
-                    "data": response,
-                }
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                json.dumps(
+                    {
+                        "service_name": self.config.service_name,
+                        "type": "ModelOutput",
+                        "request_id": request_id,
+                        "data": response,
+                    }
+                )
             )
-        )
         return 200, response, "application/json"
 
     # ------------------------------------------------------------ lifecycle

@@ -101,3 +101,46 @@ def test_monitor_state_save_load(tmp_path, encoded_small):
     np.testing.assert_array_equal(
         np.asarray(state.out_precision), np.asarray(state2.out_precision)
     )
+
+
+def test_ks_small_masked_matches_pooled():
+    """The dense-comparison small-batch K-S (grouped serving hot path) is
+    bit-equivalent to the pooled sort/searchsorted form — incl. ties,
+    padding, duplicate reference values, and the all-padded guard."""
+    import numpy as np
+
+    from mlops_tpu.monitor.state import _ref_cdf
+    from mlops_tpu.ops.drift import (
+        ks_two_sample_masked,
+        ks_two_sample_small_masked,
+    )
+
+    rng = np.random.default_rng(5)
+    ref = np.sort(
+        np.round(rng.normal(size=256), 1).astype(np.float32)
+    )  # rounding forces ties
+    ref_cdf = _ref_cdf(ref[None, :])[0]
+    for n_valid in (0, 1, 3, 8):
+        batch = np.round(rng.normal(size=8), 1).astype(np.float32)
+        batch[0:1] = ref[10]  # tie against the reference
+        mask = np.arange(8) < n_valid
+        s1, p1 = ks_two_sample_masked(ref, batch, mask)
+        s2, p2 = ks_two_sample_small_masked(ref, ref_cdf, batch, mask)
+        np.testing.assert_allclose(float(s1), float(s2), atol=1e-6)
+        np.testing.assert_allclose(float(p1), float(p2), atol=1e-6)
+
+
+def test_monitor_state_backcompat_without_ref_cdf(encoded_small):
+    """Bundles saved before num_ref_cdf existed load and score identically."""
+    import numpy as np
+
+    from mlops_tpu.monitor.state import MonitorState, fit_monitor
+
+    _, ds = encoded_small
+    state = fit_monitor(ds)
+    arrays = state.to_arrays()
+    arrays.pop("num_ref_cdf")
+    revived = MonitorState.from_arrays(arrays)
+    np.testing.assert_allclose(
+        np.asarray(revived.num_ref_cdf), np.asarray(state.num_ref_cdf)
+    )
