@@ -58,6 +58,29 @@ def _model_config_from_manifest(manifest: dict[str, Any]) -> ModelConfig:
     })
 
 
+def _environment_pins(flavor: str) -> dict[str, str]:
+    """Every runtime package whose version shapes the bundle's behavior —
+    the analogue of the reference's conda-env synthesis, which reads
+    installed versions via ``importlib.metadata`` and pins them into the
+    artifact (`02-register-model.ipynb` cell 11, ~:400-425). A serving
+    environment can be reconstructed (or a skew detected) from the
+    manifest alone.
+    """
+    import importlib.metadata
+    import platform
+
+    packages = ["jax", "jaxlib", "flax", "optax", "numpy", "pydantic"]
+    if flavor == "sklearn":
+        packages += ["scikit-learn", "joblib"]
+    pins = {"python": platform.python_version()}
+    for package in packages:
+        try:
+            pins[package] = importlib.metadata.version(package)
+        except importlib.metadata.PackageNotFoundError:
+            pass  # optional dep absent in this env: nothing to pin
+    return pins
+
+
 def save_bundle(
     directory: str | Path,
     model_config: ModelConfig,
@@ -81,7 +104,7 @@ def save_bundle(
     manifest = {
         "format_version": 1,
         "flavor": flavor,
-        "framework": {"mlops_tpu": __version__, "jax": jax.__version__},
+        "framework": {"mlops_tpu": __version__, **_environment_pins(flavor)},
         "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
         "schema_fingerprint": SCHEMA.fingerprint(),
         "model_config": dataclasses.asdict(model_config),

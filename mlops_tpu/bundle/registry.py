@@ -16,6 +16,7 @@ Layout:
 from __future__ import annotations
 
 import datetime
+import hashlib
 import json
 import shutil
 import uuid
@@ -58,11 +59,18 @@ class ModelRegistry:
         self._gcs = storage.is_gcs(root)
         self.root = str(root).rstrip("/") if self._gcs else Path(root)
         self._client = client
-        # Per-user cache (0700): a world-writable shared temp dir would let
-        # another local user pre-plant a "cached" bundle that resolve()
-        # trusts as immutable.
-        self._cache_dir = Path(
-            cache_dir or Path.home() / ".cache" / "mlops_tpu" / "registry"
+        # Per-user cache, created 0700 in resolve(): a world-writable
+        # shared temp dir would let another local user pre-plant a
+        # "cached" bundle that resolve() trusts as immutable. Namespaced
+        # by a hash of the registry root so two registries (staging vs
+        # production buckets) can never serve each other's versions.
+        root_tag = hashlib.sha256(str(self.root).encode()).hexdigest()[:16]
+        self._cache_dir = (
+            Path(
+                cache_dir
+                or Path.home() / ".cache" / "mlops_tpu" / "registry"
+            )
+            / root_tag
         )
 
     # ---------------------------------------------------------------- index
@@ -88,15 +96,15 @@ class ModelRegistry:
         """Version numbers physically present under versions/ (orphan scan)."""
         if self._gcs:
             prefix = f"{self.root}/{name}/versions/"
-            _, key_prefix = storage.split_gcs(prefix)
             found = set()
             # A listing failure must FAIL the register: numbering from the
             # index alone could collide with a crashed upload's orphan and
             # merge two bundles under one version (the orphan scan is the
-            # collision protection).
-            keys = (self._client or storage.gcs_client()).list_keys(prefix)
-            for key in keys:
-                head = key[len(key_prefix) :].split("/", 1)[0]
+            # collision protection). delimiter listing returns one child
+            # prefix per version instead of every bundle file's key.
+            client = self._client or storage.gcs_client()
+            for child in client.list_prefixes(prefix):
+                head = child.rstrip("/").rsplit("/", 1)[-1]
                 if head.isdigit():
                     found.add(int(head))
             return sorted(found)
@@ -200,6 +208,7 @@ class ModelRegistry:
         # never masquerade as a complete cached bundle.
         local = self._cache_dir / name / str(version)
         if not local.exists():
+            self._cache_dir.mkdir(parents=True, exist_ok=True, mode=0o700)
             local.parent.mkdir(parents=True, exist_ok=True)
             incoming = local.parent / f".incoming-{uuid.uuid4().hex}"
             try:

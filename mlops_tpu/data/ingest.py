@@ -11,7 +11,6 @@ keyed by the canonical schema, with header validation. A native C++ fast path
 from __future__ import annotations
 
 import csv
-import io
 from pathlib import Path
 
 import numpy as np
@@ -46,9 +45,7 @@ def fetch_local(path: str | Path, workdir: str | Path | None = None) -> Path:
     tag = hashlib.sha256(f"{path}\x00{stamp}".encode()).hexdigest()[:16]
     local = workdir / f"{tag}-{str(path).rsplit('/', 1)[-1]}"
     if not local.exists():
-        from mlops_tpu.utils.io import atomic_write
-
-        atomic_write(local, client.read_bytes(str(path)))
+        client.read_to_file(str(path), local)
     return local
 
 
@@ -60,13 +57,11 @@ def load_csv_columns(
     """Read a schema-conforming CSV into columnar lists (+labels if present).
 
     Accepts local paths and ``gs://`` URIs (the uploaded-dataset contract:
-    `deploy-infrastructure.yml` stages curated.csv into the estate bucket).
+    `deploy-infrastructure.yml` stages curated.csv into the estate bucket);
+    remote objects stream to the local cache first rather than being
+    buffered (and decoded) whole in memory.
     """
-    if storage.is_gcs(path):
-        f = io.StringIO(storage.read_bytes(path).decode("utf-8"), newline="")
-    else:
-        f = Path(path).open(newline="")
-    with f:
+    with fetch_local(path).open(newline="") as f:
         reader = csv.reader(f)
         header = next(reader)
         # Malformed-row semantics are pinned to the native kernel's

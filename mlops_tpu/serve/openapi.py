@@ -1,0 +1,109 @@
+"""OpenAPI document generated from the pydantic wire models.
+
+The reference gets interactive API docs for free from FastAPI — Swagger
+UI served at `/` (`app/main.py:37`, ``docs_url="/"``). This framework's
+server is hand-rolled, so the document is built here from the SAME
+schema-generated pydantic models the validator uses: one source of truth
+for validation, docs, and client generation.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pydantic
+
+from mlops_tpu.schema import LoanApplicant, ModelOutput
+from mlops_tpu.version import __version__
+
+
+def build_openapi(service_name: str) -> dict[str, Any]:
+    """OpenAPI 3.1 document for the serving API."""
+    request_schema = pydantic.TypeAdapter(list[LoanApplicant]).json_schema(
+        ref_template="#/components/schemas/{model}"
+    )
+    response_schema = pydantic.TypeAdapter(ModelOutput).json_schema(
+        ref_template="#/components/schemas/{model}"
+    )
+    components: dict[str, Any] = {}
+    for schema in (request_schema, response_schema):
+        components.update(schema.pop("$defs", {}))
+
+    return {
+        "openapi": "3.1.0",
+        "info": {
+            "title": service_name,
+            "version": __version__,
+            "description": (
+                "TPU-native credit-default inference service: classifier "
+                "+ drift monitor + outlier detector fused into one device "
+                "dispatch per request batch."
+            ),
+        },
+        "paths": {
+            "/predict": {
+                "post": {
+                    "summary": "Score loan applicants",
+                    "operationId": "predict",
+                    "requestBody": {
+                        "required": True,
+                        "content": {
+                            "application/json": {"schema": request_schema}
+                        },
+                    },
+                    "responses": {
+                        "200": {
+                            "description": "Predictions, outlier flags, and per-feature batch drift",
+                            "content": {
+                                "application/json": {"schema": response_schema}
+                            },
+                        },
+                        "422": {"description": "Request body failed validation"},
+                        "413": {"description": "Batch exceeds the serving cap"},
+                    },
+                }
+            },
+            "/healthz/live": {
+                "get": {
+                    "summary": "Liveness probe",
+                    "responses": {"200": {"description": "Process is up"}},
+                }
+            },
+            "/healthz/ready": {
+                "get": {
+                    "summary": "Readiness probe (bundle loaded + jit warm)",
+                    "responses": {
+                        "200": {"description": "Ready"},
+                        "503": {"description": "Still warming"},
+                    },
+                }
+            },
+            "/metrics": {
+                "get": {
+                    "summary": "Prometheus metrics",
+                    "responses": {"200": {"description": "Metrics exposition"}},
+                }
+            },
+        },
+        "components": {"schemas": components},
+    }
+
+
+# Self-contained Swagger UI page (assets from the standard CDN — same
+# approach FastAPI's bundled docs page uses).
+SWAGGER_HTML = """<!doctype html>
+<html>
+<head>
+  <title>{title}</title>
+  <meta charset="utf-8"/>
+  <link rel="stylesheet"
+        href="https://cdn.jsdelivr.net/npm/swagger-ui-dist@5/swagger-ui.css">
+</head>
+<body>
+<div id="swagger-ui"></div>
+<script src="https://cdn.jsdelivr.net/npm/swagger-ui-dist@5/swagger-ui-bundle.js"></script>
+<script>
+  SwaggerUIBundle({{url: "/openapi.json", dom_id: "#swagger-ui"}});
+</script>
+</body>
+</html>"""

@@ -69,6 +69,7 @@ class HttpServer:
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
+        self._openapi: dict | None = None  # built lazily, served cached
         self.batcher = MicroBatcher(
             engine,
             self._executor,
@@ -184,7 +185,23 @@ class HttpServer:
             return self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":
             if path == "/":
+                # Interactive Swagger UI (reference parity: FastAPI serves
+                # its docs at `/`, `app/main.py:37`).
+                from mlops_tpu.serve.openapi import SWAGGER_HTML
+
+                return (
+                    200,
+                    SWAGGER_HTML.format(title=self.config.service_name),
+                    "text/html",
+                )
+            if path == "/docs/plain":
                 return 200, _DOCS_HTML.format(title=self.config.service_name), "text/html"
+            if path == "/openapi.json":
+                from mlops_tpu.serve.openapi import build_openapi
+
+                if self._openapi is None:
+                    self._openapi = build_openapi(self.config.service_name)
+                return 200, self._openapi, "application/json"
             if path == "/healthz/live":
                 return 200, {"status": "alive"}, "application/json"
             if path == "/healthz/ready":

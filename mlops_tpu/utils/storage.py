@@ -113,6 +113,38 @@ class GCSClient:
             raise RuntimeError(f"GCS read {path} failed: HTTP {status}")
         return body
 
+    def read_to_file(self, path: str, local: "str | Path") -> None:
+        """Stream an object to a local file without buffering it whole in
+        memory (curated datasets can be multi-GB; ``read_bytes`` + a
+        decoded copy would hold 2x the file in RAM). Streams through
+        urllib when running on the real transport; injected (test)
+        transports fall back to a buffered copy."""
+        from mlops_tpu.utils.io import atomic_write
+
+        if self._transport is not self._urllib_transport:
+            atomic_write(local, self.read_bytes(path))
+            return
+        bucket, key = split_gcs(path)
+        url = (
+            f"{_API}/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+            f"/o/{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        local = Path(local)
+        req = urllib.request.Request(url, headers=self._auth_headers())
+        try:
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                tmp = local.with_name(f".{local.name}.partial")
+                with tmp.open("wb") as f:
+                    while chunk := resp.read(1 << 20):
+                        f.write(chunk)
+                tmp.replace(local)
+        except urllib.error.HTTPError as err:
+            if err.code == 404:
+                raise FileNotFoundError(path) from None
+            raise RuntimeError(
+                f"GCS read {path} failed: HTTP {err.code}"
+            ) from None
+
     def write_bytes(self, path: str, data: bytes) -> None:
         bucket, key = split_gcs(path)
         url = (
@@ -146,15 +178,31 @@ class GCSClient:
 
     def list_keys(self, path: str) -> list[str]:
         """All object keys under the ``gs://bucket/prefix`` (recursive)."""
+        keys, _ = self._list(path, delimiter=None)
+        return keys
+
+    def list_prefixes(self, path: str) -> list[str]:
+        """Immediate child "directories" of the prefix (``delimiter=/``
+        listing) — one small page instead of every object key, e.g. the
+        registry's version-number scan."""
+        _, prefixes = self._list(path, delimiter="/")
+        return prefixes
+
+    def _list(
+        self, path: str, delimiter: str | None
+    ) -> tuple[list[str], list[str]]:
         bucket, prefix = split_gcs(path)
         keys: list[str] = []
+        prefixes: list[str] = []
         page = ""
         while True:
             url = (
                 f"{_API}/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
                 f"/o?prefix={urllib.parse.quote(prefix, safe='')}"
-                f"&fields=items(name),nextPageToken"
+                f"&fields=items(name),prefixes,nextPageToken"
             )
+            if delimiter:
+                url += f"&delimiter={urllib.parse.quote(delimiter, safe='')}"
             if page:
                 url += f"&pageToken={urllib.parse.quote(page, safe='')}"
             status, body = self._call("GET", url)
@@ -162,9 +210,10 @@ class GCSClient:
                 raise RuntimeError(f"GCS list {path} failed: HTTP {status}")
             payload = json.loads(body or b"{}")
             keys.extend(item["name"] for item in payload.get("items", []))
+            prefixes.extend(payload.get("prefixes", []))
             page = payload.get("nextPageToken", "")
             if not page:
-                return keys
+                return keys, prefixes
 
 
 _default_client: GCSClient | None = None

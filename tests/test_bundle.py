@@ -119,3 +119,16 @@ def test_parse_model_uri():
         parse_model_uri("model:/foo/3")
     with pytest.raises(ValueError):
         parse_model_uri("models:/foo")
+
+
+def test_manifest_pins_environment(tiny_pipeline):
+    """The manifest records every behavior-shaping package version (the
+    reference's conda-env synthesis analogue, `02-register-model.ipynb`
+    cell 11) so a serving env can be reconstructed from the artifact."""
+    import json
+
+    _, result = tiny_pipeline
+    manifest = json.loads((result.bundle_dir / "manifest.json").read_text())
+    pins = manifest["framework"]
+    for key in ("mlops_tpu", "python", "jax", "flax", "optax", "numpy", "pydantic"):
+        assert pins.get(key), f"missing environment pin: {key}"

@@ -255,3 +255,23 @@ def test_profile_disabled(engine):
         _http((server, [("POST", "/debug/profile/start", None)]))
     )
     assert status == 404
+
+
+def test_openapi_document(engine):
+    """GET /openapi.json serves a valid document generated from the SAME
+    pydantic models that validate requests (reference parity: FastAPI's
+    auto-docs at `/`, `app/main.py:37`), and `/` serves the Swagger page."""
+    [(status, _, body), (hstatus, hhead, hbody)] = _run_exchanges(
+        engine, [("GET", "/openapi.json", None), ("GET", "/", None)]
+    )
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["openapi"].startswith("3.")
+    assert "/predict" in doc["paths"]
+    applicant = doc["components"]["schemas"]["LoanApplicant"]
+    assert len(applicant["properties"]) == 23
+    request_schema = doc["paths"]["/predict"]["post"]["requestBody"]
+    assert request_schema["required"] is True
+    output = doc["components"]["schemas"]["FeatureBatchDrift"]
+    assert len(output["properties"]) == 23
+    assert hstatus == 200 and b"swagger-ui" in hbody

@@ -46,11 +46,29 @@ class FakeGCS:
             key = parts[6] if len(parts) > 6 else None
             if key is None:  # list
                 prefix = query.get("prefix", [""])[0]
+                delimiter = query.get("delimiter", [None])[0]
                 names = sorted(
                     k[len(bucket) + 1 :]
                     for k in self.objects
                     if k.startswith(f"{bucket}/{prefix}")
                 )
+                if delimiter:
+                    # Collapse keys past the delimiter into "directory"
+                    # prefixes, per the GCS JSON API contract.
+                    prefixes, leaves = set(), []
+                    for n in names:
+                        rest = n[len(prefix) :]
+                        if delimiter in rest:
+                            prefixes.add(
+                                prefix + rest.split(delimiter, 1)[0] + delimiter
+                            )
+                        else:
+                            leaves.append(n)
+                    payload = {
+                        "items": [{"name": n} for n in leaves],
+                        "prefixes": sorted(prefixes),
+                    }
+                    return 200, json.dumps(payload).encode()
                 page = int(query.get("pageToken", ["0"])[0] or 0)
                 chunk, nxt = names[page : page + 2], page + 2
                 payload = {"items": [{"name": n} for n in chunk]}
