@@ -179,10 +179,65 @@ def _engine_stage(engine, record) -> dict:
     return {"engine_group_req_per_s": round(n_threads * reps * 64 / dt, 1)}
 
 
+_HTTP_CLIENT = r"""
+import asyncio, json, sys, time
+
+port = int(sys.argv[1])
+body = sys.stdin.buffer.read()
+head = (
+    "POST /predict HTTP/1.1\r\nhost: x\r\n"
+    "content-type: application/json\r\n"
+    f"content-length: {len(body)}\r\n\r\n"
+).encode()
+
+
+async def client(n_requests):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    for _ in range(n_requests):
+        writer.write(head + body)
+        await writer.drain()
+        line = await reader.readline()
+        assert b"200" in line, line
+        length = 0
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n"):
+                break
+            if h.lower().startswith(b"content-length:"):
+                length = int(h.split(b":")[1])
+        await reader.readexactly(length)
+    writer.close()
+    try:
+        await writer.wait_closed()
+    except (ConnectionResetError, BrokenPipeError):
+        pass
+
+
+async def main():
+    results = {}
+    for concurrency, per_client in ((1, 20), (8, 15), (32, 10), (128, 8)):
+        await asyncio.gather(*[client(3) for _ in range(min(concurrency, 4))])
+        t0 = time.perf_counter()
+        await asyncio.gather(*[client(per_client) for _ in range(concurrency)])
+        dt = time.perf_counter() - t0
+        results[f"http_req_per_s_c{concurrency}"] = round(
+            concurrency * per_client / dt, 1
+        )
+    print(json.dumps(results))
+
+
+asyncio.run(main())
+"""
+
+
 def _http_stage(engine, record) -> dict:
     """req/s through the real HTTP server + micro-batcher at client
-    concurrency {1, 8, 32, 128} (keep-alive, batch-1 bodies)."""
+    concurrency {1, 8, 32, 128} (keep-alive, batch-1 bodies). The load
+    generator runs in a SEPARATE process — clients sharing the server's
+    event loop would throttle the server and measure the harness, not
+    the service."""
     import asyncio
+    import subprocess
 
     from mlops_tpu.config import ServeConfig
     from mlops_tpu.serve.server import HttpServer
@@ -194,46 +249,20 @@ def _http_stage(engine, record) -> dict:
         server = HttpServer(engine, config)
         srv = await asyncio.start_server(server.handle_connection, "127.0.0.1", 0)
         port = srv.sockets[0].getsockname()[1]
-        results = {}
-
-        async def client(n_requests: int) -> None:
-            reader, writer = await asyncio.open_connection("127.0.0.1", port)
-            head = (
-                "POST /predict HTTP/1.1\r\nhost: x\r\n"
-                "content-type: application/json\r\n"
-                f"content-length: {len(body)}\r\n\r\n"
-            ).encode()
-            for _ in range(n_requests):
-                writer.write(head + body)
-                await writer.drain()
-                # Read status + headers, then exactly content-length bytes.
-                line = await reader.readline()
-                assert b"200" in line, line
-                length = 0
-                while True:
-                    h = await reader.readline()
-                    if h in (b"\r\n", b"\n"):
-                        break
-                    if h.lower().startswith(b"content-length:"):
-                        length = int(h.split(b":")[1])
-                await reader.readexactly(length)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
-
-        for concurrency, per_client in ((1, 20), (8, 15), (32, 10), (128, 8)):
-            await asyncio.gather(*[client(3) for _ in range(min(concurrency, 4))])
-            t0 = time.perf_counter()
-            await asyncio.gather(*[client(per_client) for _ in range(concurrency)])
-            dt = time.perf_counter() - t0
-            results[f"http_req_per_s_c{concurrency}"] = round(
-                concurrency * per_client / dt, 1
-            )
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-c",
+            _HTTP_CLIENT,
+            str(port),
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+        )
+        out, _ = await proc.communicate(body)
         srv.close()
         await srv.wait_closed()
-        return results
+        if proc.returncode != 0:
+            raise RuntimeError("http load client failed")
+        return json.loads(out.decode().strip().splitlines()[-1])
 
     return asyncio.run(run())
 

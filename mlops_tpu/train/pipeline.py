@@ -82,9 +82,19 @@ def _package_and_register(
     register: bool,
 ) -> tuple[Path, str | None]:
     """Shared packaging tail: fit monitors, write the bundle, register it
-    (notebook 02's role — `02-register-model.ipynb` cells 6-15)."""
+    (notebook 02's role — `02-register-model.ipynb` cells 6-15).
+
+    Multi-host cohorts (JobSet over DCN): every process computes
+    identically, but only the coordinator writes the bundle and registry
+    entry — N hosts registering N duplicate versions (and racing the
+    index write) is the multi-host failure mode this guards.
+    """
+    from mlops_tpu.parallel.distributed import is_coordinator
+
     monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
     bundle_dir = run_dir / "bundle"
+    if not is_coordinator():
+        return bundle_dir, None
     save_bundle(
         bundle_dir,
         config.model,

@@ -124,26 +124,49 @@ class GCSClient:
         if self._transport is not self._urllib_transport:
             atomic_write(local, self.read_bytes(path))
             return
+        import os
+        import tempfile
+
         bucket, key = split_gcs(path)
         url = (
             f"{_API}/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
             f"/o/{urllib.parse.quote(key, safe='')}?alt=media"
         )
         local = Path(local)
-        req = urllib.request.Request(url, headers=self._auth_headers())
-        try:
-            with urllib.request.urlopen(req, timeout=300) as resp:
-                tmp = local.with_name(f".{local.name}.partial")
-                with tmp.open("wb") as f:
-                    while chunk := resp.read(1 << 20):
-                        f.write(chunk)
-                tmp.replace(local)
-        except urllib.error.HTTPError as err:
-            if err.code == 404:
-                raise FileNotFoundError(path) from None
-            raise RuntimeError(
-                f"GCS read {path} failed: HTTP {err.code}"
-            ) from None
+        local.parent.mkdir(parents=True, exist_ok=True)
+        for attempt in (0, 1):
+            req = urllib.request.Request(url, headers=self._auth_headers())
+            try:
+                with urllib.request.urlopen(req, timeout=300) as resp:
+                    # mkstemp: concurrent fetchers of the same object each
+                    # stream into their OWN temp file (a shared fixed name
+                    # would interleave chunks), and the rename is atomic.
+                    fd, tmp = tempfile.mkstemp(
+                        dir=local.parent, prefix=f".{local.name}."
+                    )
+                    try:
+                        with os.fdopen(fd, "wb") as f:
+                            while chunk := resp.read(1 << 20):
+                                f.write(chunk)
+                        os.replace(tmp, local)
+                    except BaseException:
+                        try:
+                            os.unlink(tmp)
+                        except FileNotFoundError:
+                            pass
+                        raise
+                return
+            except urllib.error.HTTPError as err:
+                if err.code == 401 and attempt == 0:
+                    # Same expired-token recovery as _call: drop the
+                    # cached token and retry once.
+                    self._token = None
+                    continue
+                if err.code == 404:
+                    raise FileNotFoundError(path) from None
+                raise RuntimeError(
+                    f"GCS read {path} failed: HTTP {err.code}"
+                ) from None
 
     def write_bytes(self, path: str, data: bytes) -> None:
         bucket, key = split_gcs(path)

@@ -21,6 +21,9 @@ SUBSTITUTIONS = {
     "JOB_NAME": "train-register-123",
     "DATA_URI": "gs://bucket/data/curated.csv",
     "REGISTRY_ROOT": "gs://bucket/registry",
+    "NUM_HOSTS": "4",
+    "TPU_TOPOLOGY": "4x4",
+    "ACCELERATOR": "tpu-v5-lite-podslice",
 }
 
 
@@ -79,6 +82,39 @@ def test_train_job_manifest_contracts():
         (REPO / "configs" / "train_register_job.toml").read_text()
     )
     assert {"data", "model", "train", "hpo", "registry"} <= config.keys()
+
+
+def test_train_jobset_multihost_contracts():
+    """The multi-host JobSet forms a correct jax.distributed cohort: the
+    env contract matches what `parallel/distributed.py` consumes (and
+    what tests/test_multihost_smoke.py live-tests cross-process)."""
+    docs = _render(REPO / "kubernetes" / "train-jobset.yml")
+    (jobset,) = docs
+    assert jobset["kind"] == "JobSet"
+    job = jobset["spec"]["replicatedJobs"][0]["template"]["spec"]
+    assert job["parallelism"] == 4 and job["completions"] == 4
+    assert job["completionMode"] == "Indexed"
+    # Whole-cohort restarts only: a per-pod retry would rejoin a dead
+    # handshake.
+    assert job["backoffLimit"] == 0
+    assert jobset["spec"]["failurePolicy"]["maxRestarts"] >= 1
+    pod = job["template"]["spec"]
+    env = {e["name"]: e for e in pod["containers"][0]["env"]}
+    assert set(env) >= {
+        "MLOPS_TPU_COORDINATOR",
+        "MLOPS_TPU_NUM_PROCESSES",
+        "MLOPS_TPU_PROCESS_ID",
+    }
+    # Coordinator points at pod 0's stable DNS name inside the headless
+    # service domain; every pod derives its rank from the completion index.
+    assert env["MLOPS_TPU_COORDINATOR"]["value"].startswith(
+        "train-register-123-workers-0-0.train-register-123:"
+    )
+    assert env["MLOPS_TPU_NUM_PROCESSES"]["value"] == "4"
+    index_path = env["MLOPS_TPU_PROCESS_ID"]["valueFrom"]["fieldRef"]["fieldPath"]
+    assert "job-completion-index" in index_path
+    assert pod["subdomain"] == "train-register-123"
+    assert pod["containers"][0]["resources"]["requests"]["google.com/tpu"] == "4"
 
 
 def test_workflow_train_job_wiring():
