@@ -91,10 +91,10 @@ def _package_and_register(
     """
     from mlops_tpu.parallel.distributed import is_coordinator
 
-    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
     bundle_dir = run_dir / "bundle"
     if not is_coordinator():
         return bundle_dir, None
+    monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
     save_bundle(
         bundle_dir,
         config.model,
