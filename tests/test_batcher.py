@@ -114,3 +114,34 @@ def test_sklearn_flavor_has_no_group_path(tmp_path):
     assert not eng.supports_grouping
     out = eng.predict_group([[{"age": 30.0}], [{"age": 40.0}]])
     assert len(out) == 2
+
+
+def test_overlapped_dispatch_stress_matches_solo(engine, sample_request):
+    """100 concurrent mixed-size requests through the batcher (overlapped
+    dispatches, group-batched encode) return exactly what each request
+    would get alone — ordering, per-request drift, everything."""
+    rng = np.random.default_rng(9)
+    requests = []
+    for i in range(100):
+        rec = dict(sample_request[0])
+        rec["age"] = float(20 + (i % 50))
+        rec["bill_amount_1"] = float(rng.integers(100, 5000))
+        requests.append([rec] * int(rng.integers(1, GROUP_ROW_BUCKET + 1)))
+
+    expected = [engine.predict_records(r) for r in requests]
+
+    async def run():
+        executor = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        batcher = MicroBatcher(engine, executor, window_ms=1.0)
+        return await asyncio.gather(
+            *[batcher.predict(r) for r in requests]
+        )
+
+    got = asyncio.run(run())
+    for g, e in zip(got, expected):
+        assert g["predictions"] == pytest.approx(e["predictions"], abs=1e-6)
+        assert g["outliers"] == e["outliers"]
+        for name, score in e["feature_drift_batch"].items():
+            assert g["feature_drift_batch"][name] == pytest.approx(
+                score, abs=1e-5
+            )
