@@ -229,6 +229,27 @@ def test_download_dir_prefix_is_exact(client, tmp_path):
         storage.download_dir("gs://est/reg/m/versions/3", tmp_path / "v3", client)
 
 
+def test_expired_token_refreshes_once(fake):
+    """A 401 (metadata-server token expired mid-process) drops the cached
+    token and retries once with a fresh one — long-lived serving
+    replicas and >1h training jobs must survive token expiry."""
+    state = {"expired": True}
+
+    def transport(method, url, data, headers):
+        if "metadata.google.internal" in url:
+            token = "tok-2" if not state["expired"] else "tok-1"
+            return 200, json.dumps({"access_token": token}).encode()
+        if headers.get("Authorization") == "Bearer tok-1":
+            state["expired"] = False  # server rejects the stale token
+            return 401, b"{}"
+        return fake.transport(method, url, data, headers)
+
+    client = storage.GCSClient(transport=transport)
+    client.write_bytes("gs://est/x", b"payload")  # first call: 401 -> refresh
+    assert client.read_bytes("gs://est/x") == b"payload"
+    assert client._token == "tok-2"
+
+
 def test_registry_gcs_orphan_scan(client, tmp_path):
     """A crashed upload (objects, no index entry) can't collide."""
     from mlops_tpu.bundle.registry import ModelRegistry
