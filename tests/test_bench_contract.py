@@ -1,0 +1,37 @@
+"""bench.py's one-JSON-line contract under failure.
+
+The driver parses the LAST stdout line of `python bench.py` as JSON
+(`BENCH_r{N}.json`); round 1 lost its benchmark to a crash that printed
+a traceback instead. The contract is now: ANY failure still emits one
+parseable line with an ``error`` field and a nonzero exit code.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_forced_failure_still_emits_one_json_line():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/tmp",
+            "JAX_PLATFORMS": "bogus-backend",
+        },
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode != 0
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout at all; stderr:\n{proc.stderr[-500:]}"
+    payload = json.loads(lines[-1])
+    assert payload["metric"] == "inference_p50_latency_ms"
+    assert payload["value"] is None
+    assert payload["vs_baseline"] == 0.0
+    assert "bogus-backend" in payload["error"]
