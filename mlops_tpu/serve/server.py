@@ -70,6 +70,13 @@ class HttpServer:
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
         self._openapi: dict | None = None  # built lazily, served cached
+        # Drain bookkeeping: open client transports and the subset with an
+        # exchange currently in flight (between request read and response
+        # write). SIGTERM closes idle transports immediately and lets busy
+        # ones finish their current response (serve/server.py::_serve).
+        self.draining = False
+        self._connections: set[asyncio.StreamWriter] = set()
+        self._busy: set[asyncio.StreamWriter] = set()
         self.batcher = MicroBatcher(
             engine,
             self._executor,
@@ -81,6 +88,7 @@ class HttpServer:
     async def handle_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        self._connections.add(writer)
         try:
             while True:
                 request_line = await reader.readline()
@@ -125,16 +133,28 @@ class HttpServer:
                 if length:
                     body = await reader.readexactly(length)
 
-                keep_alive = headers.get("connection", "keep-alive") != "close"
-                start = time.perf_counter()
-                status, payload, content_type = await self._route(
-                    method, path.split("?")[0], body
+                # A draining server finishes the current exchange but
+                # advertises connection: close and stops looping.
+                keep_alive = (
+                    headers.get("connection", "keep-alive") != "close"
+                    and not self.draining
                 )
-                latency_ms = (time.perf_counter() - start) * 1e3
-                self.metrics.observe_request(path.split("?")[0], status, latency_ms)
-                await self._write_response(
-                    writer, status, payload, content_type, keep_alive
-                )
+                self._busy.add(writer)
+                try:
+                    start = time.perf_counter()
+                    status, payload, content_type = await self._route(
+                        method, path.split("?")[0], body
+                    )
+                    latency_ms = (time.perf_counter() - start) * 1e3
+                    self.metrics.observe_request(
+                        path.split("?")[0], status, latency_ms
+                    )
+                    keep_alive = keep_alive and not self.draining
+                    await self._write_response(
+                        writer, status, payload, content_type, keep_alive
+                    )
+                finally:
+                    self._busy.discard(writer)
                 if not keep_alive:
                     break
         except (
@@ -144,6 +164,7 @@ class HttpServer:
         ):
             pass
         finally:
+            self._connections.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()
@@ -328,14 +349,58 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
             logger.error("warmup failed, shutting down: %s", err)
             srv.close()
 
+    # Graceful drain on SIGTERM (K8s sends it on rollout/scale-down; the
+    # default would sever in-flight requests mid-response): stop
+    # accepting, flip readiness to 503 so the endpoint leaves the
+    # Service, close IDLE keep-alive connections immediately (they would
+    # otherwise hold ``wait_closed`` open forever), let busy exchanges
+    # finish their current response, then exit 0.
+    import contextlib
+    import signal
+
+    draining = asyncio.Event()
+
+    def _drain(signum, frame=None) -> None:
+        logger.info("SIGTERM: draining (no new connections)")
+        server.draining = True
+        engine.ready = False  # /healthz/ready -> 503
+        draining.set()
+        srv.close()
+        for w in list(server._connections - server._busy):
+            w.close()  # idle readline() sees EOF; handler exits
+
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _drain, signal.SIGTERM)
+    except (NotImplementedError, RuntimeError):
+        pass  # non-unix event loops: no graceful path, default semantics
+
     warm_task = asyncio.create_task(_warm())
     try:
-        async with srv:
-            await srv.serve_forever()
+        # NOT ``async with srv``: its __aexit__ awaits wait_closed(),
+        # which on 3.12+ blocks until every connection drops — an idle
+        # keep-alive client would stall shutdown past the kubelet's
+        # SIGKILL. The drain path closes connections itself.
+        await srv.serve_forever()
     except asyncio.CancelledError:
         pass
     finally:
+        srv.close()
         await warm_task
+        if draining.is_set():
+            # Warmup may have finished AFTER the drain flip and
+            # re-advertised readiness; a draining pod is never ready.
+            engine.ready = False
+            # Busy exchanges get a bounded window to write their
+            # responses (the kubelet's terminationGracePeriodSeconds is
+            # the hard stop); whatever remains is then force-closed.
+            deadline = loop.time() + 30.0
+            while server._busy and loop.time() < deadline:
+                await asyncio.sleep(0.05)
+            for w in list(server._connections):
+                w.close()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(srv.wait_closed(), timeout=5)
+            logger.info("drained; exiting")
     if warmup_error:
         raise SystemExit(f"warmup failed: {warmup_error[0]}")
 

@@ -275,3 +275,84 @@ def test_openapi_document(engine):
     output = doc["components"]["schemas"]["FeatureBatchDrift"]
     assert len(output["properties"]) == 23
     assert hstatus == 200 and b"swagger-ui" in hbody
+
+
+def test_sigterm_graceful_drain():
+    """SIGTERM flips readiness, closes IDLE keep-alive connections
+    immediately, lets an IN-FLIGHT request finish its response, and
+    _serve returns promptly (K8s rollout contract) — the idle-connection
+    case is what stalls a naive wait_closed() shutdown forever."""
+    import os
+    import signal
+    import time as _time
+
+    from mlops_tpu.serve.server import _serve
+
+    class StubEngine:
+        ready = False
+        max_bucket = 64
+        supports_grouping = False
+
+        def warmup(self):
+            self.ready = True
+
+        def predict_records(self, records):
+            _time.sleep(0.8)  # in-flight work straddling the SIGTERM
+            return {
+                "predictions": [0.5],
+                "outliers": [0.0],
+                "feature_drift_batch": dict.fromkeys(FEATURE_NAMES, 0.0),
+            }
+
+    engine = StubEngine()
+    body = json.dumps([{}]).encode()
+    request = (
+        b"POST /predict HTTP/1.1\r\nhost: t\r\n"
+        b"content-type: application/json\r\n"
+        + f"content-length: {len(body)}\r\n\r\n".encode()
+        + body
+    )
+
+    async def run():
+        config = ServeConfig(host="127.0.0.1", port=5173)
+        serve_task = asyncio.create_task(_serve(engine, config))
+        for _ in range(100):  # wait for bind + warmup
+            if engine.ready:
+                break
+            await asyncio.sleep(0.05)
+        assert engine.ready
+
+        # Idle keep-alive connection: must be closed by the drain, not
+        # hold shutdown open.
+        idle_reader, idle_writer = await asyncio.open_connection(
+            "127.0.0.1", config.port
+        )
+        # In-flight request: send, then SIGTERM while the stub predict
+        # sleeps; the response must still arrive complete.
+        busy_reader, busy_writer = await asyncio.open_connection(
+            "127.0.0.1", config.port
+        )
+        busy_writer.write(request)
+        await busy_writer.drain()
+        await asyncio.sleep(0.2)  # let the exchange enter _route
+
+        t0 = asyncio.get_running_loop().time()
+        os.kill(os.getpid(), signal.SIGTERM)
+
+        head = await asyncio.wait_for(busy_reader.readline(), timeout=10)
+        assert b"200" in head
+        raw = await asyncio.wait_for(busy_reader.read(), timeout=10)
+        assert b"predictions" in raw
+        assert b"connection: close" in (head + raw).lower()
+
+        # The idle connection gets EOF instead of stalling shutdown.
+        assert await asyncio.wait_for(idle_reader.read(), timeout=10) == b""
+
+        await asyncio.wait_for(serve_task, timeout=10)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert elapsed < 8, f"drain took {elapsed:.1f}s"
+        for w in (idle_writer, busy_writer):
+            w.close()
+
+    asyncio.run(run())
+    assert engine.ready is False  # readiness stays down through exit
