@@ -33,6 +33,9 @@ class ModelConfig:
     embed_dim: int = 16
     dropout: float = 0.1
     precision: str = "bf16"  # compute dtype on MXU: bf16 | f32 (params stay f32)
+    ensemble_size: int = 1  # >1 wraps the Flax family in a vmapped deep
+    # ensemble (models/ensemble.py) — the MXU-native answer to the
+    # reference's RandomForest variance reduction; 1 = single model
     # FT-Transformer specifics
     depth: int = 3
     heads: int = 8

@@ -18,12 +18,15 @@ logits[f32 N]`` so the trainer, bundle, and server are family-agnostic.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from mlops_tpu.config import ModelConfig
 from mlops_tpu.models.bert import BertEncoder
+from mlops_tpu.models.ensemble import DeepEnsemble
 from mlops_tpu.models.ft_transformer import FTTransformer
 from mlops_tpu.models.mlp import MLP, LinearModel
 from mlops_tpu.schema.features import SCHEMA
@@ -32,7 +35,15 @@ FAMILIES = ("linear", "mlp", "ft_transformer", "bert")
 
 
 def build_model(config: ModelConfig) -> nn.Module:
-    """Instantiate a model family from config (embedding sizes from SCHEMA)."""
+    """Instantiate a model family from config (embedding sizes from SCHEMA).
+
+    ``ensemble_size > 1`` wraps the family in a vmapped deep ensemble
+    (models/ensemble.py) — same calling convention, K× the params with a
+    leading member axis.
+    """
+    if config.ensemble_size > 1:
+        single = dataclasses.replace(config, ensemble_size=1)
+        return DeepEnsemble(member=build_model(single), size=config.ensemble_size)
     dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[config.precision]
     if config.family == "linear":
         return LinearModel(cards=SCHEMA.cards, dtype=dtype)
@@ -85,6 +96,7 @@ def init_params(model: nn.Module, rng: jax.Array, batch: int = 2):
 __all__ = [
     "FAMILIES",
     "BertEncoder",
+    "DeepEnsemble",
     "FTTransformer",
     "LinearModel",
     "MLP",

@@ -1,0 +1,60 @@
+"""Vmapped deep ensemble — K members, one compiled program.
+
+The reference's quality model is a RandomForest — itself an ensemble of
+trees, which is why it is a strong tabular baseline
+(`01-train-model.ipynb:195-227`). The TPU-native counter is a deep
+ensemble of the Flax families: K independently-initialized members train
+simultaneously under one ``nn.vmap`` (the member axis becomes a leading
+batch dimension on every parameter — XLA turns the K small matmuls into
+one batched matmul on the MXU, so the marginal cost of K=8 members at
+these widths is near zero), and serving averages the K predicted
+probabilities. Diversity comes from split init and dropout rngs per
+member, matching how forest variance reduction comes from per-tree
+randomness.
+
+Calling convention is the zoo's standard one (``models/__init__.py``)
+with one deliberate asymmetry:
+
+- ``train=True``  -> logits ``[K, N]`` — each member its own head, so the
+  mean BCE over the array is the average of independent member losses and
+  gradients never couple members (coupled training would collapse the
+  variance the ensemble exists to reduce);
+- ``train=False`` -> logits ``[N]`` — the logit of the mean member
+  probability, keeping the trainer's eval, the fused predict path and the
+  serving engine family-agnostic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class DeepEnsemble(nn.Module):
+    """K-member ensemble of any zoo module, lifted with ``nn.vmap``."""
+
+    member: nn.Module
+    size: int
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        def member_call(mdl: nn.Module, cat: jnp.ndarray, num: jnp.ndarray):
+            return mdl(cat, num, train=train)
+
+        vmapped = nn.vmap(
+            member_call,
+            in_axes=(None, None),  # every member sees the same minibatch
+            out_axes=0,
+            axis_size=self.size,
+            variable_axes={"params": 0},  # member axis leads every param
+            split_rngs={"params": True, "dropout": True},  # the diversity
+        )
+        logits = vmapped(self.member, cat_ids, numeric)  # [K, N]
+        if train:
+            return logits
+        probs = jnp.mean(jax.nn.sigmoid(logits.astype(jnp.float32)), axis=0)
+        probs = jnp.clip(probs, 1e-7, 1.0 - 1e-7)
+        return jnp.log(probs) - jnp.log1p(-probs)

@@ -59,6 +59,11 @@ def param_shardings(
         path_s = _path_str(path)
         for pattern, spec in rules:
             if re.search(pattern, path_s):
+                if leaf.ndim > len(spec):
+                    # Extra LEADING axes (deep-ensemble member axis, vmapped
+                    # HPO trial axis) replicate; the rule's axes stay aligned
+                    # to the kernel's own trailing dims.
+                    spec = P(*([None] * (leaf.ndim - len(spec)) + list(spec)))
                 trimmed = P(*spec[: leaf.ndim])
                 # Drop 'model' axes that don't divide the dim (tiny leaves).
                 sizes = dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -150,6 +150,12 @@ def run_training(
     else:
         model = build_model(config.model)
         init_variables = None
+        if config.train.init_params and config.model.ensemble_size > 1:
+            raise ValueError(
+                "train.init_params grafts a pretrained trunk by parameter "
+                "name, which cannot target the vmapped member axis of an "
+                "ensemble — use ensemble_size=1 for fine-tuning runs"
+            )
         if config.train.init_params:
             # Fine-tune from masked-feature pretraining (`pretrain` CLI):
             # trunk comes from the MLM run, heads stay freshly initialized.

@@ -132,3 +132,33 @@ def test_manifest_pins_environment(tiny_pipeline):
     pins = manifest["framework"]
     for key in ("mlops_tpu", "python", "jax", "flax", "optax", "numpy", "pydantic"):
         assert pins.get(key), f"missing environment pin: {key}"
+
+
+def test_ensemble_bundle_round_trip_through_engine(tmp_path):
+    """Train a small deep ensemble end to end, reload its bundle, and serve
+    it — the manifest must carry ensemble_size so load_bundle rebuilds the
+    vmapped module, and the engine must stay family-agnostic."""
+    from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.schema import LoanApplicant
+    from mlops_tpu.serve.engine import InferenceEngine
+    from mlops_tpu.train.pipeline import run_training
+
+    config = Config()
+    config.data.rows = 2000
+    config.model = ModelConfig(
+        family="mlp", ensemble_size=2, hidden_dims=(16, 16), embed_dim=4
+    )
+    config.train = TrainConfig(steps=40, eval_every=40, batch_size=256)
+    config.registry.root = str(tmp_path / "registry")
+    config.registry.run_root = str(tmp_path / "runs")
+    result = run_training(config, register=False)
+    assert np.isfinite(result.train_result.metrics["validation_roc_auc_score"])
+
+    bundle = load_bundle(result.bundle_dir)
+    assert bundle.manifest["model_config"]["ensemble_size"] == 2
+    engine = InferenceEngine(bundle, buckets=(1, 8))
+    engine.warmup()
+    out = engine.predict_records([LoanApplicant().model_dump()])
+    assert len(out["predictions"]) == 1
+    assert 0.0 <= out["predictions"][0] <= 1.0
+    assert out["outliers"][0] in (0.0, 1.0)

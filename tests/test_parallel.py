@@ -130,3 +130,53 @@ def test_collectives_semantics():
     shifted = ring_shift(mesh)(x)
     expected = np.roll(np.asarray(x).reshape(8, 2), 1, axis=0).reshape(-1)
     np.testing.assert_array_equal(np.asarray(shifted), expected)
+
+
+def test_param_rules_skip_ensemble_member_axis():
+    """Deep-ensemble params carry a leading member axis; the TP rules must
+    land on the kernel's own trailing dims and replicate the member axis."""
+    model = build_model(
+        ModelConfig(family="mlp", ensemble_size=4, hidden_dims=(64, 64))
+    )
+    variables = init_params(model, jax.random.PRNGKey(0))
+    mesh = make_mesh(8, model_parallel=2)
+    shardings = param_shardings(mesh, variables["params"])
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]
+    }
+    a_specs = [s.spec for name, s in flat.items() if "dense_0a/kernel" in name]
+    # kernel is [K, in, out]: member axis replicated, output dim on 'model'
+    assert a_specs and all(
+        spec[0] is None and spec[2] == "model" for spec in a_specs
+    )
+    b_specs = [s.spec for name, s in flat.items() if "dense_0b/kernel" in name]
+    assert b_specs and all(
+        spec[0] is None and spec[1] == "model" for spec in b_specs
+    )
+
+
+def test_sharded_train_step_runs_with_ensemble():
+    """The DP/TP train step composes with the ensemble's member vmap."""
+    config = ModelConfig(
+        family="mlp", ensemble_size=2, hidden_dims=(32, 32), dropout=0.0,
+        precision="f32",
+    )
+    tconfig = TrainConfig(batch_size=32, steps=1, learning_rate=1e-3)
+    model = build_model(config)
+    variables = init_params(model, jax.random.PRNGKey(0))
+    optimizer = make_optimizer(tconfig)
+    mesh = make_mesh(8, model_parallel=2)
+    step_fn, _ = make_sharded_train_step(
+        model, optimizer, tconfig, mesh, variables["params"]
+    )
+    state = TrainState(
+        params=variables["params"],
+        opt_state=optimizer.init(variables["params"]),
+        step=jnp.asarray(0, jnp.int32),
+        rng=jax.random.PRNGKey(1),
+    )
+    cat, num, lab = _batch(32)
+    new_state, loss = step_fn(state, cat, num, lab, jax.random.PRNGKey(2))
+    assert np.isfinite(float(loss))
+    assert int(new_state.step) == 1
