@@ -300,7 +300,9 @@ def main() -> None:
         batch_size=1024, steps=600, eval_every=600, warmup_steps=60
     )
     config.registry.run_root = "runs/bench"
+    t_train = time.perf_counter()
     result = run_training(config, register=False, run_name="bench")
+    train_wall_s = time.perf_counter() - t_train
     bundle = load_bundle(result.bundle_dir)
 
     engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256, 4096, 16384))
@@ -326,6 +328,12 @@ def main() -> None:
                 **http,
                 "device": str(device),
                 "model": family if ensemble == 1 else f"{family}-ens{ensemble}",
+                # Training throughput for the bundle above (data gen +
+                # encode + compile + scan windows): rows/s = steps×batch/wall.
+                "train_wall_s": round(train_wall_s, 1),
+                "train_rows_per_s": round(
+                    config.train.steps * config.train.batch_size / train_wall_s, 1
+                ),
                 "model_auc": round(
                     result.train_result.metrics["validation_roc_auc_score"], 4
                 ),

@@ -49,6 +49,12 @@ class Bundle:
     def model_config(self) -> ModelConfig:
         return _model_config_from_manifest(self.manifest)
 
+    @property
+    def temperature(self) -> float:
+        """Fitted calibration temperature (train/calibrate.py); 1.0 when
+        the bundle predates calibration or the fit was degenerate."""
+        return float(self.manifest.get("calibration", {}).get("temperature", 1.0))
+
 
 def _model_config_from_manifest(manifest: dict[str, Any]) -> ModelConfig:
     """JSON lists -> tuples so manifests round-trip to equal ModelConfigs."""
@@ -89,6 +95,7 @@ def save_bundle(
     monitor: MonitorState,
     metrics: dict[str, float] | None = None,
     tags: dict[str, str] | None = None,
+    calibration: dict[str, float] | None = None,
 ) -> Path:
     """Write a self-contained bundle directory.
 
@@ -110,6 +117,7 @@ def save_bundle(
         "model_config": dataclasses.asdict(model_config),
         "metrics": metrics or {},
         "tags": tags or {},
+        "calibration": calibration or {},
     }
     if flavor == "sklearn":
         params.save(directory / ESTIMATOR_NAME)  # a SklearnBaseline

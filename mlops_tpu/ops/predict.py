@@ -17,24 +17,31 @@ import jax
 import jax.numpy as jnp
 
 from mlops_tpu.monitor.state import MonitorState, drift_scores, outlier_flags
+from mlops_tpu.train.calibrate import apply_temperature
 
 
 def make_predict_fn(
-    model, variables: Any, monitor: MonitorState
+    bundle,
 ) -> Callable[[jnp.ndarray, jnp.ndarray], dict[str, jnp.ndarray]]:
-    """Build the jitted fused predict: (cat_ids, numeric) -> response arrays.
+    """Build the jitted fused predict for a loaded (flax-flavor) bundle:
+    (cat_ids, numeric) -> response arrays.
 
     Returns a function producing the reference's response fields
     (`app/model.py:64-70`): ``predictions`` (P(default) per row),
     ``outliers`` (0/1 per row), ``feature_drift_batch`` (per-feature
-    ``1 - p_val`` scores for the batch).
+    ``1 - p_val`` scores for the batch). Takes the whole bundle so the
+    fitted calibration temperature (train/calibrate.py) cannot be
+    forgotten — the lower-level ``make_*_predict_fn`` builders are for
+    the engine, which resolves it once.
     """
+    model, variables, monitor = bundle.model, bundle.variables, bundle.monitor
+    temperature = bundle.temperature
 
     @jax.jit
     def predict(cat_ids: jnp.ndarray, numeric: jnp.ndarray):
         logits = model.apply(variables, cat_ids, numeric, train=False)
         return {
-            "predictions": jax.nn.sigmoid(logits),
+            "predictions": jax.nn.sigmoid(logits / temperature),
             "outliers": outlier_flags(monitor, numeric),
             "feature_drift_batch": drift_scores(monitor, cat_ids, numeric),
         }
@@ -43,7 +50,7 @@ def make_predict_fn(
 
 
 def make_padded_predict_fn(
-    model, variables: Any, monitor: MonitorState
+    model, variables: Any, monitor: MonitorState, temperature: float = 1.0
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, jnp.ndarray]]:
     """Fused predict for serving: takes a row-validity mask so batches padded
     to fixed bucket sizes produce statistics identical to the unpadded batch
@@ -54,7 +61,7 @@ def make_padded_predict_fn(
     def predict(cat_ids: jnp.ndarray, numeric: jnp.ndarray, mask: jnp.ndarray):
         logits = model.apply(variables, cat_ids, numeric, train=False)
         return {
-            "predictions": jax.nn.sigmoid(logits),
+            "predictions": jax.nn.sigmoid(logits / temperature),
             "outliers": outlier_flags(monitor, numeric, mask),
             "feature_drift_batch": drift_scores(monitor, cat_ids, numeric, mask),
         }
@@ -63,7 +70,7 @@ def make_padded_predict_fn(
 
 
 def make_grouped_predict_fn(
-    model, variables: Any, monitor: MonitorState
+    model, variables: Any, monitor: MonitorState, temperature: float = 1.0
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, jnp.ndarray]]:
     """Vmapped fused predict for the micro-batching queue: R concurrent
     requests ride ONE device dispatch as ``[R, B, ...]`` stacks, and the
@@ -76,7 +83,7 @@ def make_grouped_predict_fn(
     def single(cat_ids, numeric, mask):
         logits = model.apply(variables, cat_ids, numeric, train=False)
         return {
-            "predictions": jax.nn.sigmoid(logits),
+            "predictions": jax.nn.sigmoid(logits / temperature),
             "outliers": outlier_flags(monitor, numeric, mask),
             "feature_drift_batch": drift_scores(monitor, cat_ids, numeric, mask),
         }
@@ -89,7 +96,7 @@ def make_grouped_predict_fn(
 
 
 def make_hybrid_predict_fn(
-    estimator, monitor: MonitorState
+    estimator, monitor: MonitorState, temperature: float = 1.0
 ) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, Any]]:
     """Fused predict for the sklearn-flavor bundle (BASELINE config 1 floor).
 
@@ -114,9 +121,10 @@ def make_hybrid_predict_fn(
         # inference); scatter back so the output length matches the bucket.
         valid = np.asarray(mask)
         probs = np.zeros(valid.shape[0], np.float32)
-        probs[valid] = estimator.predict_proba(
+        p = estimator.predict_proba(
             np.asarray(cat_ids)[valid], np.asarray(numeric)[valid]
         )
+        probs[valid] = apply_temperature(p, temperature)
         out["predictions"] = probs
         return out
 

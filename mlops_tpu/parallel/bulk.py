@@ -70,6 +70,8 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
     equal-sized chunks so a single compile serves the whole sweep).
     Sharded over 'data' when a mesh is given."""
     monitor = bundle.monitor
+    temperature = bundle.temperature  # calibration (train/calibrate.py):
+    # bulk scores must match what the serving engine would return
 
     if bundle.flavor == "sklearn":
         estimator = bundle.estimator
@@ -78,9 +80,12 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
         def outliers_only(num, mask):
             return outlier_flags(monitor, num, mask)
 
+        from mlops_tpu.train.calibrate import apply_temperature
+
         def score_chunk(cat, num, mask):
             probs = np.zeros(mask.shape[0], np.float32)
-            probs[mask] = estimator.predict_proba(cat[mask], num[mask])
+            p = estimator.predict_proba(cat[mask], num[mask])
+            probs[mask] = apply_temperature(p, temperature)
             return probs, np.asarray(outliers_only(num, mask))
 
         return score_chunk
@@ -93,7 +98,7 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
         # bottleneck on remote-attached chips (~20 MB/s measured), and
         # int8 cuts the categorical block's bytes 4x.
         logits = model.apply(variables, cat.astype(jnp.int32), num, train=False)
-        return jax.nn.sigmoid(logits), outlier_flags(monitor, num, mask)
+        return jax.nn.sigmoid(logits / temperature), outlier_flags(monitor, num, mask)
 
     if mesh is None:
         return _bind_vars(jax.jit(fused), variables)

@@ -51,20 +51,21 @@ class InferenceEngine:
         self.buckets = sorted(buckets)
         self.max_bucket = self.buckets[-1]
         self.service_name = service_name
+        temperature = bundle.temperature  # calibration (train/calibrate.py)
         if bundle.flavor == "sklearn":
             # CPU tree-ensemble floor: host classifier + device monitors.
             # No grouped path — trees run on host threads anyway.
             self._predict = make_hybrid_predict_fn(
-                bundle.estimator, bundle.monitor
+                bundle.estimator, bundle.monitor, temperature
             )
             self._predict_group = None
         else:
             self._predict = make_padded_predict_fn(
-                bundle.model, bundle.variables, bundle.monitor
+                bundle.model, bundle.variables, bundle.monitor, temperature
             )
             self._predict_group = (
                 make_grouped_predict_fn(
-                    bundle.model, bundle.variables, bundle.monitor
+                    bundle.model, bundle.variables, bundle.monitor, temperature
                 )
                 if enable_grouping
                 else None

@@ -70,6 +70,34 @@ def split_dataset(
     return ds.slice(perm[n_valid:]), ds.slice(perm[:n_valid])
 
 
+def _fit_calibration(
+    valid_ds: EncodedDataset, params: Any, model=None
+) -> dict[str, float]:
+    """Temperature-scale on the held-out split (train/calibrate.py): the
+    bundle serves ``sigmoid(logit / T)`` instead of the reference's raw
+    ``predict_proba`` (`02-register-model.ipynb:330-353` has no
+    calibration step). ``model=None`` means the sklearn flavor, where
+    ``params`` is the estimator and logits come from its probabilities."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.train.calibrate import calibration_record, probs_to_logits
+
+    if model is None:
+        logits = probs_to_logits(
+            params.predict_proba(valid_ds.cat_ids, valid_ds.numeric)
+        )
+    else:
+        logits = np.asarray(
+            model.apply(
+                {"params": params},
+                jnp.asarray(valid_ds.cat_ids),
+                jnp.asarray(valid_ds.numeric),
+                train=False,
+            )
+        )
+    return calibration_record(logits, valid_ds.labels)
+
+
 def _package_and_register(
     config: Config,
     run_dir: Path,
@@ -80,6 +108,7 @@ def _package_and_register(
     bundle_tags: dict[str, str],
     registry_tags: dict[str, str],
     register: bool,
+    calibration: dict[str, float] | None = None,
 ) -> tuple[Path, str | None]:
     """Shared packaging tail: fit monitors, write the bundle, register it
     (notebook 02's role — `02-register-model.ipynb` cells 6-15).
@@ -103,6 +132,7 @@ def _package_and_register(
         monitor,
         metrics=metrics,
         tags=bundle_tags,
+        calibration=calibration,
     )
     model_uri = None
     if register:
@@ -137,6 +167,7 @@ def run_training(
     ds = preprocessor.encode(columns, labels)
     train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
 
+    calibration_model = None
     if config.model.family in SKLEARN_FAMILIES:
         # BASELINE config 1: the CPU tree-ensemble comparison floor, trained
         # and packaged through the exact same pipeline tail as the TPU models.
@@ -176,7 +207,9 @@ def run_training(
             metrics_path=run_dir / "metrics.jsonl",
             checkpoint_dir=run_dir / "checkpoints",
         )
+        calibration_model = model
 
+    calibration = _fit_calibration(valid_ds, result.params, calibration_model)
     bundle_dir, model_uri = _package_and_register(
         config,
         run_dir,
@@ -193,6 +226,7 @@ def run_training(
             **{k: f"{v:.6f}" for k, v in result.metrics.items()},
         },
         register=register,
+        calibration=calibration,
     )
     return PipelineResult(
         bundle_dir=bundle_dir,
@@ -249,6 +283,9 @@ def run_tuning(
         )
     )
 
+    calibration = _fit_calibration(
+        valid_ds, hpo_result.best_params, build_model(config.model)
+    )
     bundle_dir, model_uri = _package_and_register(
         config,
         run_dir,
@@ -266,6 +303,7 @@ def run_tuning(
             "best_trial": str(hpo_result.best_index),
         },
         register=register,
+        calibration=calibration,
     )
     result = PipelineResult(
         bundle_dir=bundle_dir,

@@ -38,7 +38,7 @@ def test_bundle_round_trip_predictions_identical(trained):
     bundle = load_bundle(result.bundle_dir)
     from mlops_tpu.ops.predict import make_predict_fn
 
-    predict = make_predict_fn(bundle.model, bundle.variables, bundle.monitor)
+    predict = make_predict_fn(bundle)
     from mlops_tpu.data import generate_synthetic
 
     columns, _ = generate_synthetic(50, seed=42)
@@ -50,7 +50,7 @@ def test_bundle_round_trip_predictions_identical(trained):
     assert out["feature_drift_batch"].shape == (23,)
     # Load a second time: bit-identical outputs (deterministic packaging).
     bundle2 = load_bundle(result.bundle_dir)
-    predict2 = make_predict_fn(bundle2.model, bundle2.variables, bundle2.monitor)
+    predict2 = make_predict_fn(bundle2)
     out2 = predict2(jnp.asarray(ds.cat_ids), jnp.asarray(ds.numeric))
     np.testing.assert_array_equal(
         np.asarray(out["predictions"]), np.asarray(out2["predictions"])
