@@ -28,7 +28,7 @@ class DataConfig:
 
 @dataclasses.dataclass
 class ModelConfig:
-    family: str = "mlp"  # mlp | ft_transformer | linear | bert | gbm | rf
+    family: str = "mlp"  # mlp | ft_transformer | moe | linear | bert | gbm | rf
     hidden_dims: tuple[int, ...] = (256, 256, 128)
     embed_dim: int = 16
     dropout: float = 0.1
@@ -36,10 +36,12 @@ class ModelConfig:
     ensemble_size: int = 1  # >1 wraps the Flax family in a vmapped deep
     # ensemble (models/ensemble.py) — the MXU-native answer to the
     # reference's RandomForest variance reduction; 1 = single model
-    # FT-Transformer specifics
+    # FT-Transformer / MoE specifics
     depth: int = 3
     heads: int = 8
     token_dim: int = 64
+    num_experts: int = 8  # moe family: experts per block; the stacked
+    # expert axis shards over the mesh 'model' axis (expert parallelism)
     # CPU tree-baseline specifics (families gbm/rf — BASELINE config 1;
     # bounds mirror the reference's hyperopt space, `01-train-model.ipynb:342-353`)
     n_estimators: int = 300

@@ -29,9 +29,10 @@ from mlops_tpu.models.bert import BertEncoder
 from mlops_tpu.models.ensemble import DeepEnsemble
 from mlops_tpu.models.ft_transformer import FTTransformer
 from mlops_tpu.models.mlp import MLP, LinearModel
+from mlops_tpu.models.moe import MoETransformer
 from mlops_tpu.schema.features import SCHEMA
 
-FAMILIES = ("linear", "mlp", "ft_transformer", "bert")
+FAMILIES = ("linear", "mlp", "ft_transformer", "moe", "bert")
 
 
 def build_model(config: ModelConfig) -> nn.Module:
@@ -62,6 +63,17 @@ def build_model(config: ModelConfig) -> nn.Module:
             token_dim=config.token_dim,
             depth=config.depth,
             heads=config.heads,
+            dropout=config.dropout,
+            dtype=dtype,
+        )
+    if config.family == "moe":
+        return MoETransformer(
+            cards=SCHEMA.cards,
+            num_numeric=SCHEMA.num_numeric,
+            token_dim=config.token_dim,
+            depth=config.depth,
+            heads=config.heads,
+            num_experts=config.num_experts,
             dropout=config.dropout,
             dtype=dtype,
         )
@@ -100,6 +112,7 @@ __all__ = [
     "FTTransformer",
     "LinearModel",
     "MLP",
+    "MoETransformer",
     "build_model",
     "init_params",
 ]

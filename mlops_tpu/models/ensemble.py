@@ -49,7 +49,9 @@ class DeepEnsemble(nn.Module):
             in_axes=(None, None),  # every member sees the same minibatch
             out_axes=0,
             axis_size=self.size,
-            variable_axes={"params": 0},  # member axis leads every param
+            # member axis leads every param; sown auxiliaries (e.g. MoE
+            # load-balance losses) stack the same way
+            variable_axes={"params": 0, "aux_losses": 0},
             split_rngs={"params": True, "dropout": True},  # the diversity
         )
         logits = vmapped(self.member, cat_ids, numeric)  # [K, N]

@@ -1,0 +1,153 @@
+"""Mixture-of-experts transformer — expert parallelism for the zoo.
+
+The reference has no parallelism of any kind (SURVEY.md SS2.7); DP/TP/SP
+already exist here (`parallel/`), and this family adds the remaining
+axis: **expert parallelism**. The FFN of every transformer block becomes
+a top-2 gated mixture of experts whose stacked weights ``[E, D, F]``
+shard their leading expert axis over the mesh's 'model' axis
+(PARAM_RULES in `parallel/sharding.py`), so each device holds E/ep
+experts and XLA inserts the cross-expert collectives.
+
+TPU-first design choice — **dense dispatch**: every expert runs on every
+token via two einsums (``nsd,edf->nsef`` then ``nsef,efd->nsed``) and
+the gate weights zero out non-selected experts at combine time. At this
+scale (seq 24, few experts) the E× FLOPs are far cheaper than the
+gather/scatter of a sparse dispatch — the einsums stay static-shape
+batched matmuls on the MXU, which is exactly what a Switch/GShard
+capacity-buffer formulation degenerates to when tokens-per-expert is
+tiny. Routing runs in float32 (softmax over expert logits is
+precision-sensitive); compute stays bf16.
+
+Load balancing: the standard Switch auxiliary loss
+``E * sum(importance . load)`` is sown into the ``aux_losses``
+collection, scaled by ``aux_weight``; the trainers pick up every sown
+auxiliary through ``training_loss`` (`train/loop.py`) without knowing
+MoE exists.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from mlops_tpu.models.ft_transformer import FeatureTokenizer
+from mlops_tpu.models.layers import MultiHeadSelfAttention
+
+
+class MoEFeedForward(nn.Module):
+    """Top-2 gated expert FFN with dense (all-matmul) dispatch."""
+
+    num_experts: int
+    token_dim: int
+    hidden_mult: int = 4
+    top_k: int = 2
+    aux_weight: float = 0.01
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:  # [N,S,D]
+        e, d = self.num_experts, self.token_dim
+        f = self.hidden_mult * d
+        k = min(self.top_k, e)
+
+        # Router in f32: softmax over expert logits is precision-sensitive.
+        gate_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # [N,S,E]
+        gates = jax.nn.softmax(gate_logits, axis=-1)
+        _, top_idx = jax.lax.top_k(gates, k)
+        mask = jax.nn.one_hot(top_idx, e, dtype=gates.dtype).sum(-2)  # [N,S,E]
+        weights = gates * mask
+        weights = weights / (weights.sum(-1, keepdims=True) + 1e-9)
+
+        if train:
+            # Switch load-balance loss: E * importance . load — minimized
+            # by uniform routing; scaled here so trainers stay MoE-blind.
+            importance = gates.mean(axis=(0, 1))  # [E] mean router prob
+            load = (mask / k).mean(axis=(0, 1))  # [E] fraction routed
+            aux = e * jnp.sum(importance * load)
+            self.sow("aux_losses", "moe_load_balance", self.aux_weight * aux)
+
+        w_in = self.param(
+            "experts_in", nn.initializers.normal(0.02), (e, d, f)
+        )
+        b_in = self.param("experts_in_bias", nn.initializers.zeros_init(), (e, f))
+        w_out = self.param(
+            "experts_out", nn.initializers.normal(0.02), (e, f, d)
+        )
+        b_out = self.param("experts_out_bias", nn.initializers.zeros_init(), (e, d))
+
+        xb = x.astype(self.dtype)
+        h = (
+            jnp.einsum("nsd,edf->nsef", xb, w_in.astype(self.dtype))
+            + b_in.astype(self.dtype)[None, None]
+        )
+        h = nn.gelu(h)
+        y = (
+            jnp.einsum("nsef,efd->nsed", h, w_out.astype(self.dtype))
+            + b_out.astype(self.dtype)[None, None]
+        )
+        return jnp.einsum("nse,nsed->nsd", weights.astype(self.dtype), y)
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN block: MHA + MoE FFN, residual, dropout."""
+
+    heads: int
+    token_dim: int
+    num_experts: int
+    dropout: float
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MultiHeadSelfAttention(
+            heads=self.heads, dtype=self.dtype, dropout=self.dropout
+        )(h, deterministic=not train)
+        x = x + nn.Dropout(self.dropout, deterministic=not train)(h)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MoEFeedForward(
+            num_experts=self.num_experts,
+            token_dim=self.token_dim,
+            dtype=self.dtype,
+        )(h, train=train)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class MoETransformer(nn.Module):
+    """FT-Transformer body with mixture-of-experts FFNs (family "moe")."""
+
+    cards: Sequence[int]
+    num_numeric: int
+    token_dim: int = 64
+    depth: int = 3
+    heads: int = 8
+    num_experts: int = 8
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        tokens = FeatureTokenizer(
+            self.cards, self.num_numeric, self.token_dim, dtype=self.dtype
+        )(cat_ids, numeric)
+        for i in range(self.depth):
+            tokens = MoEBlock(
+                heads=self.heads,
+                token_dim=self.token_dim,
+                num_experts=self.num_experts,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(tokens, train=train)
+        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(tokens[:, 0])
+        logit = nn.Dense(1, dtype=self.dtype, name="head")(cls)
+        return logit[:, 0].astype(jnp.float32)

@@ -15,6 +15,9 @@ module is the TPU-native capability the rebuild owes instead:
 - ``ring_attention`` exact sequence-parallel attention: K/V shards rotate
   the ICI ring via ppermute with online-softmax accumulation (long-context
   path for the BERT config; differentiable, so usable in training)
+- ``pipeline``    GPipe-style pipeline parallelism: stage-stacked weights
+  sharded over a 'stage' axis, microbatches streamed via one-hop ppermute
+  (differentiable scan; completes the DP/TP/SP/EP/PP set)
 
 - ``distributed``  multi-host (DCN) bring-up: env-detecting, idempotent
   ``jax.distributed.initialize`` wrapper + coordinator predicate; the same
@@ -28,6 +31,7 @@ from mlops_tpu.parallel.distributed import (
     is_coordinator,
 )
 from mlops_tpu.parallel.mesh import make_mesh, make_nd_mesh, mesh_shape_for
+from mlops_tpu.parallel.pipeline import make_pipeline
 from mlops_tpu.parallel.ring_attention import (
     make_ring_attention,
     ring_attention_shard,
@@ -50,6 +54,7 @@ __all__ = [
     "is_coordinator",
     "make_mesh",
     "make_nd_mesh",
+    "make_pipeline",
     "make_ring_attention",
     "make_sharded_batch_scorer",
     "make_sharded_train_step",

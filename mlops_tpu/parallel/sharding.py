@@ -29,6 +29,9 @@ PARAM_RULES: tuple[tuple[str, P], ...] = (
     # FT-Transformer MLP: Dense_0 widens (column), Dense_1 narrows (row).
     (r"block_\d+/Dense_0/kernel", P(None, "model")),
     (r"block_\d+/Dense_1/kernel", P("model", None)),
+    # MoE: stacked expert weights [E, ...] — EXPERT parallelism: each
+    # device holds E/ep experts (spec right-truncates for the 2-d biases).
+    (r"experts_", P("model", None, None)),
 )
 
 

@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from mlops_tpu.config import TrainConfig
 from mlops_tpu.parallel.sharding import batch_sharding, param_shardings, replicated
-from mlops_tpu.train.loop import TrainState, sigmoid_bce
+from mlops_tpu.train.loop import TrainState, training_loss
 
 
 def make_sharded_train_step(
@@ -47,14 +47,9 @@ def make_sharded_train_step(
 
     def step(state: TrainState, cat, num, lab, dropout_rng):
         def loss_of(params):
-            logits = model.apply(
-                {"params": params},
-                cat,
-                num,
-                train=True,
-                rngs={"dropout": dropout_rng},
+            return training_loss(
+                model, params, cat, num, lab, dropout_rng, config.pos_weight
             )
-            return sigmoid_bce(logits, lab, config.pos_weight)
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)

@@ -29,7 +29,7 @@ from mlops_tpu.config import HPOConfig, ModelConfig, TrainConfig
 from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.models import build_model
 from mlops_tpu.schema.features import SCHEMA
-from mlops_tpu.train.loop import sigmoid_bce
+from mlops_tpu.train.loop import training_loss
 from mlops_tpu.train.metrics import binary_metrics
 
 
@@ -129,14 +129,9 @@ def run_hpo(
             idx = jax.random.randint(idx_rng, (batch,), 0, n)
 
             def loss_of(p):
-                logits = model.apply(
-                    {"params": p},
-                    cat[idx],
-                    num[idx],
-                    train=True,
-                    rngs={"dropout": dropout_rng},
+                return training_loss(
+                    model, p, cat[idx], num[idx], lab[idx], dropout_rng, pw
                 )
-                return sigmoid_bce(logits, lab[idx], pw)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
             updates, opt_state = optimizer.update(grads, opt_state, params)

@@ -64,6 +64,35 @@ def sigmoid_bce(
     return per_example.mean()
 
 
+def training_loss(
+    model,
+    params: Any,
+    cat: jnp.ndarray,
+    num: jnp.ndarray,
+    lab: jnp.ndarray,
+    dropout_rng: jnp.ndarray,
+    pos_weight: float = 1.0,
+) -> jnp.ndarray:
+    """BCE plus every auxiliary the model sows into ``aux_losses`` (e.g.
+    the MoE load-balance term, `models/moe.py`) — the one loss definition
+    shared by the local scan trainer, the sharded pjit step and the
+    vmapped HPO trials, so trainers never need to know which families
+    carry auxiliaries (they sow pre-scaled values; non-MoE families sow
+    nothing and pay nothing)."""
+    logits, aux_state = model.apply(
+        {"params": params},
+        cat,
+        num,
+        train=True,
+        rngs={"dropout": dropout_rng},
+        mutable=["aux_losses"],
+    )
+    loss = sigmoid_bce(logits, lab, pos_weight)
+    for leaf in jax.tree_util.tree_leaves(aux_state):
+        loss = loss + jnp.mean(leaf)
+    return loss
+
+
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,
@@ -108,14 +137,15 @@ def make_train_window(
             idx = jax.random.randint(idx_rng, (config.batch_size,), 0, n)
 
             def loss_of(params):
-                logits = model.apply(
-                    {"params": params},
+                return training_loss(
+                    model,
+                    params,
                     cat[idx],
                     num[idx],
-                    train=True,
-                    rngs={"dropout": dropout_rng},
+                    lab[idx],
+                    dropout_rng,
+                    config.pos_weight,
                 )
-                return sigmoid_bce(logits, lab[idx], config.pos_weight)
 
             loss, grads = jax.value_and_grad(loss_of)(state.params)
             updates, opt_state = optimizer.update(

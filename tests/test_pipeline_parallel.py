@@ -1,0 +1,72 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe microbatch streaming
+over a 'stage' mesh axis, validated exactly against the sequential fold."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlops_tpu.parallel.mesh import make_nd_mesh
+from mlops_tpu.parallel.pipeline import make_pipeline
+
+
+def _stage_fn(w, h):
+    return jax.nn.gelu(h @ w[0] + w[1])
+
+
+def _setup(stages, micro, batch=8, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    weights = (
+        jnp.asarray(rng.normal(scale=0.1, size=(stages, dim, dim)).astype(np.float32)),
+        jnp.asarray(rng.normal(scale=0.1, size=(stages, dim)).astype(np.float32)),
+    )
+    x = jnp.asarray(rng.normal(size=(micro, batch, dim)).astype(np.float32))
+    return weights, x
+
+
+def _sequential(weights, x):
+    out = x
+    for s in range(weights[0].shape[0]):
+        out = _stage_fn((weights[0][s], weights[1][s]), out)
+    return out
+
+
+@pytest.mark.parametrize("stages,micro", [(2, 4), (4, 8), (8, 8), (4, 1)])
+def test_pipeline_matches_sequential_fold(stages, micro):
+    mesh = make_nd_mesh({"stage": stages})
+    weights, x = _setup(stages, micro)
+    run = make_pipeline(mesh, _stage_fn)
+    got = run(weights, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_sequential(weights, x)), atol=1e-5
+    )
+
+
+def test_pipeline_is_differentiable():
+    """The scan + ppermute pipeline must transpose for training: gradients
+    through the full pipeline equal gradients through the sequential fold."""
+    mesh = make_nd_mesh({"stage": 4})
+    weights, x = _setup(4, 4)
+    run = make_pipeline(mesh, _stage_fn)
+
+    g_pipe = jax.grad(lambda w: jnp.sum(run(w, x) ** 2))(weights)
+    g_ref = jax.grad(lambda w: jnp.sum(_sequential(w, x) ** 2))(weights)
+    np.testing.assert_allclose(
+        np.asarray(g_pipe[0]), np.asarray(g_ref[0]), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(g_pipe[1]), np.asarray(g_ref[1]), atol=1e-4
+    )
+
+
+def test_pipeline_composes_with_data_parallel_axis():
+    """('data', 'stage') hybrid mesh: the pipeline must ignore extra mesh
+    axes (inputs stay replicated — make_pipeline's in_specs are P() — so
+    this covers axis coexistence, not a DP-sharded batch)."""
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    weights, x = _setup(4, 4, batch=8)
+    run = make_pipeline(mesh, _stage_fn)
+    got = run(weights, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_sequential(weights, x)), atol=1e-5
+    )
