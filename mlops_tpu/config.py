@@ -61,6 +61,8 @@ class TrainConfig:
     pos_weight: float = 1.0  # class-imbalance weight on the positive class
     init_params: str = ""  # path to pretrained masked-LM params (`pretrain`
     # CLI output) to graft into the bert trunk before fine-tuning
+    tensorboard_dir: str = ""  # also stream metrics.jsonl records as TF
+    # scalar events here (utils/tboard.py); empty = jsonl only
 
 
 @dataclasses.dataclass

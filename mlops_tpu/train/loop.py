@@ -219,39 +219,53 @@ def fit(
     vcat, vnum, vlab = _device_put_dataset(valid_ds)
 
     writer = JsonlWriter(metrics_path) if metrics_path else None
+    tb_writer = None
+    if config.tensorboard_dir:
+        from mlops_tpu.utils.tboard import TensorBoardWriter
+
+        tb_writer = TensorBoardWriter(config.tensorboard_dir)
     history: list[dict[str, float]] = []
     step = start_step
     last_ckpt = start_step
-    while step < config.steps:
-        # Final window shrinks so the step budget is honored exactly even
-        # when steps % eval_every != 0 or when resuming mid-window.
-        window = min(base_window, config.steps - step)
-        run_window = window_fns.get(window)
-        if run_window is None:
-            run_window = make_train_window(model, optimizer, config, window)
-            window_fns[window] = run_window
-        state, mean_loss = run_window(state, cat, num, lab)
-        step = int(state.step)
-        record = {"step": step, "train_loss": float(mean_loss)}
-        record.update(
-            {
-                f"validation_{k}_score": float(v)
-                for k, v in eval_fn(state.params, vcat, vnum, vlab).items()
-            }
-        )
-        history.append(record)
-        if writer:
-            writer.write(record)
-        if (
-            checkpoint_dir is not None
-            and step - last_ckpt >= config.checkpoint_every
-        ):
+    try:
+        while step < config.steps:
+            # Final window shrinks so the step budget is honored exactly even
+            # when steps % eval_every != 0 or when resuming mid-window.
+            window = min(base_window, config.steps - step)
+            run_window = window_fns.get(window)
+            if run_window is None:
+                run_window = make_train_window(model, optimizer, config, window)
+                window_fns[window] = run_window
+            state, mean_loss = run_window(state, cat, num, lab)
+            step = int(state.step)
+            record = {"step": step, "train_loss": float(mean_loss)}
+            record.update(
+                {
+                    f"validation_{k}_score": float(v)
+                    for k, v in eval_fn(state.params, vcat, vnum, vlab).items()
+                }
+            )
+            history.append(record)
+            if writer:
+                writer.write(record)
+            if tb_writer:
+                tb_writer.write(record)
+            if (
+                checkpoint_dir is not None
+                and step - last_ckpt >= config.checkpoint_every
+            ):
+                ckpt.save_checkpoint(checkpoint_dir, state, step)
+                last_ckpt = step
+        if checkpoint_dir is not None and step > last_ckpt:
             ckpt.save_checkpoint(checkpoint_dir, state, step)
-            last_ckpt = step
-    if checkpoint_dir is not None and step > last_ckpt:
-        ckpt.save_checkpoint(checkpoint_dir, state, step)
-    if writer:
-        writer.close()
+    finally:
+        # Close on every exit: the tensorboard writer buffers events
+        # (flush_secs), so a mid-run crash would otherwise lose exactly
+        # the records the interactive debugging session needs.
+        if writer:
+            writer.close()
+        if tb_writer:
+            tb_writer.close()
 
     final = (
         history[-1]

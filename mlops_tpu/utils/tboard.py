@@ -1,0 +1,47 @@
+"""Optional TensorBoard event writer for training metrics.
+
+The metrics store of record is the run's ``metrics.jsonl``
+(`utils/jsonl.py`) — greppable, diffable, no daemon. This adds the
+SURVEY.md SS5.5 "jsonl + TensorBoard" counterpart for interactive runs:
+the same records stream into TF event files when
+``train.tensorboard_dir`` is set. The writer is import-gated (torch's
+``SummaryWriter`` is the only event-file encoder in this image); if it's
+absent the writer degrades to a no-op with one warning rather than
+failing training.
+"""
+
+from __future__ import annotations
+
+import warnings
+from pathlib import Path
+
+
+class TensorBoardWriter:
+    """Scalar-event writer; constructible even when tensorboard is absent."""
+
+    def __init__(self, logdir: str | Path):
+        self._writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=str(logdir))
+        except Exception as err:  # any import/init failure -> no-op
+            warnings.warn(
+                f"tensorboard writer unavailable ({err}); metrics go to "
+                "metrics.jsonl only",
+                stacklevel=2,
+            )
+
+    def write(self, record: dict) -> None:
+        """Log every numeric field of a metrics record at its 'step'."""
+        if self._writer is None:
+            return
+        step = int(record.get("step", 0))
+        for key, value in record.items():
+            if key == "step" or not isinstance(value, (int, float)):
+                continue
+            self._writer.add_scalar(key, float(value), global_step=step)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

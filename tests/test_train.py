@@ -112,3 +112,28 @@ def test_checkpoint_survives_corrupt_pointer(tmp_path):
     model, result, _ = _train_tiny(steps=300, checkpoint_dir=tmp_path / "c")
     assert result.steps == 300
     assert result.history[0]["step"] > 200
+
+
+def test_tensorboard_writer_emits_event_file(tmp_path):
+    """train.tensorboard_dir streams the metrics.jsonl records as TF scalar
+    events (SURVEY.md SS5.5 'jsonl + TensorBoard'); absence of the encoder
+    degrades to a warning, never a training failure."""
+    pytest.importorskip("torch.utils.tensorboard")
+    from mlops_tpu.config import ModelConfig, TrainConfig
+    from mlops_tpu.data import generate_synthetic, Preprocessor
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1000, seed=3)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.2)
+    config = TrainConfig(
+        steps=20, eval_every=10, batch_size=128,
+        tensorboard_dir=str(tmp_path / "tb"),
+    )
+    model = build_model(ModelConfig(family="linear"))
+    fit(model, train_ds, valid_ds, config, metrics_path=tmp_path / "m.jsonl")
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
