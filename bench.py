@@ -22,8 +22,10 @@ A crash prints the same shape with an ``"error"`` field (exit code 1).
 Env knobs: ``BENCH_MODEL`` (mlp|gbm, default mlp), ``BENCH_ENSEMBLE``
 (deep-ensemble members for the mlp flagship, default 8; 1 = single
 model), ``BENCH_TPU_TIMEOUT_S`` (TPU health-probe watchdog, default
-300), ``JAX_PLATFORMS`` (force a backend; honored via mlops_tpu's
-config re-assert before backend init).
+300), ``BENCH_WALL_TIMEOUT_S`` (whole-run wall budget guarding against
+mid-run device stalls, default 2700), ``JAX_PLATFORMS`` (force a
+backend; honored via mlops_tpu's config re-assert before backend
+init).
 """
 
 from __future__ import annotations
@@ -269,10 +271,51 @@ def _http_stage(engine, record) -> dict:
     return asyncio.run(run())
 
 
+def _error_line(message: str) -> str:
+    """The one-JSON-line contract's failure shape — single definition for
+    the crash handler and the wall watchdog."""
+    return json.dumps(
+        {
+            "metric": "inference_p50_latency_ms",
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": 0.0,
+            "error": message,
+        }
+    )
+
+
+def _arm_wall_watchdog(timeout_s: int):
+    """The init probe can't protect against a MID-RUN tunnel stall (backend
+    healthy at start, a later dispatch blocks forever in C++). A daemon
+    timer keeps the one-JSON-line contract: on expiry it prints the error
+    line and hard-exits (``os._exit`` — a stalled runtime thread would
+    ignore a normal exit). Returns the timer; main() cancels it after the
+    success line so a run finishing near the deadline can't be clobbered."""
+    import threading
+
+    def expire():
+        print(
+            _error_line(
+                f"bench wall timeout after {timeout_s}s (mid-run device stall)"
+            ),
+            flush=True,
+        )
+        os._exit(1)
+
+    timer = threading.Timer(timeout_s, expire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
     # Honor an explicit JAX_PLATFORMS env (the container bootstrap otherwise
     # pins the TPU backend, hanging CPU-only runs on the tunnel dial).
     _ensure_healthy_backend(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "300")))
+    watchdog = _arm_wall_watchdog(
+        int(os.environ.get("BENCH_WALL_TIMEOUT_S", "2700"))
+    )
 
     from mlops_tpu.commands import _honor_jax_platforms_env
 
@@ -338,8 +381,10 @@ def main() -> None:
                     result.train_result.metrics["validation_roc_auc_score"], 4
                 ),
             }
-        )
+        ),
+        flush=True,
     )
+    watchdog.cancel()
 
 
 if __name__ == "__main__":
@@ -347,16 +392,5 @@ if __name__ == "__main__":
         main()
     except BaseException as err:  # the one-JSON-line contract survives
         # crashes: emit a parseable line with the failure, then exit 1.
-        print(
-            json.dumps(
-                {
-                    "metric": "inference_p50_latency_ms",
-                    "value": None,
-                    "unit": "ms",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(err).__name__}: {err}",
-                }
-            ),
-            flush=True,
-        )
+        print(_error_line(f"{type(err).__name__}: {err}"), flush=True)
         raise SystemExit(1)

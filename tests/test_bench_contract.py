@@ -35,3 +35,28 @@ def test_forced_failure_still_emits_one_json_line():
     assert payload["value"] is None
     assert payload["vs_baseline"] == 0.0
     assert "bogus-backend" in payload["error"]
+
+
+def test_wall_watchdog_emits_json_on_midrun_stall():
+    """A mid-run device stall (tunnel hangs AFTER a healthy init) must not
+    hang the driver: the wall watchdog prints the error line and
+    hard-exits. Simulated with a 1-second budget on the CPU backend."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/tmp",
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_WALL_TIMEOUT_S": "1",
+        },
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode != 0
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout at all; stderr:\n{proc.stderr[-500:]}"
+    payload = json.loads(lines[-1])
+    assert payload["value"] is None
+    assert "wall timeout" in payload["error"]
