@@ -208,6 +208,26 @@ def _score_batch(config) -> int:
     from mlops_tpu.parallel.bulk import score_dataset
 
     bundle = load_bundle(_resolve_bundle(config))
+    if config.score.streaming:
+        # Out-of-core path (the Spark-scale analogue): the dataset never
+        # materializes; peak memory is one chunk, each chunk data-parallel
+        # over the mesh like the in-memory path (data/stream.py).
+        if not config.data.train_path:
+            raise SystemExit("score.streaming requires data.train_path=<csv>")
+        from mlops_tpu.data.stream import score_csv_stream
+
+        mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
+        stats = score_csv_stream(
+            bundle,
+            config.data.train_path,
+            out_path=config.score.output_path or None,
+            chunk_rows=config.score.chunk_rows,
+            mesh=mesh,
+        )
+        import json
+
+        print(json.dumps(stats))
+        return 0
     if config.data.train_path:
         # Native one-pass parse+encode when built (the 1M-row hot path);
         # transparent Python fallback otherwise.

@@ -125,6 +125,9 @@ class ScoreConfig:
     chunk_rows: int = 131_072  # rows per compiled chunk (rounded to mesh axis)
     drift_sample: int = 65_536  # bounded sample for dataset-level drift
     output_path: str = ""  # optional .npz with predictions/outliers
+    streaming: bool = False  # out-of-core: stream CSV chunks through the
+    # fused predict with one-chunk peak memory (data/stream.py); output
+    # becomes an incrementally-written CSV instead of an .npz
 
 
 @dataclasses.dataclass

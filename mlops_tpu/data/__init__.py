@@ -10,12 +10,20 @@ standardized numerics.
 
 from mlops_tpu.data.encode import EncodedDataset, Preprocessor
 from mlops_tpu.data.ingest import load_csv_columns, write_csv_columns
+from mlops_tpu.data.stream import (
+    fit_streaming,
+    iter_csv_chunks,
+    score_csv_stream,
+)
 from mlops_tpu.data.synth import generate_synthetic
 
 __all__ = [
     "EncodedDataset",
     "Preprocessor",
+    "fit_streaming",
     "generate_synthetic",
+    "iter_csv_chunks",
     "load_csv_columns",
+    "score_csv_stream",
     "write_csv_columns",
 ]

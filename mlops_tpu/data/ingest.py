@@ -49,6 +49,56 @@ def fetch_local(path: str | Path, workdir: str | Path | None = None) -> Path:
     return local
 
 
+def _cell(row: list, i: int) -> str:
+    return row[i] if i < len(row) else ""
+
+
+def _to_float(raw: str) -> float:
+    try:
+        return float(raw)
+    except ValueError:
+        return float("nan")
+
+
+def rows_to_columns(
+    rows: list, col_index: dict[str, int], schema: FeatureSchema = SCHEMA
+) -> dict[str, list]:
+    """Parsed CSV rows -> columnar lists, one contract for the batch reader
+    and the streaming reader (`data/stream.py`): categorical cells pass
+    through as strings (missing -> "" -> OOV), numerics parse leniently
+    (unparseable -> NaN -> median imputation)."""
+    columns: dict[str, list] = {}
+    for feat in schema.categorical:
+        i = col_index[feat.name]
+        columns[feat.name] = [_cell(row, i) for row in rows]
+    for feat in schema.numeric:
+        i = col_index[feat.name]
+        columns[feat.name] = [_to_float(_cell(row, i)) for row in rows]
+    return columns
+
+
+def parse_labels(
+    rows: list,
+    col_index: dict[str, int],
+    schema: FeatureSchema,
+    path,
+    base_row: int,
+) -> np.ndarray:
+    """Strict TRAINING-label parse: any unparseable value fails fast
+    (silently training on garbage would surface only as mysteriously bad
+    AUC; the native kernel mirrors this — MLOPS_ERR_BAD_LABEL)."""
+    i = col_index[schema.target]
+    raw = np.asarray([_to_float(_cell(row, i)) for row in rows])
+    bad = ~np.isfinite(raw)
+    if bad.any():
+        raise ValueError(
+            f"{path}: {int(bad.sum())} unparseable value(s) in target "
+            f"column {schema.target!r} (first at data row "
+            f"{base_row + int(np.argmax(bad))})"
+        )
+    return raw.astype(np.int8)
+
+
 def load_csv_columns(
     path: str | Path,
     schema: FeatureSchema = SCHEMA,
@@ -76,44 +126,18 @@ def load_csv_columns(
     if require_target and schema.target not in col_index:
         raise ValueError(f"{path}: missing target column {schema.target!r}")
 
-    def cell(row: list, i: int) -> str:
-        return row[i] if i < len(row) else ""
-
-    def to_float(raw: str) -> float:
-        try:
-            return float(raw)
-        except ValueError:
-            return float("nan")
-
-    columns: dict[str, list] = {}
-    for feat in schema.categorical:
-        i = col_index[feat.name]
-        columns[feat.name] = [cell(row, i) for row in rows]
-    for feat in schema.numeric:
-        i = col_index[feat.name]
-        columns[feat.name] = [to_float(cell(row, i)) for row in rows]
+    columns = rows_to_columns(rows, col_index, schema)
 
     labels = None
     if schema.target in col_index:
-        i = col_index[schema.target]
-        raw = np.asarray([to_float(cell(row, i)) for row in rows])
-        bad = ~np.isfinite(raw)
-        if bad.any():
-            if require_target:
-                # Features degrade gracefully (OOV/median) but corrupt
-                # TRAINING labels fail fast — silently training on garbage
-                # would surface only as mysteriously bad AUC. Native
-                # kernel mirrors this (MLOPS_ERR_BAD_LABEL).
-                raise ValueError(
-                    f"{path}: {int(bad.sum())} unparseable value(s) in "
-                    f"target column {schema.target!r} (first at data row "
-                    f"{int(np.argmax(bad))})"
-                )
-            # Scoring/pretrain paths: a partially-blank target column just
-            # means the file is unlabeled — labels are never read there.
-            labels = None
+        if require_target:
+            labels = parse_labels(rows, col_index, schema, path, 0)
         else:
-            labels = raw.astype(np.int8)
+            # Scoring/pretrain paths: parse permissively; any unparseable
+            # value means the file is unlabeled as a whole.
+            i = col_index[schema.target]
+            raw = np.asarray([_to_float(_cell(row, i)) for row in rows])
+            labels = None if (~np.isfinite(raw)).any() else raw.astype(np.int8)
     return columns, labels
 
 
