@@ -224,8 +224,6 @@ def _score_batch(config) -> int:
             chunk_rows=config.score.chunk_rows,
             mesh=mesh,
         )
-        import json
-
         print(json.dumps(stats))
         return 0
     if config.data.train_path:

@@ -138,10 +138,10 @@ class StreamingStats:
         vectorized per chunk)."""
         k = self._reservoir_size
         if reservoir.size < k:
-            room = k - reservoir.size
-            reservoir = np.concatenate([reservoir, values[:room]])
-            values = values[room:]
-            seen += min(room, reservoir.size)
+            taken = min(k - reservoir.size, values.size)
+            reservoir = np.concatenate([reservoir, values[:taken]])
+            values = values[taken:]
+            seen += taken
         if values.size == 0:
             return reservoir
         # For the i-th remaining value (global index seen+i), replace a

@@ -16,6 +16,7 @@ import concurrent.futures
 import json
 import logging
 import time
+import re
 import uuid
 from typing import Awaitable, Callable
 
@@ -142,8 +143,9 @@ class HttpServer:
                 self._busy.add(writer)
                 try:
                     start = time.perf_counter()
+                    request_id = self._request_id(headers)
                     status, payload, content_type = await self._route(
-                        method, path.split("?")[0], body
+                        method, path.split("?")[0], body, request_id
                     )
                     latency_ms = (time.perf_counter() - start) * 1e3
                     self.metrics.observe_request(
@@ -151,7 +153,8 @@ class HttpServer:
                     )
                     keep_alive = keep_alive and not self.draining
                     await self._write_response(
-                        writer, status, payload, content_type, keep_alive
+                        writer, status, payload, content_type, keep_alive,
+                        request_id=request_id,
                     )
                 finally:
                     self._busy.discard(writer)
@@ -171,6 +174,19 @@ class HttpServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+    def _request_id(self, headers: dict) -> str:
+        """Honor a well-formed inbound ``x-request-id`` (so the caller's
+        trace id correlates the two log events end to end — the reference
+        only ever generates its own, `app/main.py:57`); mint one otherwise.
+        The charset/length gate keeps log-injection text out of the
+        structured stream."""
+        inbound = headers.get("x-request-id", "")
+        if inbound and self._REQUEST_ID_RE.match(inbound):
+            return inbound
+        return uuid.uuid4().hex
+
     async def _write_response(
         self,
         writer: asyncio.StreamWriter,
@@ -178,6 +194,7 @@ class HttpServer:
         payload,
         content_type: str = "application/json",
         keep_alive: bool = True,
+        request_id: str | None = None,
     ) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   409: "Conflict", 413: "Payload Too Large",
@@ -189,19 +206,22 @@ class HttpServer:
             body = payload.encode()
         else:
             body = payload
+        rid = f"x-request-id: {request_id}\r\n" if request_id else ""
         head = (
             f"HTTP/1.1 {status} {reason}\r\n"
             f"content-type: {content_type}\r\n"
-            f"content-length: {len(body)}\r\n"
+            f"content-length: {len(body)}\r\n{rid}"
             f"connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
         )
         writer.write(head.encode() + body)
         await writer.drain()
 
     # -------------------------------------------------------------- routing
-    async def _route(self, method: str, path: str, body: bytes):
+    async def _route(
+        self, method: str, path: str, body: bytes, request_id: str | None = None
+    ):
         if path == "/predict" and method == "POST":
-            return await self._predict(body)
+            return await self._predict(body, request_id)
         if path.startswith("/debug/profile/") and method == "POST":
             return self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":
@@ -261,7 +281,7 @@ class HttpServer:
             return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
         return 404, {"detail": "not found"}, "application/json"
 
-    async def _predict(self, body: bytes):
+    async def _predict(self, body: bytes, request_id: str | None = None):
         """The reference's `predict()` endpoint (`app/main.py:42-86`):
         validate -> log InferenceData -> model -> log ModelOutput -> respond.
         """
@@ -282,7 +302,7 @@ class HttpServer:
                 "application/json",
             )
 
-        request_id = uuid.uuid4().hex
+        request_id = request_id or uuid.uuid4().hex
         record_dicts = [r.model_dump() for r in records]
         # isEnabledFor guards: the two-event monitoring contract serializes
         # full payloads per request — skip the dumps work entirely when the

@@ -234,3 +234,72 @@ def test_blank_labels_on_scoring_path_mean_unlabeled(tmp_path):
     assert got.labels is None and got.cat_ids.shape[0] == 2
     _, labels = load_csv_columns(path)
     assert labels is None
+
+
+hypothesis = pytest.importorskip("hypothesis")  # not in the CI dep list
+
+
+class TestParityFuzz:
+    """Property-based parity: for ANY ascii CSV content — quoted cells,
+    garbage numerics, short rows, empties — the native kernel must encode
+    bit-identically to the Python path (the contract every other native
+    test pins pointwise; hypothesis explores the space)."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    _ascii = st.text(
+        alphabet=st.characters(min_codepoint=32, max_codepoint=126),
+        max_size=10,
+    )
+    _cat_cell = st.one_of(
+        st.sampled_from(["male", "female", "university", "", "other"]),
+        _ascii,
+    )
+    _num_cell = st.one_of(
+        st.floats(
+            allow_nan=False, allow_infinity=False, min_value=-1e30, max_value=1e30
+        ).map(repr),
+        _ascii,
+        st.just(""),
+    )
+    _row = st.builds(
+        lambda cats, nums, keep: (cats + nums)[: max(1, keep)],
+        st.lists(_cat_cell, min_size=9, max_size=9),
+        st.lists(_num_cell, min_size=14, max_size=14),
+        st.integers(min_value=1, max_value=23),  # short rows included
+    )
+
+    @given(rows=st.lists(_row, min_size=1, max_size=12))
+    @settings(max_examples=30, deadline=None)
+    def test_fuzzed_csv_parity(self, rows):
+        import csv as _csv
+        import io
+        import tempfile
+
+        from mlops_tpu.data.ingest import load_csv_columns
+        from mlops_tpu.schema import SCHEMA
+
+        buf = io.StringIO()
+        writer = _csv.writer(buf)
+        writer.writerow(list(SCHEMA.feature_names))
+        writer.writerows(rows)
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".csv", delete=False
+        ) as f:
+            f.write(buf.getvalue())
+            path = f.name
+
+        try:
+            prep = _tiny_prep()
+            got = native.encode_csv_native(path, prep)
+            columns, labels = load_csv_columns(path)
+            want = prep.encode(columns, labels)
+            np.testing.assert_array_equal(got.cat_ids, want.cat_ids)
+            np.testing.assert_allclose(
+                got.numeric, want.numeric, atol=1e-4, rtol=1e-5
+            )
+        finally:
+            import os as _os
+
+            _os.unlink(path)

@@ -356,3 +356,48 @@ def test_sigterm_graceful_drain():
 
     asyncio.run(run())
     assert engine.ready is False  # readiness stays down through exit
+
+
+def test_inbound_request_id_is_honored_and_echoed(engine, sample_request):
+    """A well-formed x-request-id correlates the caller's trace end to end:
+    echoed as a response header and stamped on both log events; malformed
+    ids are replaced with a fresh hex (log-injection gate)."""
+    config = ServeConfig(host="127.0.0.1", port=0)
+    server = HttpServer(engine, config)
+
+    async def run():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        out = []
+        try:
+            for rid in ("trace-abc_123", "bad id with spaces", "x" * 100):
+                reader, writer = await asyncio.open_connection("127.0.0.1", port)
+                data = json.dumps(sample_request).encode()
+                writer.write(
+                    (
+                        f"POST /predict HTTP/1.1\r\nhost: t\r\n"
+                        f"x-request-id: {rid}\r\n"
+                        f"content-length: {len(data)}\r\nconnection: close\r\n\r\n"
+                    ).encode()
+                    + data
+                )
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                head = raw.partition(b"\r\n\r\n")[0].decode("latin1")
+                echoed = [
+                    line.split(":", 1)[1].strip()
+                    for line in head.splitlines()
+                    if line.lower().startswith("x-request-id:")
+                ]
+                out.append((rid, echoed[0]))
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        return out
+
+    results = asyncio.run(run())
+    assert results[0] == ("trace-abc_123", "trace-abc_123")  # honored
+    for sent, echoed in results[1:]:
+        assert echoed != sent  # malformed -> replaced
+        assert len(echoed) == 32 and all(c in "0123456789abcdef" for c in echoed)
