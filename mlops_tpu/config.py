@@ -63,6 +63,9 @@ class TrainConfig:
     # CLI output) to graft into the bert trunk before fine-tuning
     tensorboard_dir: str = ""  # also stream metrics.jsonl records as TF
     # scalar events here (utils/tboard.py); empty = jsonl only
+    ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
+    # params (EMA folded into the compiled scan; eval/packaging use the
+    # debiased average, raw params keep training). 0 disables.
 
 
 @dataclasses.dataclass

@@ -61,6 +61,7 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
     candidates.extend(
         (p, None) for p in sorted(directory.glob("ckpt_*.msgpack"), reverse=True)
     )
+    failures = []
     for path, known_step in candidates:
         try:
             restored = restore_tree(target, path.read_bytes())
@@ -69,7 +70,31 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
                 if known_step is not None
                 else int(path.stem.split("_")[1])
             )
-        except (OSError, ValueError, KeyError, IndexError):
+        except (
+            OSError,
+            ValueError,
+            KeyError,
+            IndexError,
+            AttributeError,  # flax pytree-structure mismatch (e.g. the
+            TypeError,  # TrainState gained/lost the ema field)
+        ) as err:
+            failures.append((path, err))
             continue
         return restored, step
+    if failures:
+        # Checkpoints exist but NONE restored — most likely a state-shape
+        # mismatch (e.g. toggling train.ema_decay changes the TrainState
+        # pytree). Restarting silently from step 0 would throw away the
+        # run's progress without a trace, so say it loudly.
+        import warnings
+
+        path, err = failures[0]
+        warnings.warn(
+            f"{len(failures)} checkpoint(s) in {directory} failed to "
+            f"restore (first: {path.name}: {err}); training restarts from "
+            "step 0 — if the TrainState shape changed (e.g. ema_decay "
+            "toggled), resume with the original settings or clear the "
+            "checkpoint dir",
+            stacklevel=2,
+        )
     return None

@@ -38,6 +38,17 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
     step: jnp.ndarray
     rng: jnp.ndarray
+    # Raw (biased) EMA accumulator when train.ema_decay > 0, else None.
+    # Zero-initialized; consumers debias via ``ema_debiased``.
+    ema: Any = None
+
+
+def ema_debiased(state: TrainState, decay: float):
+    """Bias-corrected Polyak average: ``ema / (1 - decay^step)`` — exact
+    from step 1, so short runs (bench trains 600 steps) are not dragged
+    toward the zero init the raw accumulator starts from."""
+    correction = 1.0 - decay ** state.step.astype(jnp.float32)
+    return jax.tree_util.tree_map(lambda e: e / correction, state.ema)
 
 
 @dataclasses.dataclass
@@ -152,8 +163,14 @@ def make_train_window(
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
+            ema = state.ema
+            if config.ema_decay:  # static at trace time
+                d = config.ema_decay
+                ema = jax.tree_util.tree_map(
+                    lambda e, q: d * e + (1.0 - d) * q, ema, params
+                )
             new_state = state.replace(
-                params=params, opt_state=opt_state, step=state.step + 1
+                params=params, opt_state=opt_state, step=state.step + 1, ema=ema
             )
             return new_state, loss
 
@@ -204,6 +221,11 @@ def fit(
         opt_state=optimizer.init(params),
         step=jnp.asarray(0, jnp.int32),
         rng=loop_rng,
+        ema=(
+            jax.tree_util.tree_map(jnp.zeros_like, params)
+            if config.ema_decay
+            else None
+        ),
     )
 
     start_step = 0
@@ -238,11 +260,19 @@ def fit(
                 window_fns[window] = run_window
             state, mean_loss = run_window(state, cat, num, lab)
             step = int(state.step)
+            # Metrics must describe the params that will be PACKAGED —
+            # the debiased EMA when enabled (a promotion decision made on
+            # raw-param metrics would grade a model that never ships).
+            eval_params = (
+                ema_debiased(state, config.ema_decay)
+                if config.ema_decay
+                else state.params
+            )
             record = {"step": step, "train_loss": float(mean_loss)}
             record.update(
                 {
                     f"validation_{k}_score": float(v)
-                    for k, v in eval_fn(state.params, vcat, vnum, vlab).items()
+                    for k, v in eval_fn(eval_params, vcat, vnum, vlab).items()
                 }
             )
             history.append(record)
@@ -267,16 +297,25 @@ def fit(
         if tb_writer:
             tb_writer.close()
 
+    # step == 0 (eval-only / fully-resumed-with-no-new-steps runs that never
+    # entered the loop THIS process but restored step>0 are fine; a literal
+    # zero-step run has an all-zeros accumulator and a 1-d^0 = 0 correction)
+    # falls back to the raw params instead of packaging 0/0 = NaN.
+    serving_params = (
+        ema_debiased(state, config.ema_decay)
+        if config.ema_decay and int(state.step) > 0
+        else state.params
+    )
     final = (
         history[-1]
         if history
         else {
             f"validation_{k}_score": float(v)
-            for k, v in eval_fn(state.params, vcat, vnum, vlab).items()
+            for k, v in eval_fn(serving_params, vcat, vnum, vlab).items()
         }
     )
     return TrainResult(
-        params=jax.device_get(state.params),
+        params=jax.device_get(serving_params),
         metrics={k: v for k, v in final.items() if k.startswith("validation_")},
         history=history,
         steps=step,

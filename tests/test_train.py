@@ -137,3 +137,130 @@ def test_tensorboard_writer_emits_event_file(tmp_path):
     fit(model, train_ds, valid_ds, config, metrics_path=tmp_path / "m.jsonl")
     events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
     assert events and events[0].stat().st_size > 0
+
+
+def test_ema_debias_matches_closed_form():
+    """ema_t = d*ema + (1-d)*p from zeros; debiased by 1-d^t equals the
+    geometrically-weighted average of the params seen so far."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.train.loop import TrainState, ema_debiased
+
+    d = 0.9
+    params_seq = [1.0, 2.0, 5.0]
+    ema = 0.0
+    for p in params_seq:
+        ema = d * ema + (1 - d) * p
+    state = TrainState(
+        params=None, opt_state=None,
+        step=jnp.asarray(len(params_seq), jnp.int32),
+        rng=jnp.zeros(2, jnp.uint32), ema=jnp.asarray(ema),
+    )
+    got = float(ema_debiased(state, d))
+    weights = np.array([d**2 * (1 - d), d * (1 - d), (1 - d)])
+    expect = float((weights * np.asarray(params_seq)).sum() / weights.sum())
+    assert abs(got - expect) < 1e-6
+
+
+def test_ema_training_serves_averaged_params(tmp_path):
+    """With ema_decay on, the packaged params are the debiased average —
+    different from the raw final params but still a working model."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(2000, seed=6)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.2)
+    model = build_model(ModelConfig(family="linear"))
+
+    base = TrainConfig(steps=60, eval_every=30, batch_size=256)
+    ema_cfg = TrainConfig(steps=60, eval_every=30, batch_size=256, ema_decay=0.9)
+    raw = fit(model, train_ds, valid_ds, base)
+    averaged = fit(model, train_ds, valid_ds, ema_cfg)
+    assert np.isfinite(averaged.metrics["validation_roc_auc_score"])
+    # same seed/schedule: raw params equal, so the EMA params must differ
+    raw_leaf = jax.tree_util.tree_leaves(raw.params)[0]
+    ema_leaf = jax.tree_util.tree_leaves(averaged.params)[0]
+    assert raw_leaf.shape == ema_leaf.shape
+    assert not np.allclose(raw_leaf, ema_leaf)
+
+
+def test_ema_checkpoint_resume(tmp_path):
+    """The EMA accumulator rides the checkpointed TrainState: a resumed
+    run continues the average instead of restarting it."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1500, seed=8)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.2)
+    model = build_model(ModelConfig(family="linear"))
+    config = TrainConfig(
+        steps=40, eval_every=20, batch_size=128, checkpoint_every=20,
+        ema_decay=0.9,
+    )
+    full = fit(model, train_ds, valid_ds, config, checkpoint_dir=tmp_path / "ck")
+    # Re-fit from the final checkpoint: nothing left to train, so the
+    # restored state (incl. ema) must reproduce the packaged params.
+    resumed = fit(model, train_ds, valid_ds, config, checkpoint_dir=tmp_path / "ck")
+    np.testing.assert_allclose(
+        jax.tree_util.tree_leaves(full.params)[0],
+        jax.tree_util.tree_leaves(resumed.params)[0],
+        rtol=1e-6,
+    )
+
+
+def test_ema_metrics_describe_the_packaged_params(tmp_path):
+    """The bundle metrics must grade the EMA params that ship, not the raw
+    ones: the final history record's AUC equals a fresh eval of
+    TrainResult.params."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train import evaluate
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1500, seed=12)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.2)
+    model = build_model(ModelConfig(family="linear"))
+    config = TrainConfig(steps=40, eval_every=40, batch_size=128, ema_decay=0.9)
+    result = fit(model, train_ds, valid_ds, config)
+    fresh = evaluate(model, result.params, valid_ds)
+    assert (
+        abs(
+            fresh["validation_roc_auc_score"]
+            - result.metrics["validation_roc_auc_score"]
+        )
+        < 1e-6
+    )
+
+
+def test_mismatched_checkpoint_warns_instead_of_silent_restart(tmp_path):
+    """Toggling ema_decay changes the TrainState pytree; resuming against
+    old checkpoints must warn loudly, not silently restart from step 0."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1000, seed=13)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.2)
+    model = build_model(ModelConfig(family="linear"))
+    plain = TrainConfig(steps=20, eval_every=20, batch_size=128, checkpoint_every=10)
+    fit(model, train_ds, valid_ds, plain, checkpoint_dir=tmp_path / "ck")
+    with_ema = TrainConfig(
+        steps=20, eval_every=20, batch_size=128, checkpoint_every=10,
+        ema_decay=0.9,
+    )
+    with pytest.warns(UserWarning, match="failed to restore"):
+        fit(model, train_ds, valid_ds, with_ema, checkpoint_dir=tmp_path / "ck")
