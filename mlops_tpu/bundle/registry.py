@@ -15,10 +15,13 @@ Layout:
 
 from __future__ import annotations
 
+import contextlib
 import datetime
+import fcntl
 import hashlib
 import json
 import shutil
+import threading
 import uuid
 from pathlib import Path
 from typing import Any
@@ -27,6 +30,11 @@ from mlops_tpu.utils import storage
 from mlops_tpu.utils.io import atomic_write
 
 STAGES = ("none", "staging", "production")
+
+# Intra-process serialization of index mutations, keyed by resolved
+# (root, name); the cross-process half is an flock alongside the index.
+_LOCKS_GUARD = threading.Lock()
+_LOCKS: dict[str, threading.Lock] = {}
 
 
 def parse_model_uri(uri: str) -> tuple[str, str]:
@@ -72,6 +80,35 @@ class ModelRegistry:
             )
             / root_tag
         )
+
+    # -------------------------------------------------------------- locking
+    @contextlib.contextmanager
+    def _locked(self, name: str):
+        """Serialize index mutations per model: a process-local lock for
+        threads plus an ``flock`` for concurrent processes (flock alone
+        cannot arbitrate threads sharing one process). Lifts the local
+        backend past the reference's implicit CI-serializes-releases
+        assumption; the GCS flavor keeps the documented single-writer
+        contract (object stores have no flock — CI's ``needs:`` chain is
+        the serializer there, as in the reference's workflows)."""
+        if self._gcs:
+            yield
+            return
+        key = str(Path(self.root).resolve() / name)
+        with _LOCKS_GUARD:
+            thread_lock = _LOCKS.setdefault(key, threading.Lock())
+        with thread_lock:
+            # Locks live under <root>/.locks, NOT <root>/<name>/ — taking
+            # the lock for a typo'd name must not create a phantom model
+            # directory a registry listing would then surface.
+            lock_dir = self.root / ".locks"
+            lock_dir.mkdir(parents=True, exist_ok=True)
+            with open(lock_dir / f"{name}.lock", "w") as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
 
     # ---------------------------------------------------------------- index
     def _index_path(self, name: str) -> str | Path:
@@ -128,6 +165,15 @@ class ModelRegistry:
         reference's registration notebook exits with
         (`02-register-model.ipynb:504`).
         """
+        with self._locked(name):
+            return self._register_locked(name, bundle_dir, tags)
+
+    def _register_locked(
+        self,
+        name: str,
+        bundle_dir: str | Path,
+        tags: dict[str, str] | None,
+    ) -> str:
         index = self._read_index(name)
         # Next version = 1 + max over index AND already-stored dirs, so an
         # orphan from a crash between copy and index write can never
@@ -153,10 +199,9 @@ class ModelRegistry:
             versions_dir = self.root / name / "versions"
             dest = versions_dir / str(version)
             # Copy to a temp sibling then rename: a partial copy is never
-            # visible under a version number. Single-writer assumption:
-            # concurrent registers of the same name are not coordinated (CI
-            # serializes the release pipeline, as the reference's workflow
-            # jobs do via `needs:`).
+            # visible under a version number. Concurrent LOCAL registers
+            # are serialized by _locked (thread lock + flock); only the
+            # GCS flavor still assumes CI serializes the release pipeline.
             versions_dir.mkdir(parents=True, exist_ok=True)
             staging = versions_dir / f".incoming-{uuid.uuid4().hex}"
             try:
@@ -240,21 +285,22 @@ class ModelRegistry:
         """
         if stage not in STAGES:
             raise ValueError(f"stage must be one of {STAGES}")
-        index = self._read_index(name)
-        target = next(
-            (e for e in index["versions"] if e["version"] == version), None
-        )
-        if target is None:
-            raise KeyError(f"model {name!r} has no version {version}")
-        if stage != "none":
-            for entry in index["versions"]:
-                if entry is not target and entry["stage"] == stage:
-                    entry["stage"] = "none"
-        target["stage"] = stage
-        target[f"{stage}_since"] = datetime.datetime.now(
-            datetime.timezone.utc
-        ).isoformat()
-        self._write_index(name, index)
+        with self._locked(name):
+            index = self._read_index(name)
+            target = next(
+                (e for e in index["versions"] if e["version"] == version), None
+            )
+            if target is None:
+                raise KeyError(f"model {name!r} has no version {version}")
+            if stage != "none":
+                for entry in index["versions"]:
+                    if entry is not target and entry["stage"] == stage:
+                        entry["stage"] = "none"
+            target["stage"] = stage
+            target[f"{stage}_since"] = datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat()
+            self._write_index(name, index)
 
     def list_versions(self, name: str) -> list[dict[str, Any]]:
         return self._read_index(name)["versions"]

@@ -65,7 +65,9 @@ class TrainConfig:
     # scalar events here (utils/tboard.py); empty = jsonl only
     ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
     # params (EMA folded into the compiled scan; eval/packaging use the
-    # debiased average, raw params keep training). 0 disables.
+    # debiased average, raw params keep training). 0 disables. Applies to
+    # the `train` path (loop.fit); the vmapped HPO sweep and the raw
+    # sharded step warn and ignore it.
 
 
 @dataclasses.dataclass

@@ -34,6 +34,14 @@ def make_sharded_train_step(
     laid out per ``PARAM_RULES`` over 'model'. Gradients reduce over ICI via
     XLA-inserted psums.
     """
+    if getattr(config, "ema_decay", 0.0):
+        import warnings
+
+        warnings.warn(
+            "train.ema_decay is only applied by loop.fit; the sharded "
+            "train step updates raw params and ignores it",
+            stacklevel=2,
+        )
     p_shard = param_shardings(mesh, params_template)
     # Optimizer state mirrors the param layout (adamw: mu/nu per param).
     state_shardings = TrainState(

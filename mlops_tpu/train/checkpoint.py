@@ -58,8 +58,11 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
             candidates.append((directory / meta["file"], int(meta["step"])))
         except (json.JSONDecodeError, KeyError, TypeError, ValueError, OSError):
             pass
+    pointed = {path for path, _ in candidates}
     candidates.extend(
-        (p, None) for p in sorted(directory.glob("ckpt_*.msgpack"), reverse=True)
+        (p, None)
+        for p in sorted(directory.glob("ckpt_*.msgpack"), reverse=True)
+        if p not in pointed  # don't retry (and double-count) the pointer's file
     )
     failures = []
     for path, known_step in candidates:

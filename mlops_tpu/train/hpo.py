@@ -58,6 +58,18 @@ def sample_hyperparams(config: HPOConfig) -> dict[str, np.ndarray]:
     }
 
 
+def _warn_ema_unsupported(train_config) -> None:
+    if getattr(train_config, "ema_decay", 0.0):
+        import warnings
+
+        warnings.warn(
+            "train.ema_decay is only applied by the `train` path "
+            "(loop.fit); the vmapped HPO sweep packages raw final-step "
+            "params and ignores it",
+            stacklevel=3,
+        )
+
+
 def run_hpo(
     model_config: ModelConfig,
     train_config: TrainConfig,
@@ -67,6 +79,7 @@ def run_hpo(
     mesh=None,
 ) -> HPOResult:
     """Train all trials simultaneously and pick the objective winner."""
+    _warn_ema_unsupported(train_config)
     model = build_model(model_config)
     t = hpo_config.trials
     steps = hpo_config.steps

@@ -162,3 +162,47 @@ def test_ensemble_bundle_round_trip_through_engine(tmp_path):
     assert len(out["predictions"]) == 1
     assert 0.0 <= out["predictions"][0] <= 1.0
     assert out["outliers"][0] in (0.0, 1.0)
+
+
+def _register_worker(args):
+    """Process-pool worker for the concurrency stress (module-level for
+    pickling): fresh registry object per process, one register call."""
+    root, bundle_dir = args
+    from mlops_tpu.bundle import ModelRegistry
+
+    return ModelRegistry(root).register("stress", bundle_dir)
+
+
+def test_concurrent_registration_is_serialized(trained, tmp_path):
+    """Thread- and process-concurrent registers must produce unique,
+    gapless versions and a consistent index (threading.Lock + flock in
+    registry._locked — past the reference's CI-serializes assumption)."""
+    import concurrent.futures
+
+    _, result = trained
+    root = tmp_path / "reg"
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+        thread_uris = list(
+            pool.map(
+                lambda _: ModelRegistry(root).register(
+                    "stress", result.bundle_dir
+                ),
+                range(6),
+            )
+        )
+    with concurrent.futures.ProcessPoolExecutor(max_workers=4) as pool:
+        proc_uris = list(
+            pool.map(
+                _register_worker, [(str(root), str(result.bundle_dir))] * 4
+            )
+        )
+
+    uris = thread_uris + proc_uris
+    versions = sorted(int(u.rsplit("/", 1)[1]) for u in uris)
+    assert versions == list(range(1, 11))  # unique and gapless
+    registry = ModelRegistry(root)
+    listed = sorted(v["version"] for v in registry.list_versions("stress"))
+    assert listed == list(range(1, 11))
+    for v in range(1, 11):
+        assert (root / "stress" / "versions" / str(v) / "manifest.json").exists()
