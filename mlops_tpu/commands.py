@@ -63,6 +63,7 @@ def _train(config) -> int:
                 "bundle": str(result.bundle_dir),
                 "model_uri": result.model_uri,
                 "steps": result.train_result.steps,
+                "packaged_step": result.train_result.packaged_step,
                 "metrics": result.train_result.metrics,
             }
         )

@@ -42,6 +42,41 @@ def save_checkpoint(directory: str | Path, state: Any, step: int) -> Path:
     return path
 
 
+BEST_PARAMS_NAME = "best_params.msgpack"
+BEST_RECORD_NAME = "best_record.json"
+
+
+def save_best(directory: Path, params, record: dict) -> None:
+    """Persist the best-eval-window snapshot (train.keep_best) so a
+    crash-resume continues the best-so-far comparison."""
+    directory.mkdir(parents=True, exist_ok=True)
+    atomic_write(directory / BEST_PARAMS_NAME, tree_bytes(params))
+    atomic_write(
+        directory / BEST_RECORD_NAME, json.dumps(record).encode()
+    )
+
+
+def load_best(directory: Path, template):
+    """Restore the persisted best snapshot; None when absent or unreadable
+    (e.g. the params pytree shape changed between runs)."""
+    try:
+        params = restore_tree(
+            template, (directory / BEST_PARAMS_NAME).read_bytes()
+        )
+        record = json.loads((directory / BEST_RECORD_NAME).read_text())
+        float(record["validation_roc_auc_score"])  # shape sanity
+        return params, record
+    except (
+        OSError,
+        ValueError,
+        KeyError,
+        TypeError,
+        AttributeError,
+        json.JSONDecodeError,
+    ):
+        return None
+
+
 def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | None:
     """Load the newest readable checkpoint into ``target``'s structure.
 

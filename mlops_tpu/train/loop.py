@@ -54,9 +54,11 @@ def ema_debiased(state: TrainState, decay: float):
 @dataclasses.dataclass
 class TrainResult:
     params: Any
-    metrics: dict[str, float]  # final validation metrics
+    metrics: dict[str, float]  # metrics of the PACKAGED params (with
+    # keep_best that is the best eval window, not necessarily the final)
     history: list[dict[str, float]]
-    steps: int
+    steps: int  # total steps trained
+    packaged_step: int = 0  # the eval step the packaged params came from
 
 
 def sigmoid_bce(
@@ -240,6 +242,20 @@ def fit(
     eval_fn = make_eval_fn(model)
     vcat, vnum, vlab = _device_put_dataset(valid_ds)
 
+    # Best-eval tracking (train.keep_best): snapshot the params of the
+    # highest-AUC eval window so long runs cannot ship an overfit tail.
+    # The snapshot persists NEXT TO the checkpoints so a crash-resume
+    # continues the comparison instead of restarting it at -inf (which
+    # would re-ship the overfit tail the feature exists to prevent).
+    best_auc = float("-inf")
+    best_params = None
+    best_record: dict | None = None
+    if config.keep_best and checkpoint_dir is not None:
+        restored_best = ckpt.load_best(Path(checkpoint_dir), params)
+        if restored_best is not None:
+            best_params, best_record = restored_best
+            best_auc = best_record["validation_roc_auc_score"]
+
     writer = JsonlWriter(metrics_path) if metrics_path else None
     tb_writer = None
     if config.tensorboard_dir:
@@ -275,6 +291,17 @@ def fit(
                     for k, v in eval_fn(eval_params, vcat, vnum, vlab).items()
                 }
             )
+            if (
+                config.keep_best
+                and record["validation_roc_auc_score"] > best_auc
+            ):
+                # strict >: a plateaued run must not re-pay the full
+                # device->host params copy every tying window
+                best_auc = record["validation_roc_auc_score"]
+                best_params = jax.device_get(eval_params)
+                best_record = record
+                if checkpoint_dir is not None:
+                    ckpt.save_best(Path(checkpoint_dir), best_params, best_record)
             history.append(record)
             if writer:
                 writer.write(record)
@@ -306,17 +333,24 @@ def fit(
         if config.ema_decay and int(state.step) > 0
         else state.params
     )
-    final = (
-        history[-1]
-        if history
-        else {
-            f"validation_{k}_score": float(v)
-            for k, v in eval_fn(serving_params, vcat, vnum, vlab).items()
-        }
-    )
+    if best_params is not None:
+        # Metrics and params come from the SAME (best) eval window — the
+        # bundle always grades exactly what it serves.
+        final, packaged = best_record, best_params
+    else:
+        final = (
+            history[-1]
+            if history
+            else {
+                f"validation_{k}_score": float(v)
+                for k, v in eval_fn(serving_params, vcat, vnum, vlab).items()
+            }
+        )
+        packaged = jax.device_get(serving_params)
     return TrainResult(
-        params=jax.device_get(serving_params),
+        params=packaged,
         metrics={k: v for k, v in final.items() if k.startswith("validation_")},
         history=history,
         steps=step,
+        packaged_step=int(final.get("step", step)),
     )

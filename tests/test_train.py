@@ -203,7 +203,7 @@ def test_ema_checkpoint_resume(tmp_path):
     model = build_model(ModelConfig(family="linear"))
     config = TrainConfig(
         steps=40, eval_every=20, batch_size=128, checkpoint_every=20,
-        ema_decay=0.9,
+        ema_decay=0.9, keep_best=False,  # isolate EMA from best-window selection
     )
     full = fit(model, train_ds, valid_ds, config, checkpoint_dir=tmp_path / "ck")
     # Re-fit from the final checkpoint: nothing left to train, so the
@@ -264,3 +264,73 @@ def test_mismatched_checkpoint_warns_instead_of_silent_restart(tmp_path):
     )
     with pytest.warns(UserWarning, match="failed to restore"):
         fit(model, train_ds, valid_ds, with_ema, checkpoint_dir=tmp_path / "ck")
+
+
+def test_keep_best_packages_the_best_eval_window(tmp_path):
+    """A run that degrades after its best eval window must package the best
+    window's params+metrics (the measured 2400-step overfitting cliff:
+    AUC 0.8056 -> 0.7537), never the final ones."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train import evaluate
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1200, seed=15)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.25)
+    # Tiny train split + many steps at high LR: guaranteed to overfit.
+    model = build_model(ModelConfig(family="mlp", hidden_dims=(64, 64), dropout=0.0))
+    config = TrainConfig(
+        steps=400, eval_every=50, batch_size=256, learning_rate=2e-2,
+        warmup_steps=10,
+    )
+    result = fit(model, train_ds, valid_ds, config)
+    aucs = [r["validation_roc_auc_score"] for r in result.history]
+    assert result.metrics["validation_roc_auc_score"] == max(aucs)
+    # packaged params reproduce the packaged metrics
+    fresh = evaluate(model, result.params, valid_ds)
+    assert (
+        abs(
+            fresh["validation_roc_auc_score"]
+            - result.metrics["validation_roc_auc_score"]
+        )
+        < 1e-6
+    )
+    # and keep_best=False would have shipped the (worse) final window
+    final_auc = aucs[-1]
+    assert result.metrics["validation_roc_auc_score"] >= final_auc
+
+
+def test_keep_best_survives_checkpoint_resume(tmp_path):
+    """The best-window snapshot persists next to the checkpoints: a
+    resumed run that only degrades must still package the pre-resume
+    best, not restart the comparison at -inf."""
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import fit
+    from mlops_tpu.train.pipeline import split_dataset
+
+    columns, labels = generate_synthetic(1200, seed=15)
+    pre = Preprocessor.fit(columns)
+    ds = pre.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, 0.25)
+    model = build_model(
+        ModelConfig(family="mlp", hidden_dims=(64, 64), dropout=0.0)
+    )
+
+    def cfg(steps):
+        return TrainConfig(
+            steps=steps, eval_every=50, batch_size=256, learning_rate=2e-2,
+            warmup_steps=10, checkpoint_every=50,
+        )
+
+    first = fit(model, train_ds, valid_ds, cfg(200), checkpoint_dir=tmp_path / "c")
+    resumed = fit(model, train_ds, valid_ds, cfg(400), checkpoint_dir=tmp_path / "c")
+    all_aucs = [
+        r["validation_roc_auc_score"] for r in first.history + resumed.history
+    ]
+    assert resumed.metrics["validation_roc_auc_score"] == max(all_aucs)
+    assert resumed.packaged_step <= 400
+    assert resumed.steps == 400
