@@ -216,8 +216,6 @@ def _engine_stage(engine, record) -> dict:
     """Chip-serving capability without the HTTP layer: concurrent grouped
     dispatches from a small thread pool (what replica processes would
     drive). Separates the device ceiling from server-side Python cost."""
-    import threading
-
     if not engine.supports_grouping:
         return {}
     reqs = [[record]] * 64
@@ -228,7 +226,7 @@ def _engine_stage(engine, record) -> dict:
         for _ in range(reps):
             engine.predict_group(reqs)
 
-    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    threads = [_threading.Thread(target=worker) for _ in range(n_threads)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -350,7 +348,6 @@ def _arm_wall_watchdog(timeout_s: int):
     hard-exits instead (``os._exit`` — a stalled runtime thread would
     ignore a normal exit). Returns the timer; main() cancels it after the
     success line so a run finishing near the deadline can't be clobbered."""
-    import threading
 
     def expire():
         if _BENCH_DONE.is_set():
@@ -365,7 +362,7 @@ def _arm_wall_watchdog(timeout_s: int):
         )
         os._exit(1)
 
-    timer = threading.Timer(timeout_s, expire)
+    timer = _threading.Timer(timeout_s, expire)
     timer.daemon = True
     timer.start()
     return timer

@@ -23,6 +23,20 @@ import numpy as np
 
 from mlops_tpu.schema.features import SCHEMA, FeatureSchema
 
+# Vocab -> id lookup tables are schema constants (frozen dataclasses);
+# building them inside encode() would put 9 dict constructions on the
+# serving hot path for every request batch.
+_VOCAB_LUTS: dict[tuple, dict[str, int]] = {}
+
+
+def _vocab_lut(feat) -> dict[str, int]:
+    key = (feat.name, feat.vocab)
+    lut = _VOCAB_LUTS.get(key)
+    if lut is None:
+        lut = {value: i for i, value in enumerate(feat.vocab)}
+        _VOCAB_LUTS[key] = lut
+    return lut
+
 
 @dataclasses.dataclass
 class EncodedDataset:
@@ -86,7 +100,7 @@ class Preprocessor:
         n = len(next(iter(columns.values())))
         cat_ids = np.empty((n, schema.num_categorical), dtype=np.int32)
         for j, feat in enumerate(schema.categorical):
-            lut = {value: i for i, value in enumerate(feat.vocab)}
+            lut = _vocab_lut(feat)
             oov = feat.oov_id
             cat_ids[:, j] = [lut.get(v, oov) for v in columns[feat.name]]
 

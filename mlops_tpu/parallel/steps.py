@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from mlops_tpu.config import TrainConfig
 from mlops_tpu.parallel.sharding import batch_sharding, param_shardings, replicated
-from mlops_tpu.train.loop import TrainState, training_loss
+from mlops_tpu.train.loop import TrainState, training_loss, warn_ema_unsupported
 
 
 def make_sharded_train_step(
@@ -34,14 +34,7 @@ def make_sharded_train_step(
     laid out per ``PARAM_RULES`` over 'model'. Gradients reduce over ICI via
     XLA-inserted psums.
     """
-    if getattr(config, "ema_decay", 0.0):
-        import warnings
-
-        warnings.warn(
-            "train.ema_decay is only applied by loop.fit; the sharded "
-            "train step updates raw params and ignores it",
-            stacklevel=2,
-        )
+    warn_ema_unsupported(config, "the sharded train step")
     p_shard = param_shardings(mesh, params_template)
     # Optimizer state mirrors the param layout (adamw: mu/nu per param).
     state_shardings = TrainState(

@@ -29,7 +29,7 @@ from mlops_tpu.config import HPOConfig, ModelConfig, TrainConfig
 from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.models import build_model
 from mlops_tpu.schema.features import SCHEMA
-from mlops_tpu.train.loop import training_loss
+from mlops_tpu.train.loop import training_loss, warn_ema_unsupported
 from mlops_tpu.train.metrics import binary_metrics
 
 
@@ -58,18 +58,6 @@ def sample_hyperparams(config: HPOConfig) -> dict[str, np.ndarray]:
     }
 
 
-def _warn_ema_unsupported(train_config) -> None:
-    if getattr(train_config, "ema_decay", 0.0):
-        import warnings
-
-        warnings.warn(
-            "train.ema_decay is only applied by the `train` path "
-            "(loop.fit); the vmapped HPO sweep packages raw final-step "
-            "params and ignores it",
-            stacklevel=3,
-        )
-
-
 def run_hpo(
     model_config: ModelConfig,
     train_config: TrainConfig,
@@ -79,7 +67,7 @@ def run_hpo(
     mesh=None,
 ) -> HPOResult:
     """Train all trials simultaneously and pick the objective winner."""
-    _warn_ema_unsupported(train_config)
+    warn_ema_unsupported(train_config, "the vmapped HPO sweep")
     model = build_model(model_config)
     t = hpo_config.trials
     steps = hpo_config.steps

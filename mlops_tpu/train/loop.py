@@ -106,6 +106,19 @@ def training_loss(
     return loss
 
 
+def warn_ema_unsupported(config: TrainConfig, where: str) -> None:
+    """train.ema_decay is applied only by ``fit``; every other trainer must
+    say so out loud instead of silently shipping raw params."""
+    if getattr(config, "ema_decay", 0.0):
+        import warnings
+
+        warnings.warn(
+            f"train.ema_decay is only applied by the `train` path "
+            f"(loop.fit); {where} packages raw params and ignores it",
+            stacklevel=3,
+        )
+
+
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,

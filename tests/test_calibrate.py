@@ -46,9 +46,6 @@ def test_nll_matches_closed_form():
 def test_bundle_carries_temperature_and_engine_applies_it(tiny_pipeline):
     """The pipeline fits T into the manifest and serving divides the
     logit by it — verified by reconstructing the raw logit."""
-    import jax
-    import jax.numpy as jnp
-
     from mlops_tpu.bundle import load_bundle
     from mlops_tpu.serve.engine import InferenceEngine
 
@@ -81,7 +78,6 @@ def test_bundle_carries_temperature_and_engine_applies_it(tiny_pipeline):
     uncal = np.asarray(engine_t1.predict_arrays(cat, num)["predictions"])
     logit = lambda p: np.log(p) - np.log1p(-p)  # noqa: E731
     np.testing.assert_allclose(logit(served), logit(uncal) / t, atol=1e-4)
-    assert jnp is not None  # keep the import used
 
 
 def test_old_manifest_without_calibration_defaults_to_identity(tiny_pipeline, tmp_path):
