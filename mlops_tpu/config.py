@@ -110,6 +110,11 @@ class ServeConfig:
     max_group: int = 64  # most requests one vmapped dispatch may carry;
     # clamped to the largest warmed slot bucket. Large groups are what
     # amortize the flat per-dispatch transport round trip into req/s
+    request_timeout_s: float = 30.0  # per-request deadline on the predict
+    # path: a stalled device (observed live: a remote-attached chip's
+    # tunnel hanging dispatches for 40+ min) 503s requests fast instead
+    # of wedging every in-flight connection until the client gives up.
+    # 0 disables.
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable

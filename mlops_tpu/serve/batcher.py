@@ -95,11 +95,19 @@ class MicroBatcher:
             # on the dispatch itself, so up to max_inflight groups ride
             # overlapping device round trips.
             await self._inflight.acquire()
-            # The loop guard + single-consumer invariant guarantee batch is
-            # non-empty (predict() only appends; this loop is the only
-            # consumer and nothing above awaited while the queue was read).
+            # Abandoned entries (the server's request deadline cancels the
+            # caller's future, e.g. during a device stall) are dropped at
+            # claim time: without this, a long stall with ongoing traffic
+            # grows _pending unboundedly and a recovering device would
+            # burn through a dead backlog before serving live requests.
+            self._pending = [
+                entry for entry in self._pending if not entry[1].done()
+            ]
             batch = self._pending[: self.max_group]
             del self._pending[: self.max_group]
+            if not batch:
+                self._inflight.release()
+                continue
             task = asyncio.create_task(self._dispatch(batch))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)

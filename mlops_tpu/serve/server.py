@@ -321,7 +321,32 @@ class HttpServer:
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
-            response = await self.batcher.predict(record_dicts)
+            # The deadline exists for a STALLED DEVICE (observed live: a
+            # remote-attached chip's tunnel hanging dispatches 40+ min):
+            # without it every in-flight request wedges until the client
+            # gives up, while liveness stays green.
+            call = self.batcher.predict(record_dicts)
+            if self.config.request_timeout_s:
+                response = await asyncio.wait_for(
+                    call, self.config.request_timeout_s
+                )
+            else:
+                response = await call
+        except asyncio.TimeoutError:
+            logger.error(
+                "prediction deadline (%.1fs) exceeded request_id=%s — "
+                "device stall?",
+                self.config.request_timeout_s,
+                request_id,
+            )
+            return (
+                503,
+                {
+                    "detail": f"prediction exceeded the "
+                    f"{self.config.request_timeout_s:g}s deadline"
+                },
+                "application/json",
+            )
         except Exception:
             logger.exception("prediction failed request_id=%s", request_id)
             return 500, {"detail": "prediction failed"}, "application/json"

@@ -145,3 +145,30 @@ def test_overlapped_dispatch_stress_matches_solo(engine, sample_request):
             assert g["feature_drift_batch"][name] == pytest.approx(
                 score, abs=1e-5
             )
+
+
+def test_abandoned_requests_are_purged_at_claim_time(engine, sample_request):
+    """Entries whose caller gave up (request deadline 503 during a device
+    stall) must be dropped when a group is claimed — a recovering device
+    must serve live traffic, not a dead backlog, and a long stall must not
+    grow the queue without bound."""
+
+    async def run():
+        executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        batcher = MicroBatcher(engine, executor, window_ms=30.0)
+        loop = asyncio.get_running_loop()
+
+        # Seed abandoned entries directly (what wait_for cancellation
+        # leaves behind), then one live request.
+        for _ in range(5):
+            dead = loop.create_future()
+            dead.cancel()
+            batcher._pending.append(([sample_request[0]], dead))
+        live = asyncio.create_task(batcher.predict([sample_request[0]]))
+        response = await asyncio.wait_for(live, timeout=30)
+        assert 0.0 <= response["predictions"][0] <= 1.0
+        # the dead entries did not survive the claim
+        assert all(not f.cancelled() for _, f in batcher._pending)
+        executor.shutdown(wait=False)
+
+    asyncio.run(run())

@@ -401,3 +401,41 @@ def test_inbound_request_id_is_honored_and_echoed(engine, sample_request):
     for sent, echoed in results[1:]:
         assert echoed != sent  # malformed -> replaced
         assert len(echoed) == 32 and all(c in "0123456789abcdef" for c in echoed)
+
+
+def test_request_deadline_503s_on_stalled_device(engine, sample_request):
+    """A wedged predict path (stalled device) must 503 within the deadline
+    instead of hanging every in-flight connection (observed live: a
+    tunnel-attached chip stalling dispatches for 40+ minutes)."""
+    config = ServeConfig(host="127.0.0.1", port=0, request_timeout_s=0.3)
+    server = HttpServer(engine, config)
+
+    async def hang_forever(records):
+        await asyncio.sleep(3600)
+
+    server.batcher.predict = hang_forever  # simulate the stall
+
+    async def run():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            data = json.dumps(sample_request).encode()
+            writer.write(
+                (
+                    f"POST /predict HTTP/1.1\r\nhost: t\r\n"
+                    f"content-length: {len(data)}\r\nconnection: close\r\n\r\n"
+                ).encode()
+                + data
+            )
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=5)
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), json.loads(body)
+
+    status, payload = asyncio.run(run())
+    assert status == 503
+    assert "deadline" in payload["detail"]
