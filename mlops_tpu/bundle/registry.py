@@ -304,3 +304,57 @@ class ModelRegistry:
 
     def list_versions(self, name: str) -> list[dict[str, Any]]:
         return self._read_index(name)["versions"]
+
+    def gc(self, name: str, keep_unstaged: int = 0) -> dict[str, list[int]]:
+        """Prune registry garbage for one model (local backend).
+
+        Removes ORPHAN version dirs (present on disk, absent from the
+        index) and abandoned ``.incoming-*`` staging dirs — both are
+        crash-mid-register leftovers the runbook otherwise asks operators
+        to delete by hand — and with ``keep_unstaged > 0`` also the oldest
+        stage-'none' versions beyond the newest N. Staged versions are
+        never touched. Returns what was removed.
+        """
+        if self._gcs:
+            raise ValueError(
+                "gc supports the local registry backend; for gs:// roots "
+                "use bucket lifecycle rules (versions are immutable "
+                "prefixes)"
+            )
+        with self._locked(name):
+            index = self._read_index(name)
+            known = {v["version"] for v in index["versions"]}
+            versions_dir = self.root / name / "versions"
+            orphans_removed = []
+            for v in self._stored_versions(name):
+                if v not in known:
+                    shutil.rmtree(versions_dir / str(v), ignore_errors=True)
+                    orphans_removed.append(v)
+            # Hard-killed register()s (SIGKILL skips the cleanup handler)
+            # leave full-bundle-sized staging dirs; no register can be in
+            # flight while gc holds the lock, so they are safe to drop.
+            if versions_dir.is_dir():
+                for staging in versions_dir.glob(".incoming-*"):
+                    shutil.rmtree(staging, ignore_errors=True)
+            versions_removed = []
+            if keep_unstaged > 0:
+                unstaged = sorted(
+                    (e for e in index["versions"] if e["stage"] == "none"),
+                    key=lambda e: e["version"],
+                )
+                doomed = unstaged[:-keep_unstaged]
+                if doomed:
+                    # Index first, dirs after — the inverse order would
+                    # leave dangling index entries on a crash mid-loop,
+                    # while this order leaves only orphan dirs, which the
+                    # scan above self-heals on the next gc.
+                    for entry in doomed:
+                        index["versions"].remove(entry)
+                        versions_removed.append(entry["version"])
+                    self._write_index(name, index)
+                    for v in versions_removed:
+                        shutil.rmtree(versions_dir / str(v), ignore_errors=True)
+            return {
+                "orphans_removed": orphans_removed,
+                "versions_removed": versions_removed,
+            }

@@ -30,6 +30,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("register", "register a bundle in the model registry"),
         ("promote", "move a registered version between stages"),
         ("versions", "list registered versions, stages, tags"),
+        ("gc", "prune registry orphans (and old unstaged versions)"),
         ("serve", "serve a bundle over HTTP"),
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),

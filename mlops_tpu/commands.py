@@ -169,6 +169,22 @@ def _promote(config) -> int:
     return 0
 
 
+def _gc(config) -> int:
+    """Prune crash orphans (and, with registry.gc_keep=N, old unstaged
+    versions) for the configured model."""
+    from mlops_tpu.bundle import ModelRegistry
+
+    registry = ModelRegistry(config.registry.root)
+    try:
+        removed = registry.gc(
+            config.registry.model_name, keep_unstaged=config.registry.gc_keep
+        )
+    except ValueError as err:  # gs:// root: clean message, no traceback
+        raise SystemExit(str(err))
+    print(json.dumps({"model": config.registry.model_name, **removed}))
+    return 0
+
+
 def _versions(config) -> int:
     from mlops_tpu.bundle import ModelRegistry
 
@@ -331,6 +347,7 @@ _HANDLERS = {
     "register": _register,
     "promote": _promote,
     "versions": _versions,
+    "gc": _gc,
     "predict-file": _predict_file,
     "score-batch": _score_batch,
     "bench": _bench,

@@ -131,6 +131,8 @@ class RegistryConfig:
     run_root: str = "runs"  # per-run artifacts: metrics.jsonl, checkpoints
     promote_version: str = ""  # `promote` CLI: version to move
     promote_stage: str = "staging"  # `promote` CLI: target stage
+    gc_keep: int = 0  # `gc` CLI: also prune old unstaged versions beyond
+    # the newest N (0 = remove crash orphans only)
 
 
 @dataclasses.dataclass

@@ -206,3 +206,37 @@ def test_concurrent_registration_is_serialized(trained, tmp_path):
     assert listed == list(range(1, 11))
     for v in range(1, 11):
         assert (root / "stress" / "versions" / str(v) / "manifest.json").exists()
+
+
+def test_gc_prunes_orphans_and_old_unstaged(trained, tmp_path):
+    """gc removes crash orphans and (with keep_unstaged) old stage-'none'
+    versions; staged versions and the newest unstaged survive."""
+    _, result = trained
+    registry = ModelRegistry(tmp_path / "reg")
+    for _ in range(4):
+        registry.register("m", result.bundle_dir)  # versions 1..4
+    registry.set_stage("m", 1, "production")
+    # crash orphan: dir on disk, absent from the index
+    orphan = tmp_path / "reg" / "m" / "versions" / "9"
+    orphan.mkdir(parents=True)
+
+    removed = registry.gc("m", keep_unstaged=1)
+    assert removed == {"orphans_removed": [9], "versions_removed": [2, 3]}
+    left = sorted(v["version"] for v in registry.list_versions("m"))
+    assert left == [1, 4]  # production v1 + newest unstaged v4
+    assert registry.resolve("m", "production").name == "1"
+    assert registry.resolve("m", "latest").name == "4"
+    assert not orphan.exists()
+
+
+def test_gc_prunes_abandoned_staging_dirs(trained, tmp_path):
+    """A SIGKILLed register leaves a .incoming-* staging dir (the cleanup
+    handler never ran); gc drops it."""
+    _, result = trained
+    registry = ModelRegistry(tmp_path / "reg")
+    registry.register("m", result.bundle_dir)
+    staging = tmp_path / "reg" / "m" / "versions" / ".incoming-deadbeef"
+    staging.mkdir(parents=True)
+    registry.gc("m")
+    assert not staging.exists()
+    assert registry.resolve("m", "latest").name == "1"
