@@ -31,6 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("promote", "move a registered version between stages"),
         ("versions", "list registered versions, stages, tags"),
         ("gc", "prune registry orphans (and old unstaged versions)"),
+        ("validate", "schema-check a CSV (OOV / unparseable counts)"),
         ("serve", "serve a bundle over HTTP"),
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),

@@ -169,6 +169,66 @@ def _promote(config) -> int:
     return 0
 
 
+def _validate(config) -> int:
+    """Lint a CSV before training/scoring — streamed, so any size.
+
+    Counts values the pipeline would silently degrade (OOV categoricals
+    -> the OOV bucket; missing/unparseable numerics -> median imputation)
+    and pre-flights label parseability the way training will see it
+    (fail-fast semantics). Exit 2 when anything is flagged. (The
+    reference's only data validation is Spark's inferSchema plus whatever
+    breaks at train time.)"""
+    import numpy as np
+
+    from mlops_tpu.data.stream import iter_csv_chunks
+    from mlops_tpu.schema import SCHEMA
+
+    path = config.data.train_path
+    if not path:
+        raise SystemExit("pass the csv via data.train_path=<csv>")
+
+    rows = 0
+    oov = dict.fromkeys((f.name for f in SCHEMA.categorical), 0)
+    vocabs = {f.name: set(f.vocab) for f in SCHEMA.categorical}
+    degraded_numeric = dict.fromkeys((f.name for f in SCHEMA.numeric), 0)
+    for columns, _ in iter_csv_chunks(path, chunk_rows=65_536):
+        rows += len(columns[SCHEMA.categorical[0].name])
+        for feat in SCHEMA.categorical:
+            vocab = vocabs[feat.name]
+            oov[feat.name] += sum(
+                1 for v in columns[feat.name] if v not in vocab
+            )
+        for feat in SCHEMA.numeric:
+            raw = np.asarray(columns[feat.name], dtype=np.float64)
+            degraded_numeric[feat.name] += int((~np.isfinite(raw)).sum())
+
+    # Label pre-flight: replay training's strict parse (one bad value
+    # fails `train` fast); "absent" is fine for scoring-only files.
+    try:
+        for _ in iter_csv_chunks(path, chunk_rows=65_536, require_target=True):
+            pass
+        labels = "ok"
+    except ValueError as err:
+        labels = "absent" if "missing target column" in str(err) else str(err)
+
+    report = {
+        "path": path,
+        "rows": rows,
+        "oov_categorical": {k: v for k, v in oov.items() if v},
+        # missing AND unparseable cells both impute to the median — the
+        # pipeline handles them; the count is the lint signal.
+        "numeric_imputed": {k: v for k, v in degraded_numeric.items() if v},
+        "labels": labels,
+        "ok": (
+            not any(oov.values())
+            and not any(degraded_numeric.values())
+            and labels in ("ok", "absent")
+        ),
+    }
+    print(json.dumps(report))
+    return 0 if report["ok"] else 2
+
+
 def _gc(config) -> int:
     """Prune crash orphans (and, with registry.gc_keep=N, old unstaged
     versions) for the configured model."""
@@ -348,6 +408,7 @@ _HANDLERS = {
     "promote": _promote,
     "versions": _versions,
     "gc": _gc,
+    "validate": _validate,
     "predict-file": _predict_file,
     "score-batch": _score_batch,
     "bench": _bench,

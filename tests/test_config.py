@@ -33,3 +33,4 @@ def test_env_overrides():
 def test_unknown_key_rejected():
     with pytest.raises(KeyError):
         load_config(overrides=["nope.nope=1"], env={})
+

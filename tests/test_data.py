@@ -80,3 +80,38 @@ def test_preprocessor_save_load(tmp_path, encoded_small):
     np.testing.assert_array_equal(prep.numeric_median, prep2.numeric_median)
     np.testing.assert_array_equal(prep.numeric_std, prep2.numeric_std)
     assert prep2.schema_fingerprint == SCHEMA.fingerprint()
+
+
+def test_validate_cli_reports_oov_bad_numerics_and_labels(tmp_path, capsys):
+    """`validate` streams a CSV and counts schema violations; exit 2 when
+    dirty, 0 when clean."""
+    import json as _json
+
+    from mlops_tpu.commands import _validate
+    from mlops_tpu.config import Config
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+
+    columns, labels = generate_synthetic(200, seed=4)
+    columns["sex"] = ["martian"] * 3 + columns["sex"][3:]
+    columns["age"] = [float("nan")] * 2 + columns["age"][2:]
+    path = tmp_path / "dirty.csv"
+    write_csv_columns(path, columns, labels)
+
+    config = Config()
+    config.data.train_path = str(path)
+    rc = _validate(config)
+    report = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 2
+    assert report["rows"] == 200
+    assert report["oov_categorical"] == {"sex": 3}
+    assert report["numeric_imputed"] == {"age": 2}
+    assert report["labels"] == "ok"
+    assert report["ok"] is False
+
+    # corrupt a label: the pre-flight must surface training's error
+    text = path.read_text().splitlines()
+    text[10] = text[10].rsplit(",", 1)[0] + ",maybe"
+    path.write_text("\n".join(text) + "\n")
+    rc = _validate(config)
+    report = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 2 and "unparseable" in report["labels"]
