@@ -1,0 +1,41 @@
+"""CLI surface: parser/handler sync, help smoke, small util units."""
+
+import numpy as np
+
+from mlops_tpu.cli import build_parser, main
+from mlops_tpu.utils.timing import percentile
+
+
+def test_every_subcommand_has_a_handler_and_vice_versa():
+    """cli.py's subparser list and commands._HANDLERS are edited in two
+    places; they must never drift (a listed command without a handler
+    exits 'not implemented', a handler without a listing is unreachable)."""
+    from mlops_tpu.commands import _HANDLERS
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if a.__class__.__name__ == "_SubParsersAction"
+    )
+    listed = set(sub.choices)
+    assert listed == set(_HANDLERS), (
+        f"parser-only: {listed - set(_HANDLERS)}; "
+        f"handler-only: {set(_HANDLERS) - listed}"
+    )
+
+
+def test_no_args_prints_help_and_exits_nonzero(capsys):
+    assert main([]) == 1
+    assert "mlops-tpu" in capsys.readouterr().out
+
+
+def test_percentile_matches_numpy_nearest_rank():
+    """percentile() is nearest-rank by contract — compare against numpy's
+    inverted_cdf method (its nearest-rank), not the interpolating default."""
+    rng = np.random.default_rng(0)
+    for n in (1, 7, 500, 501):
+        values = sorted(rng.normal(size=n).tolist())
+        for q in (0, 25, 50, 75, 90, 99, 100):
+            ours = percentile(values, q)
+            ref = float(np.percentile(values, q, method="inverted_cdf"))
+            assert ours == ref, (n, q)
+    assert percentile([42.0], 50) == 42.0
