@@ -9,7 +9,11 @@ warmed engine, then measure:
   (records -> encode -> device -> classifier+drift+outlier -> host),
   decomposed into encode / dispatch / fetch stages,
 - bulk throughput at buckets {256, 4096, 16384} plus a pipelined sweep
-  (dispatch all chunks, one batched fetch), and
+  (dispatch all chunks, one batched fetch) on both the exact ensemble and
+  the auto-routed bulk path (distilled student on CPU backends),
+- roofline evidence: XLA-counted FLOPs ÷ wall ÷ chip peak (``mfu_*`` keys)
+  for bulk inference, the fused train step, and the flash-attention
+  kernel (utils/flops.py),
 - direct engine grouped-dispatch capability (no HTTP layer), and
 - HTTP-level req/s through the real asyncio server + micro-batcher at
   client concurrency {1, 8, 32, 128}.
@@ -21,8 +25,11 @@ A crash prints the same shape with an ``"error"`` field (exit code 1).
 
 Env knobs: ``BENCH_MODEL`` (mlp|gbm, default mlp), ``BENCH_ENSEMBLE``
 (deep-ensemble members for the mlp flagship, default 8; 1 = single
-model), ``BENCH_TPU_TIMEOUT_S`` (TPU health-probe watchdog, default
-300), ``BENCH_WALL_TIMEOUT_S`` (PER-ATTEMPT wall budget guarding against
+model), ``BENCH_TPU_TIMEOUT_S`` (per-attempt TPU health-probe watchdog,
+default 150) with ``BENCH_TPU_RETRIES``/``BENCH_TPU_BACKOFF_S`` retry
+knobs (default 3 attempts, 30 s doubling backoff — a flapping tunnel gets
+several chances before the run falls back to measured CPU numbers),
+``BENCH_WALL_TIMEOUT_S`` (PER-ATTEMPT wall budget guarding against
 mid-run device stalls, default 1500; a stalled TPU attempt re-execs one
 CPU attempt with a fresh budget, so the worst-case total is ~2x plus
 the init probe), ``JAX_PLATFORMS`` (force a backend; honored via
@@ -98,20 +105,11 @@ def _reexec_on_cpu(reason: str) -> None:
         os._exit(1)
 
 
-def _ensure_healthy_backend(timeout_s: int) -> None:
-    """Probe TPU init in a SUBPROCESS (the tunnel dial blocks in C++ where
-    in-process alarms can't interrupt). If the probe doesn't come back
-    healthy in time, RE-EXEC this process under ``JAX_PLATFORMS=cpu`` —
-    the in-process ``jax.config.update`` fallback is shadowed whenever the
-    site bootstrap already initialized the backend (BENCH_r01 failure
-    mode), while a fresh process + the env re-assert in
-    ``_honor_jax_platforms_env`` cannot be. Only a non-TPU
-    ``JAX_PLATFORMS`` (or a prior re-exec) skips the probe — the harness
-    exports ``JAX_PLATFORMS=axon`` ambiently (see ``_on_tpu_path``)."""
+def _probe_tpu_once(timeout_s: int) -> bool:
+    """One subprocess TPU-init probe (the tunnel dial blocks in C++ where
+    in-process alarms can't interrupt)."""
     import subprocess
 
-    if not _on_tpu_path():
-        return
     try:
         # DEVNULL, not pipes: the TPU plugin forks tunnel helpers that
         # inherit stdio; after the timeout-kill a captured pipe would
@@ -122,11 +120,46 @@ def _ensure_healthy_backend(timeout_s: int) -> None:
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
-        healthy = probe.returncode == 0
+        return probe.returncode == 0
     except subprocess.TimeoutExpired:
-        healthy = False
-    if not healthy:
-        _reexec_on_cpu(f"tpu backend not healthy within {timeout_s}s")
+        return False
+
+
+def _ensure_healthy_backend(timeout_s: int) -> None:
+    """Probe TPU init with bounded RETRY + BACKOFF before giving up. A
+    remote-attached chip's tunnel flaps (observed live: dead at round end,
+    back minutes later — the reason BENCH_r03 recorded CPU numbers), so a
+    single failed probe re-trying a few times is the difference between a
+    driver-captured TPU benchmark and a software-floor one. After the last
+    failed attempt, RE-EXEC this process under ``JAX_PLATFORMS=cpu`` — the
+    in-process ``jax.config.update`` fallback is shadowed whenever the
+    site bootstrap already initialized the backend (BENCH_r01 failure
+    mode), while a fresh process + the env re-assert in
+    ``_honor_jax_platforms_env`` cannot be. Only a non-TPU
+    ``JAX_PLATFORMS`` (or a prior re-exec) skips the probe — the harness
+    exports ``JAX_PLATFORMS=axon`` ambiently (see ``_on_tpu_path``).
+
+    Knobs: ``BENCH_TPU_TIMEOUT_S`` per-attempt budget, ``BENCH_TPU_RETRIES``
+    attempts (default 3), ``BENCH_TPU_BACKOFF_S`` first sleep between
+    attempts (default 30, doubling)."""
+    if not _on_tpu_path():
+        return
+    attempts = max(1, int(os.environ.get("BENCH_TPU_RETRIES", "3")))
+    backoff = float(os.environ.get("BENCH_TPU_BACKOFF_S", "30"))
+    for attempt in range(attempts):
+        if _probe_tpu_once(timeout_s):
+            return
+        if attempt < attempts - 1:
+            print(
+                f"# tpu probe {attempt + 1}/{attempts} failed; "
+                f"retrying in {backoff:.0f}s",
+                flush=True,
+            )
+            time.sleep(backoff)
+            backoff *= 2
+    _reexec_on_cpu(
+        f"tpu backend not healthy in {attempts} probe(s) of {timeout_s}s"
+    )
 
 
 def _percentile(sorted_ms: list[float], q: float) -> float:
@@ -200,15 +233,137 @@ def _bulk_stage(engine, bundle) -> dict:
         dt = time.perf_counter() - t0
         out[f"bulk_rows_per_s_b{n}"] = round(reps * n / dt, 1)
 
-    # Pipelined sweep: 262,144 rows through the chunked bulk scorer.
+    # Pipelined sweep: 262,144 rows through the chunked bulk scorer —
+    # once exact (serving-identical ensemble; the key's historical
+    # meaning) and once auto-routed (the product path: the distilled bulk
+    # student on CPU backends, the exact model on TPU — parallel/bulk.py
+    # use_distilled_bulk). The auto number is the one BASELINE.md compares
+    # against the sklearn GBM floor.
     n = 262_144
     ds = EncodedDataset(
         cat_ids=rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32),
         numeric=rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32),
         labels=None,
     )
-    result = score_dataset(bundle, ds, mesh=None, chunk_rows=16_384)
+    from mlops_tpu.parallel.bulk import use_distilled_bulk
+
+    result = score_dataset(bundle, ds, mesh=None, chunk_rows=16_384, exact=True)
     out["bulk_rows_per_s_pipelined"] = round(result.rows_per_s, 1)
+    if use_distilled_bulk(bundle):
+        # Only re-sweep when auto actually routes differently (distilled
+        # student on CPU); on the exact path the number would be a
+        # duplicate measurement plus a duplicate compile.
+        auto = score_dataset(bundle, ds, mesh=None, chunk_rows=16_384)
+        out["bulk_rows_per_s_bulkpath"] = round(auto.rows_per_s, 1)
+        out["bulk_path"] = auto.path
+    else:
+        out["bulk_rows_per_s_bulkpath"] = out["bulk_rows_per_s_pipelined"]
+        out["bulk_path"] = "exact"
+    fidelity = bundle.bulk_fidelity
+    if "roc_auc_delta" in fidelity:
+        out["bulk_fidelity_auc_delta"] = round(fidelity["roc_auc_delta"], 4)
+    return out
+
+
+def _mfu_stage(bundle, bulk: dict, device) -> dict:
+    """Roofline evidence (SURVEY §6 gap: the reference publishes none):
+    XLA-counted FLOPs per call ÷ measured wall ÷ chip peak, for the three
+    hot paths — bulk inference (using the throughput the bulk stage just
+    measured), one fused train step at the training batch size, and the
+    flash-attention kernel at its tuned shape. ``mfu_*`` is None when the
+    device kind has no known peak (plain CPU) unless
+    ``MLOPS_TPU_PEAK_FLOPS`` supplies one; ``*_gflops_per_s`` is always
+    reported so the achieved-FLOPs floor is auditable either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.utils.flops import (
+        compile_with_flops,
+        compiled_flops,
+        mfu,
+        peak_flops,
+    )
+
+    peak = peak_flops(device)
+    out: dict = {"peak_flops": peak}
+    if bundle.flavor == "sklearn":
+        return {}
+
+    model, variables = bundle.model, bundle.variables
+    rng = np.random.default_rng(1)
+
+    # --- bulk inference: FLOPs of the b16384 forward × measured calls/s.
+    n = 16_384
+    cat = jnp.asarray(
+        rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
+    )
+    num = jnp.asarray(rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32))
+
+    def fwd(cat, num):
+        return model.apply(variables, cat, num, train=False)
+
+    f_bulk = compiled_flops(fwd, cat, num)
+    rows_per_s = bulk.get("bulk_rows_per_s_b16384", 0.0)
+    if f_bulk:
+        out["bulk_gflops_per_s"] = round(f_bulk * rows_per_s / n / 1e9, 1)
+        out["mfu_bulk"] = mfu(f_bulk, rows_per_s / n, peak)
+
+    # --- train step: fused value_and_grad at the training batch size.
+    from mlops_tpu.train.loop import training_loss
+
+    batch = 1024
+    tcat = cat[:batch]
+    tnum = num[:batch]
+    tlab = jnp.asarray((rng.random(batch) < 0.2).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    def step(params, cat, num, lab):
+        return jax.value_and_grad(
+            lambda p: training_loss(model, p, cat, num, lab, key, 1.0)
+        )(params)
+
+    params = variables["params"]
+    # One compile serves both the FLOP count and the timed calls.
+    exe, f_step = compile_with_flops(step, params, tcat, tnum, tlab)
+    if exe is not None:
+        jax.block_until_ready(exe(params, tcat, tnum, tlab))
+        reps = 10
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            loss, grads = exe(params, tcat, tnum, tlab)
+        jax.block_until_ready(grads)
+        dt = (time.perf_counter() - t0) / reps
+        if f_step:
+            out["train_step_gflops_per_s"] = round(f_step / dt / 1e9, 1)
+            out["mfu_train"] = mfu(f_step, 1.0 / dt, peak)
+
+    # --- flash attention at its tuned shape (TPU only: the Pallas kernel
+    # runs in interpret mode on CPU, which measures the interpreter).
+    if getattr(device, "platform", "cpu") != "cpu":
+        from mlops_tpu.ops.attention import flash_attention
+
+        b, s, h, d = 4, 2048, 8, 64
+        q, k, v = (
+            jnp.asarray(
+                rng.normal(size=(b, s, h, d)), dtype=jnp.bfloat16
+            )
+            for _ in range(3)
+        )
+        flash = jax.jit(flash_attention)
+        jax.block_until_ready(flash(q, k, v))
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = flash(q, k, v)
+        jax.block_until_ready(o)
+        dt = (time.perf_counter() - t0) / reps
+        # Analytic dense-equivalent FLOPs (QKᵀ + PV): Pallas kernels are
+        # opaque to XLA's cost model, so this one is counted by hand.
+        f_attn = 4.0 * b * h * s * s * d
+        out["flash_attn_gflops_per_s"] = round(f_attn / dt / 1e9, 1)
+        out["mfu_flash_attn"] = mfu(f_attn, 1.0 / dt, peak)
     return out
 
 
@@ -371,7 +526,7 @@ def _arm_wall_watchdog(timeout_s: int):
 def main() -> None:
     # Honor an explicit JAX_PLATFORMS env (the container bootstrap otherwise
     # pins the TPU backend, hanging CPU-only runs on the tunnel dial).
-    _ensure_healthy_backend(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "300")))
+    _ensure_healthy_backend(int(os.environ.get("BENCH_TPU_TIMEOUT_S", "150")))
     watchdog = _arm_wall_watchdog(
         int(os.environ.get("BENCH_WALL_TIMEOUT_S", "1500"))
     )
@@ -424,6 +579,7 @@ def main() -> None:
     record = LoanApplicant().model_dump()
     batch1 = _batch1_stage(engine, record)
     bulk = _bulk_stage(engine, bundle)
+    roofline = _mfu_stage(bundle, bulk, device)
     http = {**_engine_stage(engine, record), **_http_stage(engine, record)}
 
     p50 = batch1["p50_ms"]
@@ -439,6 +595,7 @@ def main() -> None:
                 "batch1_req_per_s": round(1e3 / p50, 1),
                 "breakdown_ms": batch1["breakdown_ms"],
                 **bulk,
+                **roofline,
                 **http,
                 "device": str(device),
                 "model": family if ensemble == 1 else f"{family}-ens{ensemble}",

@@ -19,6 +19,7 @@ from mlops_tpu.version import __version__
 
 MANIFEST_NAME = "manifest.json"
 PARAMS_NAME = "params.msgpack"
+BULK_PARAMS_NAME = "bulk_params.msgpack"
 ESTIMATOR_NAME = "estimator.joblib"
 PREPROCESS_NAME = "preprocess.npz"
 MONITOR_NAME = "monitor.npz"
@@ -40,10 +41,23 @@ class Bundle:
     preprocessor: Preprocessor
     monitor: MonitorState
     estimator: Any = None  # SklearnBaseline (sklearn flavor) | None
+    bulk_model: Any = None  # distilled student (train/distill.py) | None
+    bulk_variables: dict[str, Any] | None = None
 
     @property
     def flavor(self) -> str:
         return self.manifest.get("flavor", "flax")
+
+    @property
+    def has_bulk(self) -> bool:
+        """True when the bundle carries a distilled bulk student — the
+        CPU-backend bulk scorer routes through it (`parallel/bulk.py`);
+        serving always uses the exact model."""
+        return self.bulk_model is not None
+
+    @property
+    def bulk_fidelity(self) -> dict[str, float]:
+        return dict(self.manifest.get("bulk", {}).get("fidelity", {}))
 
     @property
     def model_config(self) -> ModelConfig:
@@ -96,6 +110,7 @@ def save_bundle(
     metrics: dict[str, float] | None = None,
     tags: dict[str, str] | None = None,
     calibration: dict[str, float] | None = None,
+    bulk: Any = None,  # DistillResult (train/distill.py) | None
 ) -> Path:
     """Write a self-contained bundle directory.
 
@@ -123,6 +138,16 @@ def save_bundle(
         params.save(directory / ESTIMATOR_NAME)  # a SklearnBaseline
     else:
         (directory / PARAMS_NAME).write_bytes(tree_bytes(params))
+    if bulk is not None:
+        # Distilled bulk student (train/distill.py): a second, smaller
+        # param tree + its fidelity record, so bulk routing is auditable.
+        manifest["bulk"] = {
+            "model_config": dataclasses.asdict(bulk.student_config),
+            "fidelity": bulk.fidelity,
+        }
+        (directory / BULK_PARAMS_NAME).write_bytes(
+            tree_bytes(bulk.student_params)
+        )
     preprocessor.save(directory / PREPROCESS_NAME)
     monitor.save(directory / MONITOR_NAME)
     (directory / MANIFEST_NAME).write_text(json.dumps(manifest, indent=2))
@@ -174,10 +199,24 @@ def load_bundle(directory: str | Path) -> Bundle:
             "builds — re-train/re-register the model with the current "
             "framework"
         ) from err
+    bulk_model = None
+    bulk_variables = None
+    if "bulk" in manifest and (directory / BULK_PARAMS_NAME).exists():
+        bulk_config = _model_config_from_manifest(manifest["bulk"])
+        bulk_model = build_model(bulk_config)
+        bulk_template = init_params(bulk_model, jax.random.PRNGKey(0))
+        bulk_variables = {
+            "params": restore_tree(
+                bulk_template["params"],
+                (directory / BULK_PARAMS_NAME).read_bytes(),
+            )
+        }
     return Bundle(
         manifest=manifest,
         model=model,
         variables={"params": params},
         preprocessor=preprocessor,
         monitor=monitor,
+        bulk_model=bulk_model,
+        bulk_variables=bulk_variables,
     )

@@ -75,14 +75,14 @@ def _pretrain(config) -> int:
     """Masked-feature pretraining on unlabeled rows (BASELINE config 5's
     'fine-tune' implies a pretrain stage; labels are never read). Output:
     a params file consumable via ``train train.init_params=<path>``."""
-    from mlops_tpu.data import Preprocessor, generate_synthetic, load_csv_columns
+    from mlops_tpu.data import Preprocessor, generate_synthetic, load_table_columns
     from mlops_tpu.train.pipeline import new_run_dir
     from mlops_tpu.train.pretrain import pretrain_bert, save_pretrained
 
     if config.model.family != "bert":
         raise SystemExit("pretrain supports model.family=bert")
     if config.data.train_path:
-        columns, _ = load_csv_columns(config.data.train_path)
+        columns, _ = load_table_columns(config.data.train_path)
     else:
         columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
     prep = Preprocessor.fit(columns)
@@ -170,7 +170,7 @@ def _promote(config) -> int:
 
 
 def _validate(config) -> int:
-    """Lint a CSV before training/scoring — streamed, so any size.
+    """Lint a CSV/Parquet before training/scoring — streamed, so any size.
 
     Counts values the pipeline would silently degrade (OOV categoricals
     -> the OOV bucket; missing/unparseable numerics -> median imputation)
@@ -180,18 +180,18 @@ def _validate(config) -> int:
     breaks at train time.)"""
     import numpy as np
 
-    from mlops_tpu.data.stream import iter_csv_chunks
+    from mlops_tpu.data.stream import iter_table_chunks
     from mlops_tpu.schema import SCHEMA
 
     path = config.data.train_path
     if not path:
-        raise SystemExit("pass the csv via data.train_path=<csv>")
+        raise SystemExit("pass the dataset via data.train_path=<csv|parquet>")
 
     rows = 0
     oov = dict.fromkeys((f.name for f in SCHEMA.categorical), 0)
     vocabs = {f.name: set(f.vocab) for f in SCHEMA.categorical}
     degraded_numeric = dict.fromkeys((f.name for f in SCHEMA.numeric), 0)
-    for columns, _ in iter_csv_chunks(path, chunk_rows=65_536):
+    for columns, _ in iter_table_chunks(path, chunk_rows=65_536):
         rows += len(columns[SCHEMA.categorical[0].name])
         for feat in SCHEMA.categorical:
             vocab = vocabs[feat.name]
@@ -205,7 +205,7 @@ def _validate(config) -> int:
     # Label pre-flight: replay training's strict parse (one bad value
     # fails `train` fast); "absent" is fine for scoring-only files.
     try:
-        for _ in iter_csv_chunks(path, chunk_rows=65_536, require_target=True):
+        for _ in iter_table_chunks(path, chunk_rows=65_536, require_target=True):
             pass
         labels = "ok"
     except ValueError as err:
@@ -300,13 +300,22 @@ def _score_batch(config) -> int:
             out_path=config.score.output_path or None,
             chunk_rows=config.score.chunk_rows,
             mesh=mesh,
+            exact=True if config.score.exact else None,
         )
         print(json.dumps(stats))
         return 0
     if config.data.train_path:
-        # Native one-pass parse+encode when built (the 1M-row hot path);
-        # transparent Python fallback otherwise.
-        ds = encode_csv(config.data.train_path, bundle.preprocessor)
+        from mlops_tpu.data.parquet import is_parquet, load_parquet_columns
+
+        if is_parquet(config.data.train_path):
+            # Columnar path: the C++ kernel is CSV-byte-oriented, so
+            # Parquet encodes through the Python pipeline.
+            columns, _ = load_parquet_columns(config.data.train_path)
+            ds = bundle.preprocessor.encode(columns)
+        else:
+            # Native one-pass parse+encode when built (the 1M-row hot
+            # path); transparent Python fallback otherwise.
+            ds = encode_csv(config.data.train_path, bundle.preprocessor)
     else:
         columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
         ds = bundle.preprocessor.encode(columns)
@@ -319,6 +328,7 @@ def _score_batch(config) -> int:
         chunk_rows=config.score.chunk_rows,
         drift_sample=config.score.drift_sample,
         seed=config.data.seed,
+        exact=True if config.score.exact else None,
     )
     if config.score.output_path:
         np.savez(

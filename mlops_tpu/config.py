@@ -46,6 +46,13 @@ class ModelConfig:
     # bounds mirror the reference's hyperopt space, `01-train-model.ipynb:342-353`)
     n_estimators: int = 300
     max_tree_depth: int = 8
+    # Long-context (family bert): read `doc_records` consecutive records as
+    # ONE document (seq = 2 + 46R tokens) and predict the last record's
+    # default from the history; `seq_parallel` routes attention through the
+    # ppermute ring (`parallel.make_ring_attention`) over the mesh's 'seq'
+    # axis — the training path is `train/long_context.py`.
+    doc_records: int = 1
+    seq_parallel: bool = False
 
 
 @dataclasses.dataclass
@@ -68,6 +75,11 @@ class TrainConfig:
     # select-best-by-validation-metric semantics (cell 10), and the guard
     # against the measured overfitting cliff (2400 steps: AUC 0.8056 ->
     # 0.7537 on the synthetic task). False = always package final params.
+    distill_bulk: bool = True  # ensembles (>1 member) also package a
+    # distilled single-MLP "bulk student" (train/distill.py): CPU-backend
+    # bulk sweeps route through it so they beat the sklearn GBM floor
+    # instead of paying K× ensemble FLOPs; serving stays exact. The
+    # student's fidelity record lands in the bundle manifest.
     ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
     # params (EMA folded into the compiled scan; eval/packaging use the
     # debiased average, raw params keep training). 0 disables. Applies to
@@ -86,6 +98,14 @@ class HPOConfig:
     objective: str = "roc_auc"  # selection metric, parity with
     # `mlflow.search_runs(order_by validation_roc_auc_score DESC)` (cell 10)
     steps: int = 1000
+    architectures: tuple[str, ...] = ()  # structural sweep axis (the
+    # reference's n_estimators/max_depth/criterion analogue,
+    # `01-train-model.ipynb:342-353`): each spec is comma-separated
+    # ModelConfig overrides, e.g. "family=mlp,hidden_dims=64x64,embed_dim=8"
+    # (tuples use 'x'). Each spec is one vmapped group of `trials` trials;
+    # groups loop in Python (shapes differ -> separate compiles), selection
+    # crosses groups by the same objective ordering. Empty = single group
+    # with the configured model.
 
 
 @dataclasses.dataclass
@@ -145,6 +165,10 @@ class ScoreConfig:
     streaming: bool = False  # out-of-core: stream CSV chunks through the
     # fused predict with one-chunk peak memory (data/stream.py); output
     # becomes an incrementally-written CSV instead of an .npz
+    exact: bool = False  # True forces the serving-identical ensemble for
+    # bulk scoring; False (default) auto-routes through the distilled
+    # bulk student on CPU backends (parallel/bulk.py use_distilled_bulk —
+    # the output JSON's "path" field records which ran)
 
 
 @dataclasses.dataclass

@@ -9,10 +9,15 @@ standardized numerics.
 """
 
 from mlops_tpu.data.encode import EncodedDataset, Preprocessor
-from mlops_tpu.data.ingest import load_csv_columns, write_csv_columns
+from mlops_tpu.data.ingest import (
+    load_csv_columns,
+    load_table_columns,
+    write_csv_columns,
+)
 from mlops_tpu.data.stream import (
     fit_streaming,
     iter_csv_chunks,
+    iter_table_chunks,
     score_csv_stream,
 )
 from mlops_tpu.data.synth import generate_synthetic
@@ -23,7 +28,9 @@ __all__ = [
     "fit_streaming",
     "generate_synthetic",
     "iter_csv_chunks",
+    "iter_table_chunks",
     "load_csv_columns",
+    "load_table_columns",
     "score_csv_stream",
     "write_csv_columns",
 ]

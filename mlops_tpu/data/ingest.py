@@ -141,6 +141,23 @@ def load_csv_columns(
     return columns, labels
 
 
+def load_table_columns(
+    path: str | Path,
+    schema: FeatureSchema = SCHEMA,
+    require_target: bool = False,
+) -> tuple[dict[str, list], np.ndarray | None]:
+    """Format-dispatching reader: ``.parquet``/``.pq`` routes to the
+    columnar path (`data/parquet.py`), everything else to CSV. One contract
+    either way — this is the entry point pipelines should use (the
+    reference gets the same property from Spark's format-agnostic
+    ``read.table``)."""
+    from mlops_tpu.data import parquet
+
+    if parquet.is_parquet(path):
+        return parquet.load_parquet_columns(path, schema, require_target)
+    return load_csv_columns(path, schema, require_target)
+
+
 def write_csv_columns(
     path: str | Path,
     columns: dict[str, list],

@@ -89,6 +89,22 @@ def iter_csv_chunks(
             yield emit(buffer, seen)
 
 
+def iter_table_chunks(
+    path: str | Path,
+    chunk_rows: int = 65_536,
+    schema: FeatureSchema = SCHEMA,
+    require_target: bool = False,
+) -> Iterator[tuple[dict[str, list], np.ndarray | None]]:
+    """Format-dispatching chunk iterator: Parquet files stream through
+    ``parquet.iter_parquet_chunks`` (exact-size re-buffered chunks),
+    everything else through ``iter_csv_chunks``. Same yielded contract."""
+    from mlops_tpu.data import parquet
+
+    if parquet.is_parquet(path):
+        return parquet.iter_parquet_chunks(path, chunk_rows, schema, require_target)
+    return iter_csv_chunks(path, chunk_rows, schema, require_target)
+
+
 class StreamingStats:
     """Mergeable single-pass accumulator for the Preprocessor's fit.
 
@@ -189,9 +205,9 @@ def fit_streaming(
     reservoir_size: int = 100_000,
     seed: int = 0,
 ) -> Preprocessor:
-    """One-pass Preprocessor fit over an arbitrarily large CSV."""
+    """One-pass Preprocessor fit over an arbitrarily large CSV/Parquet."""
     stats = StreamingStats(schema, reservoir_size=reservoir_size, seed=seed)
-    for columns, _ in iter_csv_chunks(path, chunk_rows, schema):
+    for columns, _ in iter_table_chunks(path, chunk_rows, schema):
         stats.update(columns)
     return stats.finalize()
 
@@ -202,8 +218,10 @@ def score_csv_stream(
     out_path: str | Path | None = None,
     chunk_rows: int = 65_536,
     mesh=None,
+    exact: bool | None = None,
 ) -> dict[str, float]:
-    """Stream-score a CSV of any size through the bundle's fused predict.
+    """Stream-score a CSV/Parquet of any size through the bundle's fused
+    predict.
 
     chunk -> encode -> ONE device dispatch (classifier + outliers) ->
     append ``prediction,outlier`` rows to ``out_path``. Peak memory is one
@@ -213,12 +231,16 @@ def score_csv_stream(
     """
     import contextlib
 
-    from mlops_tpu.parallel.bulk import make_chunk_scorer
+    from mlops_tpu.parallel.bulk import make_chunk_scorer, use_distilled_bulk
 
     if mesh is not None:
         axis = mesh.shape["data"]
         chunk_rows = ((chunk_rows + axis - 1) // axis) * axis
-    score_chunk = make_chunk_scorer(bundle, mesh=mesh)
+    # Same routing contract as score_dataset: ``exact=None`` auto-routes
+    # through the distilled bulk student on CPU backends; the returned
+    # stats carry ``path`` so the substitution is always visible.
+    path_used = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
+    score_chunk = make_chunk_scorer(bundle, mesh=mesh, exact=exact)
     rows = 0
     outlier_count = 0.0
     prob_sum = 0.0
@@ -230,7 +252,7 @@ def score_csv_stream(
             f = stack.enter_context(out_path.open("w", newline=""))
             writer = csv.writer(f)
             writer.writerow(["prediction", "outlier"])
-        for columns, _ in iter_csv_chunks(in_path, chunk_rows):
+        for columns, _ in iter_table_chunks(in_path, chunk_rows):
             ds = bundle.preprocessor.encode(columns)
             n = ds.n
             # Pad to the fixed chunk shape so one compiled program serves
@@ -251,6 +273,7 @@ def score_csv_stream(
                 )
     return {
         "rows": rows,
+        "path": path_used,
         "mean_prediction": prob_sum / max(rows, 1),
         "outlier_rate": outlier_count / max(rows, 1),
     }

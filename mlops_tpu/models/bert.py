@@ -268,6 +268,99 @@ class BertMaskedLM(nn.Module):
         return logits.astype(jnp.float32), targets
 
 
+def tokenize_documents(
+    cat_ids: jnp.ndarray, numeric: jnp.ndarray, layout: TokenLayout
+) -> jnp.ndarray:
+    """Render record HISTORIES as one token sequence:
+    (int32[N,R,C], f32[N,R,M]) -> int32[N, 2 + 2*F*R].
+
+    Layout: ``[CLS] rec_1 pairs ... rec_R pairs [SEP]`` — each record
+    contributes its (name, value) pairs from ``tokenize`` (per-record
+    CLS/SEP stripped). Long-context consumer: `train/long_context.py`.
+    """
+    n, r, c = cat_ids.shape
+    flat = tokenize(
+        cat_ids.reshape(n * r, c), numeric.reshape(n * r, -1), layout
+    )  # [N*R, 2 + 2F]
+    pairs = flat[:, 1:-1].reshape(n, r * 2 * layout.num_features)
+    cls = jnp.full((n, 1), CLS_ID, jnp.int32)
+    sep = jnp.full((n, 1), SEP_ID, jnp.int32)
+    return jnp.concatenate([cls, pairs, sep], axis=1)
+
+
+class BertDocEncoder(nn.Module):
+    """Long-context BERT over record histories (documents).
+
+    The tabular-as-text rendering makes ONE record a 48-token sentence;
+    this model reads ``doc_records`` consecutive records as one document
+    (seq = 2 + 46R: R=11 -> 508 tokens) and predicts the default of the
+    LAST record from the whole history. Calling convention is 3-D:
+    ``apply(vars, cat[N,R,C], numeric[N,R,M], train) -> logits[N]``.
+
+    This is the model the sequence-parallel training path runs
+    (`train/long_context.py`): ``attend_fn`` injects the ppermute ring
+    (`parallel.make_ring_attention`) so the sequence axis shards over the
+    mesh's 'seq' axis; ``attend_fn=None`` is the dense single-chip
+    reference the tests compare against. Trunk module names match
+    ``BertEncoder`` (tok_embed, pos_embed, ln_embed, block_i, ln_final,
+    pooler, head) so TP ``PARAM_RULES`` and pretrained-trunk grafting
+    apply unchanged.
+    """
+
+    cards: Sequence[int]
+    num_numeric: int
+    doc_records: int
+    hidden: int = 256
+    depth: int = 4
+    heads: int = 8
+    dropout: float = 0.0  # attention-weight dropout needs materialized
+    # scores, which the ring path never forms — keep 0 for SP training
+    num_bins: int = 32
+    dtype: jnp.dtype = jnp.bfloat16
+    attend_fn: "object" = None  # Callable | None; static module attribute
+
+    @property
+    def layout(self) -> TokenLayout:
+        return TokenLayout(tuple(self.cards), self.num_numeric, self.num_bins)
+
+    @property
+    def doc_seq_len(self) -> int:
+        return 2 + 2 * self.layout.num_features * self.doc_records
+
+    @nn.compact
+    def __call__(
+        self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
+    ) -> jnp.ndarray:
+        layout = self.layout
+        tokens = tokenize_documents(cat_ids, numeric, layout)  # [N, S]
+        x = nn.Embed(
+            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
+        )(tokens)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.doc_seq_len, self.hidden),
+        )
+        x = x + pos.astype(self.dtype)[None]
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                heads=self.heads,
+                token_dim=self.hidden,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                attend_fn=self.attend_fn,
+                name=f"block_{i}",
+            )(x, train=train)
+        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x[:, 0])
+        pooled = nn.tanh(
+            nn.Dense(self.hidden, dtype=self.dtype, name="pooler")(cls)
+        )
+        logit = nn.Dense(1, dtype=self.dtype, name="head")(pooled)
+        return logit[:, 0].astype(jnp.float32)
+
+
 def transfer_encoder_params(pretrained: dict, target: dict) -> dict:
     """Graft pretrained trunk params into a freshly-initialized classifier
     param tree (same-named subtrees copy; heads keep their fresh init)."""

@@ -15,7 +15,7 @@ already near-roofline.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Callable, Sequence
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -65,12 +65,18 @@ class FeatureTokenizer(nn.Module):
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN block: MHA + GELU MLP, residual, dropout."""
+    """Pre-LN block: MHA + GELU MLP, residual, dropout.
+
+    ``attend_fn`` (optional) overrides the attention kernel — the
+    sequence-parallel BERT path injects the shard_map'd ring
+    (`parallel.make_ring_attention`) through here.
+    """
 
     heads: int
     token_dim: int
     dropout: float
     dtype: jnp.dtype = jnp.bfloat16
+    attend_fn: Callable | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
@@ -79,6 +85,7 @@ class TransformerBlock(nn.Module):
             heads=self.heads,
             dtype=self.dtype,
             dropout=self.dropout,
+            attend_fn=self.attend_fn,
         )(h, deterministic=not train)
         x = x + nn.Dropout(self.dropout, deterministic=not train)(h)
 

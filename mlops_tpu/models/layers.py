@@ -11,6 +11,7 @@ dense path; eval/serving always goes through the dispatcher.
 from __future__ import annotations
 
 import math
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,12 @@ class MultiHeadSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     dropout: float = 0.0
     use_flash: bool | None = None  # None = dispatch on sequence length
+    attend_fn: Callable | None = None  # override the kernel dispatcher —
+    # the sequence-parallel path injects `parallel.make_ring_attention`'s
+    # shard_map'd ring here so the SAME module runs dense on one chip and
+    # ring-sharded over a ('data','seq') mesh. Incompatible with padding
+    # masks and attention-weight dropout (both need the materialized score
+    # matrix); those combinations raise rather than silently fall back.
 
     @nn.compact
     def __call__(
@@ -44,7 +51,16 @@ class MultiHeadSelfAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         needs_weight_dropout = self.dropout > 0.0 and not deterministic
-        if mask is not None or needs_weight_dropout:
+        if self.attend_fn is not None:
+            if mask is not None or needs_weight_dropout:
+                raise ValueError(
+                    "attend_fn (ring attention) cannot combine with padding "
+                    "masks or attention-weight dropout — both require the "
+                    "materialized score matrix; train with dropout=0.0 on "
+                    "the sequence-parallel path"
+                )
+            out = self.attend_fn(q, k, v)
+        elif mask is not None or needs_weight_dropout:
             # Dense path: padding masks and attention-weight dropout need the
             # materialized [B,H,S,S] scores (training-time only for dropout).
             scale = 1.0 / math.sqrt(head_dim)

@@ -42,6 +42,7 @@ class BulkScoreResult:
     feature_drift: dict[str, float]  # per-feature 1 - p_val on the sample
     rows: int
     elapsed_s: float  # device scoring time (excludes data generation/IO)
+    path: str = "exact"  # "exact" | "distilled" — which params scored
 
     @property
     def rows_per_s(self) -> float:
@@ -50,6 +51,7 @@ class BulkScoreResult:
     def summary(self) -> dict[str, Any]:
         return {
             "rows": self.rows,
+            "path": self.path,
             "elapsed_s": round(self.elapsed_s, 4),
             "rows_per_s": round(self.rows_per_s, 1),
             "default_rate": (
@@ -64,14 +66,31 @@ class BulkScoreResult:
         }
 
 
-def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
+def use_distilled_bulk(bundle: Bundle, exact: bool | None = None) -> bool:
+    """Routing decision for bulk sweeps: the distilled student
+    (`train/distill.py`) scores when the bundle carries one and either the
+    caller asked for it (``exact=False``) or — the auto default — the
+    backend is a CPU, where the K-member ensemble's FLOPs lose to the
+    reference's sklearn floor (BASELINE.md config 1). On a TPU the exact
+    ensemble is already fast, so auto keeps it."""
+    if exact is True or not bundle.has_bulk:
+        return False
+    if exact is False:
+        return True
+    return jax.default_backend() == "cpu"
+
+
+def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, exact: bool | None = None):
     """One compiled program: (cat[chunk,C], num[chunk,M], mask[chunk]) ->
     (probs, outlier_flags), fixed-shape per call site (the caller feeds
     equal-sized chunks so a single compile serves the whole sweep).
-    Sharded over 'data' when a mesh is given."""
+    Sharded over 'data' when a mesh is given. ``exact`` controls
+    distilled-student routing (see ``use_distilled_bulk``)."""
     monitor = bundle.monitor
     temperature = bundle.temperature  # calibration (train/calibrate.py):
-    # bulk scores must match what the serving engine would return
+    # bulk scores must match what the serving engine would return; the
+    # distilled student matched the teacher's LOGITS, so the same
+    # temperature applies on either path
 
     if bundle.flavor == "sklearn":
         estimator = bundle.estimator
@@ -90,7 +109,10 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None):
 
         return score_chunk
 
-    model, variables = bundle.model, bundle.variables
+    if use_distilled_bulk(bundle, exact):
+        model, variables = bundle.bulk_model, bundle.bulk_variables
+    else:
+        model, variables = bundle.model, bundle.variables
 
     def fused(variables, cat, num, mask):
         # cat ids travel as int8 (max vocab cardinality is 12; lossless)
@@ -127,8 +149,14 @@ def score_dataset(
     chunk_rows: int = 131_072,
     drift_sample: int = 65_536,
     seed: int = 0,
+    exact: bool | None = None,
 ) -> BulkScoreResult:
-    """Stream ``ds`` through the chunk scorer; aggregate monitors."""
+    """Stream ``ds`` through the chunk scorer; aggregate monitors.
+
+    ``exact=None`` auto-routes through the distilled bulk student on CPU
+    backends when the bundle carries one (``use_distilled_bulk``);
+    ``exact=True`` forces the serving-identical ensemble."""
+    path = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
     n = ds.n
     if n == 0:
         # Same guard as the serving engine: an empty dataset has no drift
@@ -142,7 +170,7 @@ def score_dataset(
         )
     axis = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     chunk = max(axis, (chunk_rows // axis) * axis)
-    scorer = make_chunk_scorer(bundle, mesh)
+    scorer = make_chunk_scorer(bundle, mesh, exact)
 
     predictions = np.empty(n, np.float32)
     outliers = np.empty(n, np.float32)
@@ -219,4 +247,5 @@ def score_dataset(
         ),
         rows=n,
         elapsed_s=elapsed,
+        path=path,
     )

@@ -1,0 +1,148 @@
+"""Ensemble -> single-student distillation for the bulk scoring path.
+
+The flagship serving model is a K-member vmapped deep ensemble
+(`models/ensemble.py`) — the MXU answer to the reference's RandomForest
+(`01-train-model.ipynb:195-227`). On the TPU that costs nearly nothing; on a
+CPU backend the K× FLOPs make BULK scoring lose to the reference's sklearn
+GBM floor (BASELINE.md config 1: ~99k rows/s). Rather than silently serving
+one member (whose predictions differ from the ensemble's), the packaging
+step distills the ensemble's LOGITS into one small MLP and records the
+fidelity it achieved; `parallel/bulk.py` routes bulk sweeps through the
+student on CPU backends (serving always uses the exact ensemble).
+
+Distillation here is plain logit matching (Hinton et al.'s soft-target
+recipe degenerates to this for binary outputs served as probabilities): the
+student minimizes MSE against teacher logits, so the fitted calibration
+temperature (manifest ``calibration``) applies to student outputs unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mlops_tpu.config import ModelConfig
+from mlops_tpu.data.encode import EncodedDataset
+from mlops_tpu.models import build_model, init_params
+from mlops_tpu.train.metrics import binary_metrics
+
+
+@dataclasses.dataclass
+class DistillResult:
+    student_config: ModelConfig
+    student_params: Any
+    fidelity: dict[str, float]  # prob-space agreement + AUC delta on valid
+
+
+def teacher_logits(model, variables, ds: EncodedDataset, chunk: int = 16_384):
+    """Teacher forward over the whole dataset, chunked at a fixed shape so
+    one executable serves every chunk (tail pads)."""
+
+    @jax.jit
+    def fwd(cat, num):
+        return model.apply(variables, cat, num, train=False)
+
+    out = np.empty(ds.n, np.float32)
+    for start in range(0, ds.n, chunk):
+        stop = min(start + chunk, ds.n)
+        cat, num = ds.cat_ids[start:stop], ds.numeric[start:stop]
+        pad = chunk - (stop - start)
+        if pad:
+            cat = np.pad(cat, ((0, pad), (0, 0)))
+            num = np.pad(num, ((0, pad), (0, 0)))
+        out[start:stop] = np.asarray(fwd(cat, num))[: stop - start]
+    return out
+
+
+def distill_for_bulk(
+    teacher_model,
+    teacher_variables,
+    model_config: ModelConfig,
+    train_ds: EncodedDataset,
+    valid_ds: EncodedDataset,
+    hidden_dims: tuple[int, ...] = (64, 64),
+    steps: int = 800,
+    batch_size: int = 2048,
+    learning_rate: float = 3e-3,
+    seed: int = 0,
+) -> DistillResult:
+    """Fit a small-MLP student to the teacher's logits.
+
+    The student keeps the teacher's embed_dim (categorical structure) but
+    shrinks the trunk to ``hidden_dims`` — at the credit-default widths
+    that is ~80× fewer FLOPs/row than the 8-member flagship, which is what
+    buys back the CPU bulk throughput. Returns params + a fidelity record
+    (mean/max |Δprob| vs teacher and AUC delta on the validation split)
+    that the bundle manifest carries so the routing decision is auditable.
+    """
+    student_config = dataclasses.replace(
+        model_config,
+        family="mlp",
+        ensemble_size=1,
+        hidden_dims=tuple(hidden_dims),
+        dropout=0.0,
+    )
+    student = build_model(student_config)
+    t_train = teacher_logits(teacher_model, teacher_variables, train_ds)
+
+    params = init_params(student, jax.random.PRNGKey(seed))["params"]
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+
+    cat = jnp.asarray(train_ds.cat_ids)
+    num = jnp.asarray(train_ds.numeric)
+    target = jnp.asarray(t_train)
+    n = train_ds.n
+
+    # lax.scan keeps the whole fit one compiled program (zero Python in the
+    # loop — the same shape as the HPO inner loop, `train/hpo.py`).
+    def scan_step(carry, i):
+        params, opt_state = carry
+        idx = jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(seed + 1), i),
+            (batch_size,),
+            0,
+            n,
+        )
+
+        def loss_of(p):
+            pred = student.apply({"params": p}, cat[idx], num[idx], train=False)
+            return jnp.mean(jnp.square(pred - target[idx]))
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    @jax.jit
+    def fit(params, opt_state):
+        return jax.lax.scan(scan_step, (params, opt_state), jnp.arange(steps))
+
+    (params, _), _ = fit(params, opt_state)
+
+    # Fidelity on the held-out split: the number that says whether routing
+    # bulk sweeps through the student is safe.
+    t_valid = teacher_logits(teacher_model, teacher_variables, valid_ds)
+    s_valid = teacher_logits(student, {"params": params}, valid_ds)
+    p_t = 1.0 / (1.0 + np.exp(-t_valid))
+    p_s = 1.0 / (1.0 + np.exp(-s_valid))
+    fidelity = {
+        "mean_abs_prob_delta": float(np.mean(np.abs(p_t - p_s))),
+        "max_abs_prob_delta": float(np.max(np.abs(p_t - p_s))),
+    }
+    if valid_ds.labels is not None:
+        lab = jnp.asarray(valid_ds.labels, jnp.float32)
+        auc_t = float(binary_metrics(jnp.asarray(t_valid), lab)["roc_auc"])
+        auc_s = float(binary_metrics(jnp.asarray(s_valid), lab)["roc_auc"])
+        fidelity["teacher_roc_auc"] = auc_t
+        fidelity["student_roc_auc"] = auc_s
+        fidelity["roc_auc_delta"] = auc_s - auc_t
+    return DistillResult(
+        student_config=student_config,
+        student_params=jax.device_get(params),
+        fidelity=fidelity,
+    )

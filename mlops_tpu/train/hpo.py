@@ -11,7 +11,12 @@ weight), so the ENTIRE per-trial training loop is ``vmap``-ed over a stacked
 trial axis and the trial axis is sharded over the mesh's 'data' axis — T
 trials train simultaneously, one compiled program, zero Python in the loop.
 Selection uses the same objective ordering as the reference. Architecture
-sweeps (different shapes) run as an outer Python loop over vmapped groups.
+sweeps (different shapes) run as an outer Python loop over vmapped groups:
+``run_architecture_hpo`` parses ``hpo.architectures`` specs into per-group
+``ModelConfig``s, runs one vmapped sweep per group, and selects across ALL
+trials of ALL groups by the same metric ordering — the structural analogue
+of the reference's ``n_estimators``/``max_depth``/``criterion`` space
+(`01-train-model.ipynb:342-353`).
 """
 
 from __future__ import annotations
@@ -36,10 +41,59 @@ from mlops_tpu.train.metrics import binary_metrics
 @dataclasses.dataclass
 class HPOResult:
     best_index: int
-    best_hyperparams: dict[str, float]
+    best_hyperparams: dict[str, Any]  # floats, plus structural fields
+    # (family/hidden_dims/...) when an architecture sweep selected them
     best_params: Any  # param pytree of the winning trial
     best_metrics: dict[str, float]
     trials: list[dict[str, Any]]  # per-trial {hyperparams, metrics}
+
+
+def parse_architecture_spec(spec: str, base: ModelConfig) -> ModelConfig:
+    """One ``hpo.architectures`` entry -> a ModelConfig.
+
+    Spec grammar: comma-separated ``field=value`` overrides of any
+    ModelConfig field; tuple fields use ``x`` as the element separator
+    (``hidden_dims=64x64``) because ``,`` is the pair separator. Values
+    coerce by the field's current type, same rules as the config loader.
+    """
+    overrides: dict[str, Any] = {}
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        field, sep, raw = pair.partition("=")
+        field = field.strip()
+        if not sep or not hasattr(base, field):
+            raise ValueError(
+                f"bad architecture spec {spec!r}: "
+                f"{pair!r} is not a ModelConfig field=value override"
+            )
+        current = getattr(base, field)
+        if isinstance(current, tuple):
+            inner = type(current[0]) if current else int
+            overrides[field] = tuple(
+                inner(x) for x in raw.strip().split("x") if x.strip()
+            )
+        elif isinstance(current, bool):
+            overrides[field] = raw.strip().lower() in ("1", "true", "yes", "on")
+        elif isinstance(current, int):
+            overrides[field] = int(raw)
+        elif isinstance(current, float):
+            overrides[field] = float(raw)
+        else:
+            overrides[field] = raw.strip()
+    result = dataclasses.replace(base, **overrides)
+    from mlops_tpu.models import FAMILIES
+
+    if result.family not in FAMILIES:
+        # Fail at parse time, not after earlier groups already trained:
+        # the vmapped sweep trains Flax families only (sklearn gbm/rf go
+        # through `train`, same guard as run_tuning).
+        raise ValueError(
+            f"bad architecture spec {spec!r}: family {result.family!r} is "
+            f"not vmappable (Flax families: {FAMILIES})"
+        )
+    return result
 
 
 def sample_hyperparams(config: HPOConfig) -> dict[str, np.ndarray]:
@@ -189,3 +243,76 @@ def run_hpo(
         best_metrics=trials[best]["metrics"],
         trials=trials,
     )
+
+
+def run_architecture_hpo(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    hpo_config: HPOConfig,
+    train_ds: EncodedDataset,
+    valid_ds: EncodedDataset,
+    mesh=None,
+) -> tuple[ModelConfig, HPOResult]:
+    """Structural axis: loop architecture groups, vmap trials within each.
+
+    Each ``hpo.architectures`` spec defines one group (a distinct set of
+    shapes -> its own compile); within a group the continuous space is the
+    usual vmapped sweep, seeded per-group so groups explore different
+    lr/wd/pos_weight draws. The winner is the single best trial across
+    every group, ordered by the SAME objective as the inner sweep (parity:
+    ``mlflow.search_runs(order_by=[objective DESC])`` ranks all child runs
+    of the joint TPE space together, `01-train-model.ipynb` cell 10).
+
+    Returns ``(winning ModelConfig, merged HPOResult)``; the result's
+    ``best_hyperparams`` carries the structural choices (family plus every
+    overridden field) alongside the continuous ones, and each trial record
+    gains ``group``/``architecture`` keys.
+    """
+    if not hpo_config.architectures:
+        return model_config, run_hpo(
+            model_config, train_config, hpo_config, train_ds, valid_ds, mesh=mesh
+        )
+
+    groups: list[tuple[ModelConfig, dict[str, Any]]] = []
+    for spec in hpo_config.architectures:
+        cfg = parse_architecture_spec(spec, model_config)
+        overridden = {
+            f.name: getattr(cfg, f.name)
+            for f in dataclasses.fields(ModelConfig)
+            if getattr(cfg, f.name) != getattr(model_config, f.name)
+        }
+        structural = {"family": cfg.family, **overridden}
+        groups.append((cfg, structural))
+
+    results: list[HPOResult] = []
+    merged_trials: list[dict[str, Any]] = []
+    for g, (cfg, structural) in enumerate(groups):
+        group_hpo = dataclasses.replace(hpo_config, seed=hpo_config.seed + g)
+        res = run_hpo(cfg, train_config, group_hpo, train_ds, valid_ds, mesh=mesh)
+        results.append(res)
+        for trial in res.trials:
+            merged_trials.append(
+                {"group": g, "architecture": structural, **trial}
+            )
+
+    def objective_of(res: HPOResult) -> float:
+        v = res.best_metrics[f"validation_{hpo_config.objective}_score"]
+        return v if np.isfinite(v) else -np.inf
+
+    best_group = int(np.argmax([objective_of(r) for r in results]))
+    winner = results[best_group]
+    win_cfg, win_structural = groups[best_group]
+    offset = sum(len(r.trials) for r in results[:best_group])
+    # Tuples stringify for the report the same way the spec wrote them.
+    surfaced = {
+        k: ("x".join(map(str, v)) if isinstance(v, tuple) else v)
+        for k, v in win_structural.items()
+    }
+    merged = HPOResult(
+        best_index=offset + winner.best_index,
+        best_hyperparams={**surfaced, **winner.best_hyperparams},
+        best_params=winner.best_params,
+        best_metrics=winner.best_metrics,
+        trials=merged_trials,
+    )
+    return win_cfg, merged

@@ -1,0 +1,146 @@
+"""Long-context training: document BERT over a ('data','seq') mesh.
+
+SURVEY §5.7's long-context obligation, made load-bearing: the ring-attention
+library (`parallel/ring_attention.py`) stops being demo-grade here — a real
+training configuration (``model.family=bert model.doc_records=R
+model.seq_parallel=true``) reads R consecutive records as one ~500-token
+document and trains `models.bert.BertDocEncoder` with its attention running
+as the ppermute ring over the mesh's 'seq' axis while the batch shards over
+'data' (combined DP × SP). The same builder with ``seq_parallel=false``
+produces the dense single-chip model, which is also the tests' equivalence
+reference: ring and dense training steps must match to numerical tolerance.
+
+The reference has no sequence workloads (23 fixed tabular features), so
+there is no reference analogue to cite — this is a capability the TPU
+rebuild adds (BASELINE config 5's stretch direction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mlops_tpu.config import ModelConfig, TrainConfig
+from mlops_tpu.data.encode import EncodedDataset
+from mlops_tpu.models.bert import BertDocEncoder
+from mlops_tpu.parallel.ring_attention import make_ring_attention
+from mlops_tpu.schema.features import SCHEMA
+from mlops_tpu.train.loop import sigmoid_bce
+
+
+def make_documents(
+    ds: EncodedDataset, doc_records: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Group consecutive rows into histories: ``[N,C]`` -> ``[D,R,C]``.
+
+    The label of a document is its LAST record's label (predict the next
+    default from the history). Rows past the last full document drop.
+    """
+    if ds.labels is None:
+        raise ValueError("document training needs labels")
+    docs = ds.n // doc_records
+    take = docs * doc_records
+    cat = ds.cat_ids[:take].reshape(docs, doc_records, -1)
+    num = ds.numeric[:take].reshape(docs, doc_records, -1)
+    labels = ds.labels[:take].reshape(docs, doc_records)[:, -1]
+    return cat, num, labels.astype(np.float32)
+
+
+def build_doc_model(
+    config: ModelConfig, mesh: Mesh | None = None
+) -> BertDocEncoder:
+    """BertDocEncoder per config; ``seq_parallel=true`` + a mesh with a
+    'seq' axis injects the ring; otherwise attention is the dense kernel
+    dispatcher (the single-chip / equivalence-reference path)."""
+    attend_fn: Callable | None = None
+    if config.seq_parallel:
+        if mesh is None or "seq" not in mesh.axis_names:
+            raise ValueError(
+                "model.seq_parallel=true needs a mesh with a 'seq' axis "
+                "(parallel.make_nd_mesh({'data': d, 'seq': s}))"
+            )
+        batch_axis = "data" if "data" in mesh.axis_names else None
+        attend_fn = make_ring_attention(mesh, "seq", batch_axis=batch_axis)
+    dtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[config.precision]
+    return BertDocEncoder(
+        cards=SCHEMA.cards,
+        num_numeric=SCHEMA.num_numeric,
+        doc_records=config.doc_records,
+        hidden=config.token_dim,
+        depth=config.depth,
+        heads=config.heads,
+        dropout=0.0,  # ring attention never materializes scores (see
+        # models/layers.py); embedding/FFN dropout would be fine but is
+        # kept off so dense and ring paths stay bit-comparable
+        dtype=dtype,
+        attend_fn=attend_fn,
+    )
+
+
+@dataclasses.dataclass
+class DocTrainStep:
+    model: BertDocEncoder
+    step_fn: Callable  # (params, opt_state, cat, num, lab) -> (params, opt_state, loss)
+    params: Any
+    opt_state: Any
+
+
+def make_doc_train_step(
+    model_config: ModelConfig,
+    train_config: TrainConfig,
+    mesh: Mesh | None = None,
+    seed: int = 0,
+) -> DocTrainStep:
+    """One jitted DP×SP train step over documents.
+
+    With a mesh: batch shards over 'data', the R record axis (= sequence)
+    over 'seq'; params replicate; XLA psums gradients over both axes while
+    the attention inner loop rides the explicit ppermute ring. Without a
+    mesh: the same step, dense, single device.
+    """
+    model = build_doc_model(model_config, mesh)
+    r = model_config.doc_records
+    dummy_cat = jnp.zeros((2, r, SCHEMA.num_categorical), jnp.int32)
+    dummy_num = jnp.zeros((2, r, SCHEMA.num_numeric), jnp.float32)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        dummy_cat, dummy_num, train=False)["params"]
+    optimizer = optax.adamw(
+        train_config.learning_rate, weight_decay=train_config.weight_decay
+    )
+    opt_state = optimizer.init(params)
+
+    def step(params, opt_state, cat, num, lab):
+        def loss_of(p):
+            logits = model.apply({"params": p}, cat, num, train=True)
+            return sigmoid_bce(logits, lab, train_config.pos_weight)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    if mesh is None:
+        step_fn = jax.jit(step, donate_argnums=(0, 1))
+    else:
+        batch = "data" if "data" in mesh.axis_names else None
+        # Inputs shard over 'data' only: the R record axis (11 for a
+        # 508-token doc) rarely divides the seq axis — XLA reshards the
+        # token activations onto the ring's ('seq'-sharded) layout at the
+        # shard_map boundary, after tokenize+embed.
+        doc_in = NamedSharding(mesh, P(batch, None, None))
+        lab_in = NamedSharding(mesh, P(batch))
+        rep = NamedSharding(mesh, P())
+        step_fn = jax.jit(
+            step,
+            in_shardings=(rep, rep, doc_in, doc_in, lab_in),
+            out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1),
+        )
+    return DocTrainStep(
+        model=model, step_fn=step_fn, params=params, opt_state=opt_state
+    )

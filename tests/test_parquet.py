@@ -1,0 +1,219 @@
+"""Parquet ingest (data/parquet.py): CSV parity on the same rows, streamed
+chunking with exact chunk shapes, format dispatch, and the degraded-value
+contract (null categorical -> OOV, null numeric -> NaN, strict labels).
+
+The reference's estate would get this from Spark reading Parquet through the
+same external-table interface (`00-create-external-table.ipynb:92-95`); here
+the contract is pinned by tests instead.
+"""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from mlops_tpu.data import (  # noqa: E402
+    generate_synthetic,
+    iter_table_chunks,
+    load_csv_columns,
+    load_table_columns,
+    write_csv_columns,
+)
+from mlops_tpu.data.parquet import (  # noqa: E402
+    is_parquet,
+    iter_parquet_chunks,
+    load_parquet_columns,
+    write_parquet_columns,
+)
+from mlops_tpu.schema import SCHEMA
+
+
+@pytest.fixture(scope="module")
+def twin_files(tmp_path_factory):
+    """The same 6k rows written as CSV and as Parquet."""
+    root = tmp_path_factory.mktemp("parquet")
+    columns, labels = generate_synthetic(6_000, seed=23)
+    csv_path = root / "data.csv"
+    pq_path = root / "data.parquet"
+    write_csv_columns(csv_path, columns, labels)
+    write_parquet_columns(pq_path, columns, labels)
+    return csv_path, pq_path
+
+
+def _assert_columns_equal(got_cols, want_cols):
+    for feat in SCHEMA.categorical:
+        assert got_cols[feat.name] == want_cols[feat.name], feat.name
+    for feat in SCHEMA.numeric:
+        np.testing.assert_allclose(
+            got_cols[feat.name], want_cols[feat.name], rtol=1e-12, err_msg=feat.name
+        )
+
+
+def test_parquet_matches_csv_batch_read(twin_files):
+    csv_path, pq_path = twin_files
+    csv_cols, csv_labels = load_csv_columns(csv_path, require_target=True)
+    pq_cols, pq_labels = load_parquet_columns(pq_path, require_target=True)
+    _assert_columns_equal(pq_cols, csv_cols)
+    np.testing.assert_array_equal(pq_labels, csv_labels)
+
+
+def test_dispatch_routes_on_extension(twin_files):
+    csv_path, pq_path = twin_files
+    assert is_parquet(pq_path) and is_parquet("gs://bucket/x.PQ")
+    assert not is_parquet(csv_path)
+    via_csv, _ = load_table_columns(csv_path)
+    via_pq, _ = load_table_columns(pq_path)
+    _assert_columns_equal(via_pq, via_csv)
+
+
+def test_chunks_exact_shape_and_reassemble(twin_files):
+    """Chunks must be EXACTLY chunk_rows (except the tail) even when Arrow
+    record batches fragment at row-group boundaries, and must reassemble to
+    the batch read."""
+    _, pq_path = twin_files
+    batch_cols, batch_labels = load_parquet_columns(pq_path, require_target=True)
+    sizes, seen_labels = [], []
+    seen = {name: [] for name in SCHEMA.feature_names}
+    for columns, labels in iter_parquet_chunks(
+        pq_path, chunk_rows=1700, require_target=True
+    ):
+        sizes.append(len(labels))
+        seen_labels.append(labels)
+        for name in SCHEMA.feature_names:
+            seen[name].extend(columns[name])
+    assert sizes[:-1] == [1700] * (len(sizes) - 1) and 0 < sizes[-1] <= 1700
+    np.testing.assert_array_equal(np.concatenate(seen_labels), batch_labels)
+    _assert_columns_equal(seen, batch_cols)
+
+
+def test_chunks_rebuffer_across_row_groups(tmp_path):
+    """Tiny row groups (97 rows) still yield exact 500-row chunks."""
+    columns, labels = generate_synthetic(1_013, seed=5)
+    path = tmp_path / "rg.parquet"
+    write_parquet_columns(path, columns, labels)
+    table = pq.read_table(path)
+    pq.write_table(table, path, row_group_size=97)
+    sizes = [
+        len(c[SCHEMA.categorical[0].name])
+        for c, _ in iter_parquet_chunks(path, chunk_rows=500)
+    ]
+    assert sizes == [500, 500, 13]
+
+
+def test_streamed_fit_and_validate_accept_parquet(twin_files):
+    from mlops_tpu.data import fit_streaming
+
+    csv_path, pq_path = twin_files
+    pre_csv = fit_streaming(csv_path, chunk_rows=1234)
+    pre_pq = fit_streaming(pq_path, chunk_rows=1234)
+    np.testing.assert_allclose(
+        pre_pq.numeric_median, pre_csv.numeric_median, rtol=1e-6
+    )
+    np.testing.assert_allclose(pre_pq.numeric_mean, pre_csv.numeric_mean, rtol=1e-6)
+    np.testing.assert_allclose(pre_pq.numeric_std, pre_csv.numeric_std, rtol=1e-6)
+
+
+def test_null_handling_matches_degraded_contract(tmp_path):
+    """Null categorical -> "" -> OOV; null numeric -> NaN -> imputable;
+    both via the same contract the CSV reader pins for empty cells."""
+    columns, labels = generate_synthetic(50, seed=1)
+    cat = SCHEMA.categorical[0].name
+    num = SCHEMA.numeric[0].name
+    arrays, names = [], []
+    for feat in SCHEMA.categorical:
+        vals = [str(v) for v in columns[feat.name]]
+        arr = pa.array(
+            [None if (feat.name == cat and i == 3) else v for i, v in enumerate(vals)],
+            pa.string(),
+        )
+        arrays.append(arr)
+        names.append(feat.name)
+    for feat in SCHEMA.numeric:
+        vals = list(columns[feat.name])
+        arr = pa.array(
+            [None if (feat.name == num and i == 7) else v for i, v in enumerate(vals)],
+            pa.float64(),
+        )
+        arrays.append(arr)
+        names.append(feat.name)
+    path = tmp_path / "nulls.parquet"
+    pq.write_table(pa.Table.from_arrays(arrays, names=names), path)
+
+    cols, got_labels = load_parquet_columns(path)
+    assert got_labels is None  # no target column at all
+    assert cols[cat][3] == ""
+    assert np.isnan(cols[num][7])
+    assert np.isfinite(np.asarray(cols[num])[:7]).all()
+
+
+def test_strict_labels_fail_fast_with_row_number(tmp_path):
+    columns, labels = generate_synthetic(40, seed=2)
+    path = tmp_path / "bad.parquet"
+    write_parquet_columns(path, columns, labels)
+    table = pq.read_table(path)
+    target = table.column(SCHEMA.target).to_pylist()
+    target[17] = None
+    table = table.set_column(
+        table.schema.get_field_index(SCHEMA.target),
+        SCHEMA.target,
+        pa.array(target, pa.int8()),
+    )
+    pq.write_table(table, path)
+    with pytest.raises(ValueError, match="data row 17"):
+        load_parquet_columns(path, require_target=True)
+    # Permissive read: one bad value unlabels the file (CSV contract).
+    _, got = load_parquet_columns(path)
+    assert got is None
+    # Streamed strict read raises too (at the chunk containing row 17).
+    with pytest.raises(ValueError, match=SCHEMA.target):
+        list(iter_parquet_chunks(path, chunk_rows=10, require_target=True))
+
+
+def test_missing_columns_error_parity(tmp_path):
+    columns, _ = generate_synthetic(10, seed=3)
+    drop = SCHEMA.numeric[2].name
+    arrays, names = [], []
+    for feat in SCHEMA.categorical:
+        arrays.append(pa.array([str(v) for v in columns[feat.name]], pa.string()))
+        names.append(feat.name)
+    for feat in SCHEMA.numeric:
+        if feat.name == drop:
+            continue
+        arrays.append(pa.array(columns[feat.name], pa.float64()))
+        names.append(feat.name)
+    path = tmp_path / "short.parquet"
+    pq.write_table(pa.Table.from_arrays(arrays, names=names), path)
+    with pytest.raises(ValueError, match="missing required columns"):
+        load_parquet_columns(path)
+    with pytest.raises(ValueError, match="missing required columns"):
+        list(iter_parquet_chunks(path))
+
+
+def test_train_pipeline_accepts_parquet(twin_files, tmp_path):
+    """End-to-end: data.train_path=*.parquet flows through load_training_data."""
+    from mlops_tpu.config import Config
+    from mlops_tpu.train.pipeline import load_training_data
+
+    _, pq_path = twin_files
+    config = Config()
+    config.data.train_path = str(pq_path)
+    columns, labels = load_training_data(config)
+    assert len(labels) == 6_000
+    assert set(SCHEMA.feature_names) <= set(columns)
+
+
+def test_score_stream_parquet_matches_csv(twin_files, tiny_pipeline):
+    """Stream scoring a Parquet file produces the same aggregates as the
+    CSV twin."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.data import score_csv_stream
+
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+    csv_path, pq_path = twin_files
+    a = score_csv_stream(bundle, csv_path, None, chunk_rows=2048)
+    b = score_csv_stream(bundle, pq_path, None, chunk_rows=2048)
+    assert a["rows"] == b["rows"] == 6_000
+    np.testing.assert_allclose(a["mean_prediction"], b["mean_prediction"], rtol=1e-5)
+    np.testing.assert_allclose(a["outlier_rate"], b["outlier_rate"], rtol=1e-6)
