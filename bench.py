@@ -294,17 +294,21 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     model, variables = bundle.model, bundle.variables
     rng = np.random.default_rng(1)
 
-    # --- bulk inference: FLOPs of the b16384 forward × measured calls/s.
+    # --- bulk inference: FLOPs of the SAME fused program the bulk stage
+    # timed (classifier + drift + outlier, ops/predict.py) × measured
+    # calls/s — numerator and denominator must describe one program.
+    from mlops_tpu.ops.predict import make_padded_predict_fn
+
     n = 16_384
     cat = jnp.asarray(
         rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
     )
     num = jnp.asarray(rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32))
-
-    def fwd(cat, num):
-        return model.apply(variables, cat, num, train=False)
-
-    f_bulk = compiled_flops(fwd, cat, num)
+    mask = jnp.ones((n,), bool)
+    fused = make_padded_predict_fn(
+        model, variables, bundle.monitor, bundle.temperature
+    )
+    f_bulk = compiled_flops(fused, cat, num, mask)
     rows_per_s = bulk.get("bulk_rows_per_s_b16384", 0.0)
     if f_bulk:
         out["bulk_gflops_per_s"] = round(f_bulk * rows_per_s / n / 1e9, 1)

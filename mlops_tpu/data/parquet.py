@@ -96,9 +96,7 @@ def _num_cells(array) -> list[float]:
     return casted.to_numpy(zero_copy_only=False).tolist()
 
 
-def _columns_from_table(
-    table, schema: FeatureSchema
-) -> tuple[dict[str, list], np.ndarray]:
+def _columns_from_table(table, schema: FeatureSchema) -> dict[str, list]:
     columns: dict[str, list] = {}
     for feat in schema.categorical:
         columns[feat.name] = _cat_cells(table.column(feat.name))

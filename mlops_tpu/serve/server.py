@@ -92,6 +92,12 @@ class HttpServer:
         self._connections.add(writer)
         try:
             while True:
+                # Line-by-line head read. This is NOT an event-loop cost:
+                # readline() on already-buffered bytes returns without
+                # suspending, so a whole head arriving in one TCP segment
+                # (the normal case) costs one suspension total. It also
+                # keeps the old tolerance for bare-LF request heads, which
+                # a single readuntil(b"\r\n\r\n") would hang on.
                 request_line = await reader.readline()
                 if not request_line:
                     break

@@ -230,6 +230,12 @@ def fit(
     init_rng, loop_rng = jax.random.split(rng)
     variables = init_variables or init_params(model, init_rng)
     params = variables["params"]
+    if init_variables is not None:
+        # Donation safety: run_window donates the TrainState, deleting its
+        # input buffers in place. Caller-provided init arrays (a pretrained
+        # trunk fine-tuned several times, ablation loops) must not be
+        # consumed — copy them into fresh buffers the donation may eat.
+        params = jax.tree_util.tree_map(jnp.array, params)
     optimizer = make_optimizer(config)
     state = TrainState(
         params=params,

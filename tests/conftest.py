@@ -28,6 +28,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles many identical
+# programs across modules (engine warmups, train steps at shared shapes);
+# caching them cuts suite wall time substantially both within a run and
+# across CI runs (ci.yml caches the directory). Override the location with
+# MLOPS_TPU_TEST_CACHE; it is never checked in (.gitignore).
+_cache_dir = os.environ.get(
+    "MLOPS_TPU_TEST_CACHE", str(Path(__file__).parent / ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import numpy as np
 import pytest
 
@@ -64,6 +75,21 @@ def tiny_pipeline(tmp_path_factory):
     config.registry.run_root = str(root / "runs")
     result = run_training(config)
     return config, result
+
+
+@pytest.fixture(scope="session")
+def warm_engine(tiny_pipeline):
+    """ONE fully-warmed serving engine shared by the serve/batcher modules
+    (each warmup compiles 4 bucket + 6 group shapes — two identical
+    engines cost ~90 s of duplicate compiles on the CI box). Tests must
+    not mutate it; anything needing special buckets builds its own."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    _, result = tiny_pipeline
+    engine = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8, 64))
+    engine.warmup()
+    return engine
 
 
 @pytest.fixture(scope="session")

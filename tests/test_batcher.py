@@ -12,11 +12,8 @@ from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, InferenceEngine
 
 
 @pytest.fixture(scope="module")
-def engine(tiny_pipeline):
-    _, result = tiny_pipeline
-    eng = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8, 64))
-    eng.warmup()
-    return eng
+def engine(warm_engine):
+    return warm_engine  # session-shared warmed engine (conftest)
 
 
 def _requests(sample_request, k):

@@ -52,7 +52,9 @@ def test_bulk_matches_serving_engine(flax_bundle, score_ds):
     from mlops_tpu.serve import InferenceEngine
 
     take = 256
-    engine = InferenceEngine(flax_bundle, buckets=(take,))
+    engine = InferenceEngine(
+        flax_bundle, buckets=(take,), enable_grouping=False
+    )
     served = engine.predict_arrays(
         score_ds.cat_ids[:take], score_ds.numeric[:take]
     )

@@ -156,7 +156,7 @@ def test_ensemble_bundle_round_trip_through_engine(tmp_path):
 
     bundle = load_bundle(result.bundle_dir)
     assert bundle.manifest["model_config"]["ensemble_size"] == 2
-    engine = InferenceEngine(bundle, buckets=(1, 8))
+    engine = InferenceEngine(bundle, buckets=(1, 8), enable_grouping=False)
     engine.warmup()
     out = engine.predict_records([LoanApplicant().model_dump()])
     assert len(out["predictions"]) == 1

@@ -102,7 +102,9 @@ def test_serving_engine_never_uses_student(ensemble_bundle):
 
     columns, _ = generate_synthetic(64, seed=44)
     ds = ensemble_bundle.preprocessor.encode(columns)
-    engine = InferenceEngine(ensemble_bundle, buckets=(64,))
+    engine = InferenceEngine(
+        ensemble_bundle, buckets=(64,), enable_grouping=False
+    )
     served = engine.predict_arrays(ds.cat_ids, ds.numeric)
     exact = score_dataset(ensemble_bundle, ds, chunk_rows=64, exact=True)
     np.testing.assert_allclose(

@@ -50,7 +50,9 @@ def test_gbm_served_response_contract(gbm_pipeline, sample_request):
     the reference's response schema (`app/model.py:64-70`) — interchangeable
     with the TPU bundles at the serving boundary."""
     _, result = gbm_pipeline
-    engine = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8))
+    engine = InferenceEngine(
+        load_bundle(result.bundle_dir), buckets=(1, 8), enable_grouping=False
+    )
     engine.warmup()
     out = engine.predict_records(sample_request)
     assert set(out) == {"predictions", "outliers", "feature_drift_batch"}

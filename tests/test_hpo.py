@@ -88,7 +88,9 @@ def test_run_tuning_packages_best(tmp_path):
     from mlops_tpu.bundle import load_bundle
     from mlops_tpu.serve.engine import InferenceEngine
 
-    engine = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1,))
+    engine = InferenceEngine(
+        load_bundle(result.bundle_dir), buckets=(1,), enable_grouping=False
+    )
     out = engine.predict_records([{}])
     assert 0.0 <= out["predictions"][0] <= 1.0
 
@@ -251,6 +253,6 @@ def test_run_tuning_packages_architecture_winner(tmp_path):
     bundle = load_bundle(result.bundle_dir)
     want = (16,) if hpo_result.best_hyperparams["hidden_dims"] == "16" else (24,)
     assert tuple(bundle.model_config.hidden_dims) == want
-    engine = InferenceEngine(bundle, buckets=(1,))
+    engine = InferenceEngine(bundle, buckets=(1,), enable_grouping=False)
     out = engine.predict_records([{}])
     assert 0.0 <= out["predictions"][0] <= 1.0

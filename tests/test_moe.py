@@ -160,7 +160,7 @@ def test_moe_trains_end_to_end_and_serves(tmp_path):
     result = run_training(config, register=False)
     assert np.isfinite(result.train_result.metrics["validation_roc_auc_score"])
     bundle = load_bundle(result.bundle_dir)
-    engine = InferenceEngine(bundle, buckets=(1, 8))
+    engine = InferenceEngine(bundle, buckets=(1, 8), enable_grouping=False)
     engine.warmup()
     out = engine.predict_records([LoanApplicant().model_dump()])
     assert 0.0 <= out["predictions"][0] <= 1.0

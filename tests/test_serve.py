@@ -18,12 +18,8 @@ from mlops_tpu.serve import HttpServer, InferenceEngine
 
 
 @pytest.fixture(scope="module")
-def engine(tiny_pipeline):
-    _, result = tiny_pipeline
-    bundle = load_bundle(result.bundle_dir)
-    engine = InferenceEngine(bundle, buckets=(1, 8, 64))
-    engine.warmup()
-    return engine
+def engine(warm_engine):
+    return warm_engine  # session-shared warmed engine (conftest)
 
 
 # ------------------------------------------------------------------ engine

@@ -334,3 +334,26 @@ def test_keep_best_survives_checkpoint_resume(tmp_path):
     assert resumed.metrics["validation_roc_auc_score"] == max(all_aucs)
     assert resumed.packaged_step <= 400
     assert resumed.steps == 400
+
+
+def test_fit_does_not_consume_caller_init_variables(encoded_small):
+    """Donation regression: run_window donates the TrainState, which used
+    to DELETE caller-owned init buffers — a pretrained trunk reused for a
+    second fine-tune run crashed with 'Array has been deleted'. fit must
+    copy caller-provided init params into its own buffers."""
+    import jax
+
+    from mlops_tpu.models import build_model, init_params
+
+    _, ds = encoded_small
+    config = ModelConfig(family="mlp", hidden_dims=(16,), embed_dim=4)
+    model = build_model(config)
+    shared = init_params(model, jax.random.PRNGKey(0))
+    # Same shared variables through two consecutive fits.
+    tconfig = TrainConfig(steps=4, eval_every=4, batch_size=64)
+    fit(model, ds, ds, tconfig, init_variables=shared)
+    result = fit(model, ds, ds, tconfig, init_variables=shared)  # crashed
+    assert np.isfinite(result.metrics["validation_roc_auc_score"])
+    # The shared tree itself is still alive and usable.
+    for leaf in jax.tree_util.tree_leaves(shared):
+        np.asarray(leaf)
