@@ -23,7 +23,8 @@ Prints ONE JSON line no matter what:
 ``vs_baseline`` = (5 ms target) / (measured p50) — >1.0 beats the target.
 A crash prints the same shape with an ``"error"`` field (exit code 1).
 
-Env knobs: ``BENCH_MODEL`` (mlp|gbm, default mlp), ``BENCH_ENSEMBLE``
+Env knobs: ``BENCH_MODEL`` (any model family — mlp, gbm/rf,
+ft_transformer, moe, linear; default mlp), ``BENCH_ENSEMBLE``
 (deep-ensemble members for the mlp flagship, default 8; 1 = single
 model), ``BENCH_TPU_TIMEOUT_S`` (per-attempt TPU health-probe watchdog,
 default 150) with ``BENCH_TPU_RETRIES``/``BENCH_TPU_BACKOFF_S`` retry
@@ -270,10 +271,13 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     XLA-counted FLOPs per call ÷ measured wall ÷ chip peak, for the three
     hot paths — bulk inference (using the throughput the bulk stage just
     measured), one fused train step at the training batch size, and the
-    flash-attention kernel at its tuned shape. ``mfu_*`` is None when the
-    device kind has no known peak (plain CPU) unless
-    ``MLOPS_TPU_PEAK_FLOPS`` supplies one; ``*_gflops_per_s`` is always
-    reported so the achieved-FLOPs floor is auditable either way."""
+    flash-attention kernel at its tuned shape. The peak denominator is
+    the device's published spec when known, the user's
+    ``MLOPS_TPU_PEAK_FLOPS`` when set (``peak_source: "env"``), or — on
+    a plain CPU — the host's MEASURED dense-GEMM rate
+    (``peak_source: "measured-gemm"``); only an unknown non-CPU device
+    leaves ``mfu_*`` None. ``*_gflops_per_s`` is always reported so the
+    achieved-FLOPs floor is auditable regardless."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -282,14 +286,27 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     from mlops_tpu.utils.flops import (
         compile_with_flops,
         compiled_flops,
+        measured_gemm_peak,
         mfu,
         peak_flops,
     )
 
-    peak = peak_flops(device)
-    out: dict = {"peak_flops": peak}
     if bundle.flavor == "sklearn":
         return {}
+    peak = peak_flops(device)
+    if os.environ.get("MLOPS_TPU_PEAK_FLOPS"):
+        peak_source = "env"
+    elif peak is not None:
+        peak_source = "spec"
+    elif getattr(device, "platform", "") == "cpu":
+        # No published peak for arbitrary host silicon: measure the
+        # backend's own dense-GEMM rate and report MFU against that —
+        # "fraction of this host's measured matmul peak".
+        peak = measured_gemm_peak()
+        peak_source = "measured-gemm"
+    else:
+        peak_source = "unknown"
+    out: dict = {"peak_flops": peak, "peak_source": peak_source}
 
     model, variables = bundle.model, bundle.variables
     rng = np.random.default_rng(1)
@@ -483,6 +500,22 @@ def _http_stage(engine, record) -> dict:
     return asyncio.run(run())
 
 
+def _prune_bench_runs(run_root: str, keep: int) -> None:
+    """Every invocation leaves one runs/bench/<name> dir; keep the newest
+    ``keep`` so repeated benches don't grow the workspace forever."""
+    import shutil
+
+    try:
+        dirs = sorted(
+            (d for d in os.listdir(run_root) if d.startswith("bench-")),
+            reverse=True,
+        )
+        for stale in dirs[keep:]:
+            shutil.rmtree(os.path.join(run_root, stale), ignore_errors=True)
+    except OSError:
+        pass
+
+
 def _error_line(message: str) -> str:
     """The one-JSON-line contract's failure shape — single definition for
     the crash handler and the wall watchdog."""
@@ -573,7 +606,17 @@ def main() -> None:
     )
     config.registry.run_root = "runs/bench"
     t_train = time.perf_counter()
-    result = run_training(config, register=False, run_name="bench")
+    # Fresh run dir per invocation (ns + pid so concurrent same-second
+    # benches can't share): a reused dir either resumes from its own
+    # checkpoints (train_wall_s would measure a restore, not training)
+    # or — across families — warns about a mismatched param tree before
+    # retraining. Old bench run dirs are pruned to the newest few.
+    _prune_bench_runs(config.registry.run_root, keep=5)
+    result = run_training(
+        config,
+        register=False,
+        run_name=f"bench-{family}-{time.time_ns()}-{os.getpid()}",
+    )
     train_wall_s = time.perf_counter() - t_train
     bundle = load_bundle(result.bundle_dir)
 

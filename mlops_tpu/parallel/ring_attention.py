@@ -107,6 +107,7 @@ def make_ring_attention(
     seq_axis: str = "seq",
     batch_axis: str | None = None,
     scale: float | None = None,
+    head_axis: str | None = None,
 ) -> Callable:
     """Host-level ring attention over global ``[B, S, H, D]`` arrays.
 
@@ -115,9 +116,18 @@ def make_ring_attention(
     each data-parallel ring runs independently). S must divide evenly by
     the seq axis size — pad upstream; for BERT-style fixed-length inputs
     even division is the normal case.
+
+    ``head_axis`` additionally shards the HEAD dimension (Megatron-style
+    tensor parallelism composed with the ring — a 3-way DP×SP×TP layout on
+    a ``{'data','seq','model'}`` mesh): heads are independent in
+    attention, so each (seq, head) shard runs its own online-softmax fold
+    and the K/V ring hops stay strictly within the 'seq' axis — no
+    cross-head communication is added. Without it, head-sharded
+    activations entering the ring would be all-gathered at the shard_map
+    boundary, serializing TP through SP.
     """
     n = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
 
     body = partial(
         ring_attention_shard, axis_name=seq_axis, axis_size=n, scale=scale

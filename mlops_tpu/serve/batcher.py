@@ -57,6 +57,12 @@ class MicroBatcher:
         self._full = asyncio.Event()  # set when a full group is waiting
         self._inflight = asyncio.Semaphore(max_inflight)
         self._dispatch_tasks: set[asyncio.Task] = set()  # strong refs
+        self._last_enqueue = float("-inf")  # loop-clock time of the most
+        # recent coalescable arrival (idle fast-path bookkeeping)
+        self._solo_inflight = 0  # fast-path calls currently in the
+        # executor: they must count against the idle condition, or a
+        # stalled engine would accumulate unbounded un-cancellable
+        # executor work outside the batcher's claim-time purge
 
     @property
     def enabled(self) -> bool:
@@ -72,6 +78,32 @@ class MicroBatcher:
             return await loop.run_in_executor(
                 self._executor, self.engine.predict_records, records
             )
+
+        # Idle fast-path: a request arriving with nothing queued, nothing
+        # in flight (grouped OR solo), and no arrival within the last
+        # window has no co-travelers to wait for — holding it the full
+        # window would buy zero coalescing and cost the whole window in
+        # p50 (measured: the 1 ms default tripled sequential-client
+        # latency). Sustained load arrives within the window of the
+        # previous request and still coalesces; a stalled solo call
+        # (counter > 0) pushes new arrivals back onto the batcher, whose
+        # claim-time purge and max_inflight bound the backlog.
+        now = loop.time()
+        idle = (
+            not self._pending
+            and not self._dispatch_tasks
+            and self._solo_inflight == 0
+            and (now - self._last_enqueue) > self.window_s
+        )
+        self._last_enqueue = now
+        if idle:
+            self._solo_inflight += 1
+            try:
+                return await loop.run_in_executor(
+                    self._executor, self.engine.predict_records, records
+                )
+            finally:
+                self._solo_inflight -= 1
 
         future: asyncio.Future = loop.create_future()
         self._pending.append((records, future))

@@ -79,6 +79,28 @@ def compiled_flops(fn, *args) -> float | None:
     return compile_with_flops(fn, *args)[1]
 
 
+def measured_gemm_peak(n: int = 1024, reps: int = 5) -> float:
+    """Empirical dense-matmul peak of the CURRENT backend (FLOP/s): best
+    of ``reps`` timed ``n×n @ n×n`` f32 matmuls. The honest denominator
+    for CPU fallback benches, where no published peak exists — reported
+    MFU then reads "fraction of this host's measured GEMM rate", which is
+    the comparable quantity to a TPU's spec-sheet peak."""
+    import time
+
+    import jax.numpy as jnp
+
+    a = jnp.ones((n, n), jnp.float32)
+    b = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a, b: a @ b)
+    jax.block_until_ready(f(a, b))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a, b))
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n**3 / best
+
+
 def mfu(flops_per_call: float | None, calls_per_s: float, peak: float | None):
     """Fraction of peak, rounded for the bench JSON; None when either side
     is unknown."""

@@ -169,3 +169,74 @@ def test_abandoned_requests_are_purged_at_claim_time(engine, sample_request):
         executor.shutdown(wait=False)
 
     asyncio.run(run())
+
+
+def test_idle_fast_path_skips_window(engine):
+    """A lone request on an idle batcher must not pay the coalescing
+    window: it runs solo immediately (measured: the 1 ms default window
+    tripled sequential-client latency for zero coalescing)."""
+    import concurrent.futures
+    import time
+
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+    batcher = MicroBatcher(engine, executor, window_ms=200.0)  # huge window
+    rec = {"age": 30.0}
+
+    async def drive():
+        t0 = time.perf_counter()
+        out = await batcher.predict([rec])
+        return out, time.perf_counter() - t0
+
+    out, dt = asyncio.run(drive())
+    assert 0.0 <= out["predictions"][0] <= 1.0
+    # Far below the 200 ms window: the idle fast-path skipped it.
+    assert dt < 0.15, f"idle request waited {dt*1e3:.0f} ms"
+    # And the batcher queue stayed untouched.
+    assert not batcher._pending and not batcher._dispatch_tasks
+
+
+def test_stalled_solo_pushes_arrivals_back_to_batcher():
+    """A hung fast-path call must not let later arrivals bypass the
+    batcher's backpressure: while a solo dispatch is in flight, new
+    requests enqueue (where the claim-time purge and max_inflight bound
+    the backlog) instead of piling un-cancellable work into the executor."""
+    import concurrent.futures
+    import threading
+
+    class StallingEngine:
+        supports_grouping = True
+
+        def __init__(self):
+            self.release = threading.Event()
+            self.solo_calls = 0
+
+        def _respond(self):
+            self.release.wait(timeout=10)
+            return {"predictions": [0.5], "outliers": [0.0],
+                    "feature_drift_batch": {}}
+
+        def predict_records(self, records):
+            self.solo_calls += 1  # fast-path entry point only
+            return self._respond()
+
+        def predict_group(self, requests):
+            return [self._respond() for _ in requests]
+
+    eng = StallingEngine()
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    batcher = MicroBatcher(eng, executor, window_ms=1.0)
+
+    async def drive():
+        first = asyncio.create_task(batcher.predict([{"age": 1.0}]))
+        await asyncio.sleep(0.05)  # > window: first went solo and stalled
+        assert batcher._solo_inflight == 1
+        second = asyncio.create_task(batcher.predict([{"age": 2.0}]))
+        await asyncio.sleep(0.05)
+        # Second arrival did NOT take the fast path: it either sits in
+        # _pending or rides a grouped dispatch task.
+        assert eng.solo_calls == 1
+        eng.release.set()
+        await asyncio.gather(first, second)
+
+    asyncio.run(drive())
+    assert batcher._solo_inflight == 0

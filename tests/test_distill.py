@@ -18,7 +18,7 @@ from mlops_tpu.train.pipeline import run_training
 
 
 @pytest.fixture(scope="module")
-def ensemble_bundle(tmp_path_factory):
+def ensemble_bundle_dir(tmp_path_factory):
     """A small 4-member ensemble trained through the real pipeline, which
     packages the distilled bulk student alongside."""
     root = tmp_path_factory.mktemp("distill")
@@ -31,7 +31,12 @@ def ensemble_bundle(tmp_path_factory):
     config.registry.root = str(root / "registry")
     config.registry.run_root = str(root / "runs")
     result = run_training(config, register=False)
-    return load_bundle(result.bundle_dir)
+    return result.bundle_dir
+
+
+@pytest.fixture(scope="module")
+def ensemble_bundle(ensemble_bundle_dir):
+    return load_bundle(ensemble_bundle_dir)
 
 
 def test_bundle_carries_bulk_student(ensemble_bundle):
@@ -110,3 +115,29 @@ def test_serving_engine_never_uses_student(ensemble_bundle):
     np.testing.assert_allclose(
         served["predictions"], exact.predictions, rtol=1e-4, atol=1e-5
     )
+
+
+def test_score_exact_flag_forces_ensemble(
+    ensemble_bundle_dir, tmp_path, capsys
+):
+    """score-batch score.exact=true reports path=exact; default reports
+    distilled (CPU backend) — the substitution is always visible and
+    overridable from the CLI."""
+    import json
+
+    from mlops_tpu.commands import _score_batch
+    from mlops_tpu.data import write_csv_columns
+
+    columns, labels = generate_synthetic(400, seed=45)
+    path = tmp_path / "in.csv"
+    write_csv_columns(path, columns, labels)
+
+    for exact, want in ((True, "exact"), (False, "distilled")):
+        config = Config()
+        config.data.train_path = str(path)
+        config.serve.model_directory = str(ensemble_bundle_dir)
+        config.score.exact = exact
+        config.score.chunk_rows = 256
+        assert _score_batch(config) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["path"] == want
