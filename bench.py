@@ -506,12 +506,18 @@ def _prune_bench_runs(run_root: str, keep: int) -> None:
     import shutil
 
     try:
-        dirs = sorted(
-            (d for d in os.listdir(run_root) if d.startswith("bench-")),
-            reverse=True,
-        )
-        for stale in dirs[keep:]:
-            shutil.rmtree(os.path.join(run_root, stale), ignore_errors=True)
+        # Newest-by-mtime, NOT by name: names lead with the model family,
+        # so a lexical sort would rank families alphabetically and could
+        # prune a concurrently-RUNNING bench's dir (active dirs have
+        # recent mtimes and survive an mtime sort).
+        paths = [
+            os.path.join(run_root, d)
+            for d in os.listdir(run_root)
+            if d.startswith("bench")
+        ]
+        paths.sort(key=os.path.getmtime, reverse=True)
+        for stale in paths[keep:]:
+            shutil.rmtree(stale, ignore_errors=True)
     except OSError:
         pass
 

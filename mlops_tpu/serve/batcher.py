@@ -97,13 +97,27 @@ class MicroBatcher:
         )
         self._last_enqueue = now
         if idle:
+            # The decrement is tied to EXECUTOR completion, not caller
+            # exit: a deadline-cancelled caller leaves the engine call
+            # occupying its thread, and decrementing early would re-open
+            # the fast-path for the next victim — re-creating the
+            # unbounded-dead-backlog failure the counter exists to stop.
             self._solo_inflight += 1
-            try:
-                return await loop.run_in_executor(
-                    self._executor, self.engine.predict_records, records
-                )
-            finally:
+            fut = loop.run_in_executor(
+                self._executor, self.engine.predict_records, records
+            )
+
+            def _done(f: asyncio.Future) -> None:
                 self._solo_inflight -= 1
+                if not f.cancelled():
+                    f.exception()  # retrieve, or the loop logs a warning
+                    # when the deadline-cancelled caller never awaits it
+
+            fut.add_done_callback(_done)
+            # shield: a deadline-cancelled caller must not cancel the
+            # wrapper future (that would fire _done at cancel time while
+            # the thread still runs — the early decrement again).
+            return await asyncio.shield(fut)
 
         future: asyncio.Future = loop.create_future()
         self._pending.append((records, future))

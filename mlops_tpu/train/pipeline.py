@@ -147,15 +147,20 @@ def _package_and_register(
     return bundle_dir, model_uri
 
 
+_DISTILL_FAMILIES = ("ft_transformer", "moe", "bert")
+
+
 def _maybe_distill(config, model_config, model, params, train_ds, valid_ds):
-    """Package-time distillation gate: ensembles get a bulk student
-    (train/distill.py) unless train.distill_bulk turned it off. ``model``
-    is None on the sklearn path, which never distills."""
-    if (
-        model is None
-        or model_config.ensemble_size <= 1
-        or not config.train.distill_bulk
-    ):
+    """Package-time distillation gate: models whose per-row FLOPs lose CPU
+    bulk scoring to the sklearn floor — ensembles (K× a small MLP) and
+    the transformer families — get a bulk student (train/distill.py)
+    unless train.distill_bulk turned it off. ``model`` is None on the
+    sklearn path, which never distills (it IS the floor)."""
+    expensive = (
+        model_config.ensemble_size > 1
+        or model_config.family in _DISTILL_FAMILIES
+    )
+    if model is None or not expensive or not config.train.distill_bulk:
         return None
     from mlops_tpu.train.distill import distill_for_bulk
 

@@ -230,13 +230,21 @@ def test_stalled_solo_pushes_arrivals_back_to_batcher():
         first = asyncio.create_task(batcher.predict([{"age": 1.0}]))
         await asyncio.sleep(0.05)  # > window: first went solo and stalled
         assert batcher._solo_inflight == 1
+        # Deadline fires: the CALLER is cancelled, but the engine call
+        # still occupies its executor thread — the counter must NOT drop
+        # (an early decrement would re-open the fast path for the next
+        # victim, rebuilding the unbounded dead backlog).
+        first.cancel()
+        await asyncio.sleep(0.05)
+        assert batcher._solo_inflight == 1
         second = asyncio.create_task(batcher.predict([{"age": 2.0}]))
         await asyncio.sleep(0.05)
         # Second arrival did NOT take the fast path: it either sits in
         # _pending or rides a grouped dispatch task.
         assert eng.solo_calls == 1
         eng.release.set()
-        await asyncio.gather(first, second)
+        await second
+        await asyncio.sleep(0.05)  # executor completion drains the counter
 
     asyncio.run(drive())
     assert batcher._solo_inflight == 0

@@ -141,3 +141,28 @@ def test_score_exact_flag_forces_ensemble(
         assert _score_batch(config) == 0
         out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
         assert out["path"] == want
+
+
+def test_transformer_families_also_distill(tmp_path):
+    """The FT-Transformer (best measured AUC) loses CPU bulk to the
+    sklearn floor just like ensembles do — the distillation gate covers
+    the transformer families too."""
+    config = Config()
+    config.data.rows = 2000
+    config.model = ModelConfig(
+        family="ft_transformer", token_dim=16, depth=1, heads=2
+    )
+    config.train = TrainConfig(steps=60, eval_every=60, batch_size=256)
+    config.registry.root = str(tmp_path / "registry")
+    config.registry.run_root = str(tmp_path / "runs")
+    result = run_training(config, register=False)
+    bundle = load_bundle(result.bundle_dir)
+    assert bundle.has_bulk
+    assert bundle.manifest["bulk"]["model_config"]["family"] == "mlp"
+    assert use_distilled_bulk(bundle) is True  # CPU test backend
+    # Student tracks the transformer teacher on fresh rows.
+    columns, _ = generate_synthetic(800, seed=46)
+    ds = bundle.preprocessor.encode(columns)
+    exact = score_dataset(bundle, ds, chunk_rows=512, exact=True)
+    distilled = score_dataset(bundle, ds, chunk_rows=512, exact=False)
+    assert np.mean(np.abs(exact.predictions - distilled.predictions)) < 0.06
