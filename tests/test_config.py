@@ -34,3 +34,32 @@ def test_unknown_key_rejected():
     with pytest.raises(KeyError):
         load_config(overrides=["nope.nope=1"], env={})
 
+
+
+def test_shipped_config_files_load_and_are_consistent():
+    """Every configs/*.toml must parse into a valid Config; the structural
+    sweep's specs must parse into ModelConfigs, and the long-context job's
+    document length must be ring-shardable on a v5e-8 ('seq': 4)."""
+    from pathlib import Path
+
+    from mlops_tpu.train.hpo import parse_architecture_spec
+
+    root = Path(__file__).resolve().parent.parent / "configs"
+    files = sorted(root.glob("*.toml"))
+    assert len(files) >= 3  # train_register, tune_architectures, long_context
+    for path in files:
+        config = load_config(path, env={})
+        assert config.data.valid_fraction <= 0.5
+        for spec in config.hpo.architectures:
+            parse_architecture_spec(spec, config.model)  # must not raise
+        if config.model.seq_parallel:
+            # Derive the doc length from the REAL model (a hardcoded
+            # feature count would keep passing if SCHEMA grew and the
+            # shipped config silently stopped ring-sharding on seq=4).
+            import dataclasses
+
+            from mlops_tpu.train.long_context import build_doc_model
+
+            dense = dataclasses.replace(config.model, seq_parallel=False)
+            seq = build_doc_model(dense).doc_seq_len
+            assert seq % 4 == 0, (path.name, seq)

@@ -262,3 +262,47 @@ def test_registry_gcs_orphan_scan(client, tmp_path):
         "gs://est/reg2", client=client, cache_dir=tmp_path / "cache"
     )
     assert reg.register("credit", bundle) == "models:/credit/8"
+
+
+def test_ingest_reads_gcs_parquet(client, monkeypatch, tmp_path):
+    """Parquet over gs:// rides the same generation-keyed fetch_local
+    cache as CSV — both the batch reader and the streamed chunker."""
+    pytest.importorskip("pyarrow")
+    from mlops_tpu.data import generate_synthetic
+    from mlops_tpu.data.parquet import write_parquet_columns
+    from mlops_tpu.data.ingest import load_table_columns
+    from mlops_tpu.data.stream import iter_table_chunks
+
+    monkeypatch.setattr(storage, "_default_client", client)
+    # Cache under tmp_path, not the real user cache: the fake bucket's
+    # generation restarts at 1 every run, so the default ~/.cache key
+    # would serve a PREVIOUS run's bytes and stop testing the roundtrip.
+    from mlops_tpu.data import ingest as ingest_mod
+
+    real_fetch = ingest_mod.fetch_local
+    monkeypatch.setattr(
+        ingest_mod,
+        "fetch_local",
+        lambda path, workdir=None: real_fetch(path, workdir=tmp_path / "cache"),
+    )
+    from mlops_tpu.data import parquet as parquet_mod
+
+    monkeypatch.setattr(parquet_mod, "fetch_local", ingest_mod.fetch_local)
+    columns, labels = generate_synthetic(60, seed=4)
+    local = tmp_path / "curated.parquet"
+    write_parquet_columns(local, columns, labels)
+    client.write_bytes("gs://est/data/curated.parquet", local.read_bytes())
+
+    got_cols, got_labels = load_table_columns(
+        "gs://est/data/curated.parquet", require_target=True
+    )
+    np.testing.assert_array_equal(got_labels, labels)
+    assert got_cols["sex"] == columns["sex"]
+
+    sizes = [
+        len(c["sex"])
+        for c, _ in iter_table_chunks(
+            "gs://est/data/curated.parquet", chunk_rows=25
+        )
+    ]
+    assert sizes == [25, 25, 10]
