@@ -166,3 +166,21 @@ def test_transformer_families_also_distill(tmp_path):
     exact = score_dataset(bundle, ds, chunk_rows=512, exact=True)
     distilled = score_dataset(bundle, ds, chunk_rows=512, exact=False)
     assert np.mean(np.abs(exact.predictions - distilled.predictions)) < 0.06
+
+
+def test_distilled_path_shards_over_mesh(ensemble_bundle):
+    """Distilled routing composes with data-parallel scoring: the student
+    sharded over the 8-device mesh matches its single-device output."""
+    from mlops_tpu.parallel import make_mesh
+
+    columns, _ = generate_synthetic(1000, seed=47)
+    ds = ensemble_bundle.preprocessor.encode(columns)
+    solo = score_dataset(ensemble_bundle, ds, chunk_rows=512, exact=False)
+    sharded = score_dataset(
+        ensemble_bundle, ds, mesh=make_mesh(8), chunk_rows=512, exact=False
+    )
+    assert solo.path == sharded.path == "distilled"
+    np.testing.assert_allclose(
+        solo.predictions, sharded.predictions, rtol=2e-2, atol=2e-3
+    )
+    np.testing.assert_array_equal(solo.outliers, sharded.outliers)
