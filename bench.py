@@ -311,13 +311,18 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     model, variables = bundle.model, bundle.variables
     rng = np.random.default_rng(1)
 
-    # Each section guards itself: a failure in one must not discard the
-    # partial evidence the earlier sections already measured.
+    # Each section guards itself — INCLUDING its input construction — so
+    # a failure in one never discards the evidence the others produced.
     n = 16_384
-    cat = jnp.asarray(
-        rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
-    )
-    num = jnp.asarray(rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32))
+
+    def big_inputs():
+        cat = jnp.asarray(
+            rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
+        )
+        num = jnp.asarray(
+            rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32)
+        )
+        return cat, num
 
     # --- bulk inference: FLOPs of the SAME fused program the bulk stage
     # timed (classifier + drift + outlier, ops/predict.py) × measured
@@ -325,6 +330,7 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     try:
         from mlops_tpu.ops.predict import make_padded_predict_fn
 
+        cat, num = big_inputs()
         mask = jnp.ones((n,), bool)
         fused = make_padded_predict_fn(
             model, variables, bundle.monitor, bundle.temperature
@@ -342,8 +348,12 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
         from mlops_tpu.train.loop import training_loss
 
         batch = 1024
-        tcat = cat[:batch]
-        tnum = num[:batch]
+        tcat = jnp.asarray(
+            rng.integers(0, 2, (batch, SCHEMA.num_categorical)).astype(np.int32)
+        )
+        tnum = jnp.asarray(
+            rng.normal(size=(batch, SCHEMA.num_numeric)).astype(np.float32)
+        )
         tlab = jnp.asarray((rng.random(batch) < 0.2).astype(np.float32))
         key = jax.random.PRNGKey(0)
 

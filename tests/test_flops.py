@@ -1,0 +1,58 @@
+"""FLOP accounting / MFU helpers (utils/flops.py) — the bench's roofline
+evidence must itself be trustworthy."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from mlops_tpu.utils.flops import (
+    compile_with_flops,
+    compiled_flops,
+    measured_gemm_peak,
+    mfu,
+    peak_flops,
+)
+
+
+def test_compile_with_flops_counts_a_matmul():
+    n = 128
+    a = jnp.ones((n, n), jnp.float32)
+    exe, flops = compile_with_flops(lambda a, b: a @ b, a, a)
+    assert exe is not None
+    # XLA counts 2*n^3 (multiply+add) for a dense matmul.
+    assert flops == 2 * n**3
+    np.testing.assert_allclose(np.asarray(exe(a, a)), np.full((n, n), n))
+    assert compiled_flops(lambda a, b: a @ b, a, a) == flops
+
+
+def test_compile_with_flops_survives_bad_fn():
+    exe, flops = compile_with_flops(lambda x: undefined_name + x, 1.0)  # noqa: F821
+    assert exe is None and flops is None
+
+
+def test_measured_gemm_peak_is_sane():
+    peak = measured_gemm_peak(n=256, reps=2)
+    # Any host lands between 100 MFLOP/s and 100 TFLOP/s.
+    assert 1e8 < peak < 1e14
+
+
+def test_mfu_and_peak_lookup():
+    assert mfu(None, 10.0, 1e12) is None
+    assert mfu(1e9, 10.0, None) is None
+    assert mfu(1e9, 100.0, 1e12) == 0.1
+
+    class FakeDevice:
+        device_kind = "TPU v5 lite"
+
+    class UnknownDevice:
+        device_kind = "mystery-asic"
+
+    assert peak_flops(FakeDevice()) == 197e12
+    assert peak_flops(UnknownDevice()) is None
+
+
+def test_peak_env_override(monkeypatch):
+    class UnknownDevice:
+        device_kind = "mystery-asic"
+
+    monkeypatch.setenv("MLOPS_TPU_PEAK_FLOPS", "5e12")
+    assert peak_flops(UnknownDevice()) == 5e12
