@@ -169,6 +169,17 @@ def _percentile(sorted_ms: list[float], q: float) -> float:
     return percentile(sorted_ms, q)
 
 
+_T0 = time.perf_counter()
+
+
+def _note(msg: str) -> None:
+    """Stage-progress breadcrumb on STDERR (stdout carries the one-JSON-line
+    contract). On a flapping remote-chip tunnel the wall watchdog can fire
+    mid-run; these timestamps are how a post-mortem tells 'stage X is slow'
+    from 'the device died during stage X' (round-4 diagnosis need)."""
+    print(f"# bench +{time.perf_counter() - _T0:7.1f}s {msg}", file=sys.stderr, flush=True)
+
+
 def _batch1_stage(engine, record) -> dict:
     """p50/p99 of the full serving path + a stage breakdown."""
     import jax
@@ -225,6 +236,7 @@ def _bulk_stage(engine, bundle) -> dict:
     rng = np.random.default_rng(0)
     out: dict[str, float] = {}
     for n, reps in ((256, 20), (4096, 10), (16384, 5)):
+        _note(f"bulk bucket n={n}")
         cat = rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
         num = rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32)
         engine.predict_arrays(cat, num)  # warm this bucket
@@ -233,6 +245,7 @@ def _bulk_stage(engine, bundle) -> dict:
             engine.predict_arrays(cat, num)
         dt = time.perf_counter() - t0
         out[f"bulk_rows_per_s_b{n}"] = round(reps * n / dt, 1)
+    _note("bulk pipelined sweep")
 
     # Pipelined sweep: 262,144 rows through the chunked bulk scorer —
     # once exact (serving-identical ensemble; the key's historical
@@ -636,6 +649,7 @@ def main() -> None:
         batch_size=1024, steps=600, eval_every=600, warmup_steps=60
     )
     config.registry.run_root = "runs/bench"
+    _note(f"backend up, device={device}; training {family} ens={ensemble}")
     t_train = time.perf_counter()
     # Fresh run dir per invocation (ns + pid so concurrent same-second
     # benches can't share): a reused dir either resumes from its own
@@ -651,12 +665,16 @@ def main() -> None:
     train_wall_s = time.perf_counter() - t_train
     bundle = load_bundle(result.bundle_dir)
 
+    _note(f"training done in {train_wall_s:.1f}s; warming engine")
     engine = InferenceEngine(bundle, buckets=(1, 8, 64, 256, 4096, 16384))
     engine.warmup()
 
     record = LoanApplicant().model_dump()
+    _note("warm; batch-1 stage")
     batch1 = _batch1_stage(engine, record)
+    _note("bulk stage")
     bulk = _bulk_stage(engine, bundle)
+    _note("roofline stage")
     try:
         # Roofline extras are evidence, not the headline: a cost-analysis
         # or kernel quirk on a new backend must not turn a measured run
@@ -664,7 +682,11 @@ def main() -> None:
         roofline = _mfu_stage(bundle, bulk, device)
     except Exception as err:
         roofline = {"mfu_error": f"{type(err).__name__}: {err}"}
-    http = {**_engine_stage(engine, record), **_http_stage(engine, record)}
+    _note("engine grouped stage")
+    engine_stats = _engine_stage(engine, record)
+    _note("http stage")
+    http = {**engine_stats, **_http_stage(engine, record)}
+    _note("stages complete")
 
     p50 = batch1["p50_ms"]
     _BENCH_DONE.set()  # from here on the watchdog must not interfere

@@ -54,14 +54,21 @@ def _synth(config) -> int:
 
 
 def _train(config) -> int:
-    from mlops_tpu.train.pipeline import run_training
+    from mlops_tpu.train.pipeline import run_layout_training, run_training
 
-    result = run_training(config)
+    if config.model.uses_layout_trainer:
+        # Multi-device training layouts (GPipe / ring-attention documents)
+        # run through their dedicated trainers on a mesh built from the
+        # available devices (train/pipeline.py run_layout_training).
+        result = run_layout_training(config)
+    else:
+        result = run_training(config)
     print(
         json.dumps(
             {
-                "bundle": str(result.bundle_dir),
+                "bundle": str(result.bundle_dir) if result.bundle_dir else None,
                 "model_uri": result.model_uri,
+                "run_dir": str(result.run_dir),
                 "steps": result.train_result.steps,
                 "packaged_step": result.train_result.packaged_step,
                 "metrics": result.train_result.metrics,

@@ -53,6 +53,22 @@ class ModelConfig:
     # axis — the training path is `train/long_context.py`.
     doc_records: int = 1
     seq_parallel: bool = False
+    # Pipeline parallelism (family bert): split the `depth` encoder blocks
+    # into `pipeline_stages` GPipe stages over the mesh's 'stage' axis
+    # (`train/pipeline_parallel.py`); microbatches stream through the
+    # ppermute ring (`parallel/pipeline.py`). 0 = off. Requires
+    # depth % pipeline_stages == 0 and dropout == 0.
+    pipeline_stages: int = 0
+
+    @property
+    def uses_layout_trainer(self) -> bool:
+        """True when this config needs a multi-device layout trainer
+        (`train/pipeline.py run_layout_training`) instead of the dense
+        ``run_training`` path — the ONE predicate both the CLI dispatch
+        and run_training's guard share."""
+        return bool(
+            self.pipeline_stages or self.seq_parallel or self.doc_records > 1
+        )
 
 
 @dataclasses.dataclass
@@ -80,6 +96,10 @@ class TrainConfig:
     # bulk sweeps route through it so they beat the sklearn GBM floor
     # instead of paying K× ensemble FLOPs; serving stays exact. The
     # student's fidelity record lands in the bundle manifest.
+    pipeline_microbatches: int = 8  # GPipe microbatches per step on the
+    # pipeline-parallel path (model.pipeline_stages > 0): bubble fraction
+    # is (S-1)/(M+S-1), so raise M to amortize; batch_size must divide by
+    # it (times the 'data' axis when composing DP x PP)
     ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
     # params (EMA folded into the compiled scan; eval/packaging use the
     # debiased average, raw params keep training). 0 disables. Applies to

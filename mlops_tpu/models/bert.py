@@ -138,6 +138,40 @@ def tokenize(
     return jnp.concatenate([cls, pairs, sep], axis=1)
 
 
+def apply_embed_front(
+    mod: nn.Module,
+    tokens: jnp.ndarray,
+    vocab_size: int,
+    seq_len: int,
+    hidden: int,
+    dtype: jnp.dtype,
+) -> jnp.ndarray:
+    """The shared embedding front: tok_embed + pos_embed → ln_embed.
+
+    Called from inside a ``@nn.compact`` ``__call__`` (``mod`` is the owning
+    module); submodule/param names are fixed here ONCE so every consumer —
+    ``BertEncoder``, ``BertMaskedLM``, ``BertDocEncoder``, and the
+    pipeline-parallel split (`train/pipeline_parallel.py`) — produces
+    byte-compatible param trees.
+    """
+    x = nn.Embed(vocab_size, hidden, dtype=dtype, name="tok_embed")(tokens)
+    pos = mod.param(
+        "pos_embed", nn.initializers.normal(0.02), (seq_len, hidden)
+    )
+    x = x + pos.astype(dtype)[None]
+    return nn.LayerNorm(dtype=dtype, name="ln_embed")(x)
+
+
+def apply_cls_head(
+    mod: nn.Module, x: jnp.ndarray, hidden: int, dtype: jnp.dtype
+) -> jnp.ndarray:
+    """The shared read-out: ln_final on [CLS] → tanh pooler → head logit."""
+    cls = nn.LayerNorm(dtype=dtype, name="ln_final")(x[:, 0])
+    pooled = nn.tanh(nn.Dense(hidden, dtype=dtype, name="pooler")(cls))
+    logit = nn.Dense(1, dtype=dtype, name="head")(pooled)
+    return logit[:, 0].astype(jnp.float32)
+
+
 class BertEncoder(nn.Module):
     """Pre-LN BERT-style encoder over the tabular token rendering.
 
@@ -164,17 +198,9 @@ class BertEncoder(nn.Module):
     ) -> jnp.ndarray:
         layout = self.layout
         tokens = tokenize(cat_ids, numeric, layout)  # [N, S]
-
-        x = nn.Embed(
-            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
-        )(tokens)
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02),
-            (layout.seq_len, self.hidden),
+        x = apply_embed_front(
+            self, tokens, layout.vocab_size, layout.seq_len, self.hidden, self.dtype
         )
-        x = x + pos.astype(self.dtype)[None]
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
 
         for i in range(self.depth):
@@ -186,13 +212,7 @@ class BertEncoder(nn.Module):
                 name=f"block_{i}",
             )(x, train=train)
 
-        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x[:, 0])
-        # BERT-style tanh pooler, then the classifier head.
-        pooled = nn.tanh(
-            nn.Dense(self.hidden, dtype=self.dtype, name="pooler")(cls)
-        )
-        logit = nn.Dense(1, dtype=self.dtype, name="head")(pooled)
-        return logit[:, 0].astype(jnp.float32)
+        return apply_cls_head(self, x, self.hidden, self.dtype)
 
 
 class BertMaskedLM(nn.Module):
@@ -243,17 +263,9 @@ class BertMaskedLM(nn.Module):
         layout = self.layout
         targets = tokenize(cat_ids, numeric, layout)
         tokens = jnp.where(mask, MASK_ID, targets)
-
-        x = nn.Embed(
-            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
-        )(tokens)
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02),
-            (layout.seq_len, self.hidden),
+        x = apply_embed_front(
+            self, tokens, layout.vocab_size, layout.seq_len, self.hidden, self.dtype
         )
-        x = x + pos.astype(self.dtype)[None]
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.depth):
             x = TransformerBlock(
@@ -333,16 +345,9 @@ class BertDocEncoder(nn.Module):
     ) -> jnp.ndarray:
         layout = self.layout
         tokens = tokenize_documents(cat_ids, numeric, layout)  # [N, S]
-        x = nn.Embed(
-            layout.vocab_size, self.hidden, dtype=self.dtype, name="tok_embed"
-        )(tokens)
-        pos = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02),
-            (self.doc_seq_len, self.hidden),
+        x = apply_embed_front(
+            self, tokens, layout.vocab_size, self.doc_seq_len, self.hidden, self.dtype
         )
-        x = x + pos.astype(self.dtype)[None]
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
         for i in range(self.depth):
             x = TransformerBlock(
@@ -353,12 +358,7 @@ class BertDocEncoder(nn.Module):
                 attend_fn=self.attend_fn,
                 name=f"block_{i}",
             )(x, train=train)
-        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x[:, 0])
-        pooled = nn.tanh(
-            nn.Dense(self.hidden, dtype=self.dtype, name="pooler")(cls)
-        )
-        logit = nn.Dense(1, dtype=self.dtype, name="head")(pooled)
-        return logit[:, 0].astype(jnp.float32)
+        return apply_cls_head(self, x, self.hidden, self.dtype)
 
 
 def transfer_encoder_params(pretrained: dict, target: dict) -> dict:

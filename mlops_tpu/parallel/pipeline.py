@@ -33,6 +33,7 @@ def pipeline_stage_shard(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     axis_name: str,
     axis_size: int,
+    extra_varying: tuple[str, ...] = (),
 ) -> jnp.ndarray:
     """Per-device body, to be called INSIDE shard_map.
 
@@ -52,6 +53,7 @@ def pipeline_stage_shard(
     num_micro = x.shape[0]
     local = jax.tree_util.tree_map(lambda w: w[0], stage_weights)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    varying_axes = (axis_name, *extra_varying)
 
     def tick(carry, t):
         recv, out = carry
@@ -76,10 +78,14 @@ def pipeline_stage_shard(
 
     # The carry varies per device from the first tick (each stage computes
     # its own activations), so the zero initials must be typed as varying
-    # over the stage axis for shard_map's scan typing.
+    # over the stage axis — and over the batch axis too when the
+    # microbatches arrive DP-sharded (extra_varying) — for shard_map's
+    # scan typing.
     recv0 = jax.lax.pcast(
-        jnp.zeros(x.shape[1:], x.dtype), (axis_name,), to="varying"
+        jnp.zeros(x.shape[1:], x.dtype), varying_axes, to="varying"
     )
+    # zeros_like(x) already inherits x's varying axes (the batch axis when
+    # DP-sharded), so out0 only needs the stage axis added.
     out0 = jax.lax.pcast(jnp.zeros_like(x), (axis_name,), to="varying")
     (recv, out), _ = jax.lax.scan(
         tick, (recv0, out0), jnp.arange(num_micro + axis_size - 1)
@@ -93,15 +99,18 @@ def make_pipeline(
     mesh: Mesh,
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     axis_name: str = "stage",
+    batch_axis: str | None = None,
 ) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
     """Build ``run(stage_weights, x) -> y`` executing ``stage_fn`` as an
     S-deep pipeline over ``mesh[axis_name]``.
 
     ``stage_weights`` is any pytree whose leaves carry a leading stage
     axis of size S (sharded across devices); ``x`` is ``[M, B, D]``
-    microbatches. Equivalent to folding ``stage_fn`` sequentially over
-    the stage axis — validated exactly in
-    ``tests/test_pipeline_parallel.py``.
+    microbatches. ``batch_axis`` composes DP x PP: the microbatch B dim
+    shards over that mesh axis and the stage ring runs independently per
+    batch shard (all communication stays on the 'stage' axis).
+    Equivalent to folding ``stage_fn`` sequentially over the stage axis —
+    validated exactly in ``tests/test_pipeline_parallel.py``.
     """
     axis_size = mesh.shape[axis_name]
     body = partial(
@@ -109,12 +118,14 @@ def make_pipeline(
         stage_fn=stage_fn,
         axis_name=axis_name,
         axis_size=axis_size,
+        extra_varying=(batch_axis,) if batch_axis else (),
     )
+    x_spec = P(None, batch_axis) if batch_axis else P()
     return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis_name), P()),
-            out_specs=P(),
+            in_specs=(P(axis_name), x_spec),
+            out_specs=x_spec,
         )
     )

@@ -31,7 +31,7 @@ from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.models.bert import BertDocEncoder
 from mlops_tpu.parallel.ring_attention import make_ring_attention
 from mlops_tpu.schema.features import SCHEMA
-from mlops_tpu.train.loop import sigmoid_bce
+from mlops_tpu.train.loop import sigmoid_bce, warn_ema_unsupported
 
 
 def make_documents(
@@ -104,6 +104,7 @@ def make_doc_train_step(
     the attention inner loop rides the explicit ppermute ring. Without a
     mesh: the same step, dense, single device.
     """
+    warn_ema_unsupported(train_config, "the long-context trainer")
     model = build_doc_model(model_config, mesh)
     r = model_config.doc_records
     dummy_cat = jnp.zeros((2, r, SCHEMA.num_categorical), jnp.int32)
@@ -124,8 +125,12 @@ def make_doc_train_step(
         updates, opt_state = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    # No donation on either path: DocTrainStep exposes the initial
+    # params/opt_state, and a donated first step would delete those
+    # buffers on TPU (the fit() donation bug class); activations dominate
+    # this trainer's memory anyway.
     if mesh is None:
-        step_fn = jax.jit(step, donate_argnums=(0, 1))
+        step_fn = jax.jit(step)
     else:
         batch = "data" if "data" in mesh.axis_names else None
         # Inputs shard over 'data' only: the R record axis (11 for a
@@ -139,7 +144,6 @@ def make_doc_train_step(
             step,
             in_shardings=(rep, rep, doc_in, doc_in, lab_in),
             out_shardings=(rep, rep, rep),
-            donate_argnums=(0, 1),
         )
     return DocTrainStep(
         model=model, step_fn=step_fn, params=params, opt_state=opt_state

@@ -32,7 +32,8 @@ from mlops_tpu.train.loop import TrainResult, fit
 
 @dataclasses.dataclass
 class PipelineResult:
-    bundle_dir: Path
+    bundle_dir: Path | None  # None for runs with no serving artifact
+    # (document models — see run_layout_training)
     model_uri: str | None
     train_result: TrainResult
     run_dir: Path
@@ -190,6 +191,18 @@ def run_training(
       5. register it (notebook 02's ``register_model``), returning a
          ``models:/<name>/<version>`` URI
     """
+    if config.model.uses_layout_trainer:
+        # Loud, not silent: this entrypoint trains the single-record dense
+        # model; a multi-device layout knob left set would otherwise train
+        # a plain model without the requested parallelism and no warning.
+        raise ValueError(
+            "run_training trains the single-record dense model; "
+            "multi-device training layouts have dedicated trainers "
+            "(model.doc_records/seq_parallel -> train/long_context.py, "
+            "model.pipeline_stages -> train/pipeline_parallel.py) — call "
+            "run_layout_training, which the `train` CLI dispatches to "
+            "automatically"
+        )
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S")
     run_dir = new_run_dir(config, run_name)
 
@@ -266,6 +279,244 @@ def run_training(
     return PipelineResult(
         bundle_dir=bundle_dir,
         model_uri=model_uri,
+        train_result=result,
+        run_dir=run_dir,
+    )
+
+
+def run_layout_training(
+    config: Config,
+    register: bool = True,
+    run_name: str | None = None,
+) -> PipelineResult:
+    """Real training runs for the multi-device layout configs the dense
+    entrypoint rejects (the `train` CLI dispatches here automatically):
+
+    - ``model.pipeline_stages=S``: GPipe trainer on a ``('data','stage')``
+      mesh (`train/pipeline_parallel.py`). After training, the
+      stage-stacked params MERGE back into the dense bert tree and flow
+      through the normal calibrate → distill → package → register tail —
+      a PP-trained model serves like any other bert bundle.
+    - ``model.doc_records>1``: document-BERT trainer
+      (`train/long_context.py`), on a ``('data','seq')`` ring mesh when
+      ``seq_parallel`` is set. Document models read record HISTORIES, not
+      the single-record serving contract, so the run saves params
+      (msgpack) + metrics.jsonl instead of a serving bundle.
+
+    Needs enough devices to host the mesh (a v5e-8 / JobSet in
+    production, the fake 8-device CPU env in tests/CI); raises with the
+    required count otherwise.
+    """
+    if not config.model.uses_layout_trainer:
+        # The mirror of run_training's guard: a dense config routed here
+        # would silently train a 1-record "document" model.
+        raise ValueError(
+            "run_layout_training needs a layout knob set "
+            "(model.pipeline_stages / seq_parallel / doc_records>1); "
+            "dense configs train via run_training"
+        )
+    run_name = run_name or time.strftime("%Y%m%d-%H%M%S")
+    run_dir = new_run_dir(config, run_name)
+    columns, labels = load_training_data(config)
+    preprocessor = Preprocessor.fit(columns)
+    ds = preprocessor.encode(columns, labels)
+    train_ds, valid_ds = split_dataset(ds, config.data.valid_fraction)
+    if config.model.pipeline_stages:
+        return _run_pp_training(
+            config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+        )
+    return _run_doc_training(config, run_dir, train_ds, valid_ds)
+
+
+def _sample_batches(n_rows: int, batch: int, steps: int, seed: int):
+    """Step-indexed minibatch indices (with-replacement sampling)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        yield rng.integers(0, n_rows, batch)
+
+
+def _run_pp_training(
+    config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+) -> PipelineResult:
+    import jax.numpy as jnp
+
+    from mlops_tpu.parallel import make_nd_mesh
+    from mlops_tpu.train.loop import evaluate
+    from mlops_tpu.train.pipeline_parallel import (
+        make_pp_train_step,
+        merge_bert_params,
+    )
+    from mlops_tpu.utils.jsonl import JsonlWriter
+
+    stages = config.model.pipeline_stages
+    n_dev = len(jax.devices())
+    if n_dev % stages:
+        raise ValueError(
+            f"model.pipeline_stages={stages} needs the device count to be a "
+            f"multiple of it; have {n_dev} (run on a v5e pod slice or the "
+            f"fake {stages}-device env)"
+        )
+    mesh = make_nd_mesh({"data": n_dev // stages, "stage": stages})
+    trainer = make_pp_train_step(
+        config.model, config.train, mesh, seed=config.train.seed
+    )
+    dense_model = build_model(
+        dataclasses.replace(config.model, pipeline_stages=0)
+    )
+    tcfg = config.train
+    params, opt_state = trainer.params, trainer.opt_state
+    history: list[dict] = []
+    merged = None
+    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+        for step, idx in enumerate(
+            _sample_batches(train_ds.n, tcfg.batch_size, tcfg.steps, tcfg.seed),
+            start=1,
+        ):
+            params, opt_state, loss = trainer.step_fn(
+                params,
+                opt_state,
+                jnp.asarray(train_ds.cat_ids[idx]),
+                jnp.asarray(train_ds.numeric[idx]),
+                jnp.asarray(train_ds.labels[idx]),
+            )
+            if step % tcfg.eval_every == 0 or step == tcfg.steps:
+                merged = merge_bert_params(jax.device_get(params))
+                metrics = evaluate(dense_model, merged, valid_ds)
+                record = {"step": step, "loss": round(float(loss), 6), **metrics}
+                writer.write(record)
+                history.append(record)
+
+    final = {k: v for k, v in history[-1].items() if k.startswith("validation_")}
+    result = TrainResult(
+        params=merged,
+        metrics=final,
+        history=history,
+        steps=tcfg.steps,
+        packaged_step=tcfg.steps,
+    )
+    calibration = _fit_calibration(valid_ds, merged, dense_model)
+    bulk = _maybe_distill(
+        config, config.model, dense_model, merged, train_ds, valid_ds
+    )
+    bundle_dir, model_uri = _package_and_register(
+        config,
+        run_dir,
+        merged,
+        preprocessor,
+        train_ds,
+        metrics=final,
+        bundle_tags={
+            "run_name": run_name,
+            "experiment": config.registry.experiment_name,
+            "trained_with": f"pipeline_parallel dp{mesh.shape['data']}xpp{stages}",
+        },
+        registry_tags={
+            "run_name": run_name,
+            **{k: f"{v:.6f}" for k, v in final.items()},
+        },
+        register=register,
+        calibration=calibration,
+        bulk=bulk,
+    )
+    return PipelineResult(
+        bundle_dir=bundle_dir,
+        model_uri=model_uri,
+        train_result=result,
+        run_dir=run_dir,
+    )
+
+
+def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
+    import jax.numpy as jnp
+
+    from mlops_tpu.parallel import make_nd_mesh
+    from mlops_tpu.train.checkpoint import tree_bytes
+    from mlops_tpu.train.long_context import make_doc_train_step, make_documents
+    from mlops_tpu.train.metrics import binary_metrics
+    from mlops_tpu.utils.io import atomic_write
+    from mlops_tpu.utils.jsonl import JsonlWriter
+
+    n_dev = len(jax.devices())
+    mesh = None
+    dp = 1
+    if config.model.seq_parallel:
+        from mlops_tpu.train.long_context import build_doc_model
+
+        # The authoritative length (BertDocEncoder.doc_seq_len), not a
+        # copy of its formula.
+        seq = build_doc_model(
+            dataclasses.replace(config.model, seq_parallel=False)
+        ).doc_seq_len
+        sp = max(
+            (d for d in range(1, n_dev + 1) if n_dev % d == 0 and seq % d == 0),
+            default=1,
+        )
+        if sp == 1:
+            raise ValueError(
+                f"seq_parallel needs the document length (2 + 46*doc_records "
+                f"= {seq}) to share a factor with the device count {n_dev}; "
+                f"pick doc_records accordingly (11 -> 508 works on 2/4-way)"
+            )
+        mesh = make_nd_mesh({"data": n_dev // sp, "seq": sp})
+        dp = n_dev // sp
+    trainer = make_doc_train_step(
+        config.model, config.train, mesh=mesh, seed=config.train.seed
+    )
+    dcat, dnum, dlab = make_documents(train_ds, config.model.doc_records)
+    vcat, vnum, vlab = make_documents(valid_ds, config.model.doc_records)
+    tcfg = config.train
+    batch = max(dp, tcfg.batch_size - tcfg.batch_size % dp)
+
+    def doc_eval(params) -> dict[str, float]:
+        # Pad the valid docs to a multiple of the 'data' axis (the ring's
+        # shard_map requires an even batch split), then slice back.
+        n = vcat.shape[0]
+        pad = (-n) % dp
+        logits = trainer.model.apply(
+            {"params": params},
+            jnp.asarray(np.pad(vcat, ((0, pad), (0, 0), (0, 0)))),
+            jnp.asarray(np.pad(vnum, ((0, pad), (0, 0), (0, 0)))),
+            train=False,
+        )[:n]
+        metrics = binary_metrics(logits, jnp.asarray(vlab))
+        return {f"validation_{k}_score": round(float(v), 6) for k, v in metrics.items()}
+
+    params, opt_state = trainer.params, trainer.opt_state
+    history: list[dict] = []
+    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+        for step, idx in enumerate(
+            _sample_batches(dcat.shape[0], batch, tcfg.steps, tcfg.seed),
+            start=1,
+        ):
+            params, opt_state, loss = trainer.step_fn(
+                params,
+                opt_state,
+                jnp.asarray(dcat[idx]),
+                jnp.asarray(dnum[idx]),
+                jnp.asarray(dlab[idx]),
+            )
+            if step % tcfg.eval_every == 0 or step == tcfg.steps:
+                record = {
+                    "step": step,
+                    "loss": round(float(loss), 6),
+                    **doc_eval(params),
+                }
+                writer.write(record)
+                history.append(record)
+
+    params_host = jax.device_get(params)
+    atomic_write(run_dir / "doc_params.msgpack", tree_bytes(params_host))
+    final = {k: v for k, v in history[-1].items() if k.startswith("validation_")}
+    result = TrainResult(
+        params=params_host,
+        metrics=final,
+        history=history,
+        steps=tcfg.steps,
+        packaged_step=tcfg.steps,
+    )
+    return PipelineResult(
+        bundle_dir=None,
+        model_uri=None,
         train_result=result,
         run_dir=run_dir,
     )

@@ -63,3 +63,12 @@ def test_shipped_config_files_load_and_are_consistent():
             dense = dataclasses.replace(config.model, seq_parallel=False)
             seq = build_doc_model(dense).doc_seq_len
             assert seq % 4 == 0, (path.name, seq)
+        if config.model.pipeline_stages:
+            # The PP job must satisfy make_pp_train_step's invariants on
+            # a v5e-8 mesh {'data': 2, 'stage': pipeline_stages}.
+            s = config.model.pipeline_stages
+            m = config.train.pipeline_microbatches
+            assert config.model.depth % s == 0, path.name
+            assert config.model.dropout == 0.0, path.name
+            assert config.train.batch_size % m == 0, path.name
+            assert (config.train.batch_size // m) % 2 == 0, path.name

@@ -70,3 +70,225 @@ def test_pipeline_composes_with_data_parallel_axis():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(_sequential(weights, x)), atol=1e-5
     )
+
+
+def test_pipeline_with_dp_sharded_batch():
+    """batch_axis='data': the microbatch batch dim shards over 'data'
+    while the ring still matches the sequential fold exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    weights, x = _setup(4, 4, batch=8)
+    x = jax.device_put(x, NamedSharding(mesh, P(None, "data")))
+    run = make_pipeline(mesh, _stage_fn, batch_axis="data")
+    got = run(weights, x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_sequential(weights, x)), atol=1e-5
+    )
+
+
+# ------------------------- product path: train/pipeline_parallel.py ------
+
+
+def _pp_configs(depth=4, batch=32, micro=4):
+    from mlops_tpu.config import ModelConfig, TrainConfig
+
+    model = ModelConfig(
+        family="bert",
+        token_dim=32,
+        depth=depth,
+        heads=4,
+        dropout=0.0,
+        precision="f32",
+        pipeline_stages=4,
+    )
+    train = TrainConfig(
+        batch_size=batch,
+        learning_rate=1e-3,
+        steps=50,
+        warmup_steps=2,  # the shared make_optimizer schedule: ramp fast so
+        # the few-step loss-decrease assertion sees a real learning rate
+        pipeline_microbatches=micro,
+    )
+    return model, train
+
+
+def _pp_batch(n, seed=0):
+    from mlops_tpu.schema import SCHEMA
+
+    rng = np.random.default_rng(seed)
+    cat = jnp.asarray(
+        rng.integers(0, 2, (n, SCHEMA.num_categorical)).astype(np.int32)
+    )
+    num = jnp.asarray(rng.normal(size=(n, SCHEMA.num_numeric)).astype(np.float32))
+    lab = jnp.asarray((rng.random(n) < 0.25).astype(np.float32))
+    return cat, num, lab
+
+
+def test_pp_bert_forward_matches_dense():
+    """The PP forward (embed → staged pipeline → head) must equal the
+    dense BertEncoder on the SAME params — pipeline parallelism is a
+    layout, not a different model."""
+    from mlops_tpu.models import build_model, init_params
+    from mlops_tpu.train.pipeline_parallel import (
+        make_pp_train_step,
+        split_bert_params,
+    )
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    trainer = make_pp_train_step(model_config, train_config, mesh, seed=7)
+
+    dense = build_model(model_config)
+    variables = init_params(dense, jax.random.PRNGKey(7))
+    cat, num, _ = _pp_batch(train_config.batch_size)
+    want = dense.apply(variables, cat, num, train=False)
+    got = trainer.forward_fn(
+        split_bert_params(variables["params"], 4), cat, num
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_pp_train_step_decreases_loss():
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    trainer = make_pp_train_step(model_config, train_config, mesh)
+    cat, num, lab = _pp_batch(train_config.batch_size)
+    params, opt_state = trainer.params, trainer.opt_state
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = trainer.step_fn(params, opt_state, cat, num, lab)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_pp_split_merge_roundtrip_and_packaging_parity():
+    """merge(split(P)) == P, and a PP-trained tree converts back into a
+    tree the DENSE model scores with — the packaging/serving path."""
+    from mlops_tpu.models import build_model, init_params
+    from mlops_tpu.train.pipeline_parallel import (
+        make_pp_train_step,
+        merge_bert_params,
+        split_bert_params,
+    )
+
+    model_config, train_config = _pp_configs()
+    dense = build_model(model_config)
+    variables = init_params(dense, jax.random.PRNGKey(3))
+    roundtrip = merge_bert_params(split_bert_params(variables["params"], 4))
+    for a, b in zip(
+        jax.tree.leaves(variables["params"]), jax.tree.leaves(roundtrip)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    trainer = make_pp_train_step(model_config, train_config, mesh)
+    cat, num, lab = _pp_batch(train_config.batch_size)
+    params, opt_state = trainer.params, trainer.opt_state
+    params, _, _ = trainer.step_fn(params, opt_state, cat, num, lab)
+    merged = merge_bert_params(jax.device_get(params))
+    logits = dense.apply({"params": merged}, cat, num, train=False)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_pp_config_validation():
+    from mlops_tpu.config import ModelConfig
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    with pytest.raises(ValueError, match="depth"):
+        make_pp_train_step(
+            ModelConfig(**{**model_config.__dict__, "depth": 3}),
+            train_config,
+            mesh,
+        )
+    with pytest.raises(ValueError, match="dropout"):
+        make_pp_train_step(
+            ModelConfig(**{**model_config.__dict__, "dropout": 0.1}),
+            train_config,
+            mesh,
+        )
+    with pytest.raises(ValueError, match="stage"):
+        make_pp_train_step(model_config, train_config, make_nd_mesh({"data": 8}))
+
+
+def test_run_layout_training_pp_trains_and_packages_servable_bundle(tmp_path):
+    """`train` on a pipeline_stages config must produce a NORMAL servable
+    bert bundle: PP-trained stage-stacked params merge back to the dense
+    tree and flow through the standard packaging tail."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    config = Config()
+    config.data.rows = 2000
+    config.model = ModelConfig(
+        family="bert", token_dim=16, depth=4, heads=2, dropout=0.0,
+        precision="f32", pipeline_stages=4,
+    )
+    config.train.batch_size = 32
+    config.train.steps = 6
+    config.train.eval_every = 3
+    config.train.warmup_steps = 2
+    config.train.pipeline_microbatches = 4
+    config.train.distill_bulk = False  # keep the test lean
+    config.registry.run_root = str(tmp_path / "runs")
+    config.registry.root = str(tmp_path / "registry")
+    result = run_layout_training(config)
+
+    assert result.model_uri and result.bundle_dir is not None
+    assert (result.run_dir / "metrics.jsonl").exists()
+    assert "validation_roc_auc_score" in result.train_result.metrics
+    bundle = load_bundle(result.bundle_dir)
+    cat = np.zeros((4, SCHEMA.num_categorical), np.int32)
+    num = np.zeros((4, SCHEMA.num_numeric), np.float32)
+    logits = bundle.model.apply(bundle.variables, cat, num, train=False)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
+    """`train` on a doc_records+seq_parallel config runs the ring trainer
+    end-to-end and saves params + metrics (document models have no
+    single-record serving artifact)."""
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    config = Config()
+    config.data.rows = 1200
+    config.model = ModelConfig(
+        family="bert", token_dim=16, depth=1, heads=2, dropout=0.0,
+        precision="f32", doc_records=3, seq_parallel=True,
+    )
+    config.train.batch_size = 8
+    config.train.steps = 4
+    config.train.eval_every = 2
+    config.registry.run_root = str(tmp_path / "runs")
+    result = run_layout_training(config)
+
+    assert result.bundle_dir is None and result.model_uri is None
+    assert (result.run_dir / "doc_params.msgpack").exists()
+    assert (result.run_dir / "metrics.jsonl").exists()
+    assert "validation_roc_auc_score" in result.train_result.metrics
+
+
+def test_run_training_rejects_multidevice_layout_knobs():
+    """The dense entrypoint must fail LOUDLY on layout knobs it does not
+    implement — a shipped pipeline/long-context config routed through
+    `train` must not silently train a plain dense model."""
+    from mlops_tpu.config import Config
+    from mlops_tpu.train.pipeline import run_training
+
+    for knob, value in (
+        ("pipeline_stages", 4),
+        ("seq_parallel", True),
+        ("doc_records", 11),
+    ):
+        config = Config()
+        setattr(config.model, knob, value)
+        with pytest.raises(ValueError, match="dedicated trainers"):
+            run_training(config, register=False)
