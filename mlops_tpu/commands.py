@@ -88,6 +88,12 @@ def _pretrain(config) -> int:
 
     if config.model.family != "bert":
         raise SystemExit("pretrain supports model.family=bert")
+    if config.model.uses_layout_trainer:
+        raise SystemExit(
+            "pretrain runs the dense single-record masked-LM; unset the "
+            "layout knobs (model.pipeline_stages / seq_parallel / "
+            "doc_records>1)"
+        )
     if config.data.train_path:
         columns, _ = load_table_columns(config.data.train_path)
     else:

@@ -364,6 +364,8 @@ def _run_pp_training(
         dataclasses.replace(config.model, pipeline_stages=0)
     )
     tcfg = config.train
+    # fit() tolerates eval_every=0 (clamps to a window); mirror that here.
+    eval_every = max(1, min(tcfg.eval_every, tcfg.steps))
     params, opt_state = trainer.params, trainer.opt_state
     history: list[dict] = []
     merged = None
@@ -379,7 +381,7 @@ def _run_pp_training(
                 jnp.asarray(train_ds.numeric[idx]),
                 jnp.asarray(train_ds.labels[idx]),
             )
-            if step % tcfg.eval_every == 0 or step == tcfg.steps:
+            if step % eval_every == 0 or step == tcfg.steps:
                 merged = merge_bert_params(jax.device_get(params))
                 metrics = evaluate(dense_model, merged, valid_ds)
                 record = {"step": step, "loss": round(float(loss), 6), **metrics}
@@ -481,6 +483,7 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
         metrics = binary_metrics(logits, jnp.asarray(vlab))
         return {f"validation_{k}_score": round(float(v), 6) for k, v in metrics.items()}
 
+    eval_every = max(1, min(tcfg.eval_every, tcfg.steps))  # as in fit()
     params, opt_state = trainer.params, trainer.opt_state
     history: list[dict] = []
     with JsonlWriter(run_dir / "metrics.jsonl") as writer:
@@ -495,7 +498,7 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
                 jnp.asarray(dnum[idx]),
                 jnp.asarray(dlab[idx]),
             )
-            if step % tcfg.eval_every == 0 or step == tcfg.steps:
+            if step % eval_every == 0 or step == tcfg.steps:
                 record = {
                     "step": step,
                     "loss": round(float(loss), 6),
@@ -541,6 +544,15 @@ def run_tuning(
         raise ValueError(
             "sklearn baseline families (gbm/rf) train via `train`; the "
             "vmapped/sharded `tune` sweep applies to the Flax families only"
+        )
+    if config.model.uses_layout_trainer:
+        # Same loud guard as run_training: the sweep trains dense models,
+        # so a layout knob left set would silently drop the requested
+        # parallelism from every trial.
+        raise ValueError(
+            "`tune` sweeps dense single-record models; layout knobs "
+            "(model.pipeline_stages / seq_parallel / doc_records>1) train "
+            "via `train` -> run_layout_training"
         )
 
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S") + "-tune"

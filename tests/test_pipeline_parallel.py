@@ -283,6 +283,8 @@ def test_run_training_rejects_multidevice_layout_knobs():
     from mlops_tpu.config import Config
     from mlops_tpu.train.pipeline import run_training
 
+    from mlops_tpu.train.pipeline import run_layout_training, run_tuning
+
     for knob, value in (
         ("pipeline_stages", 4),
         ("seq_parallel", True),
@@ -292,3 +294,10 @@ def test_run_training_rejects_multidevice_layout_knobs():
         setattr(config.model, knob, value)
         with pytest.raises(ValueError, match="dedicated trainers"):
             run_training(config, register=False)
+        # The sweep trains dense models too — same loud rejection.
+        with pytest.raises(ValueError, match="layout knobs"):
+            run_tuning(config, register=False)
+    # And the mirror: a dense config must not silently route to the
+    # layout trainer (doc_records=1 would train 1-record "documents").
+    with pytest.raises(ValueError, match="layout knob"):
+        run_layout_training(Config(), register=False)
