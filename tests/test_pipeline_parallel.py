@@ -194,6 +194,44 @@ def test_pp_split_merge_roundtrip_and_packaging_parity():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_pp_stage_params_shard_one_stage_per_device():
+    """The memory claim behind PP: stage-stacked leaves shard their
+    leading axis over 'stage' (each device holds depth/S blocks), the
+    optimizer state inherits the layout, and a train step preserves it."""
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    trainer = make_pp_train_step(model_config, train_config, mesh)
+
+    def leading_spec(leaf):
+        return leaf.sharding.spec[0] if leaf.sharding.spec else None
+
+    stage_leaf = jax.tree.leaves(trainer.params["stages"])[0]
+    assert leading_spec(stage_leaf) == "stage"
+    assert stage_leaf.addressable_data(0).shape[0] == 1  # one stage/device
+    # adamw's mu/nu mirror the param layout (optax init preserves
+    # sharding): check exactly the 'stages' subtrees, found structurally.
+    adam_stage_leaves = []
+
+    def visit(state):
+        if hasattr(state, "mu"):
+            adam_stage_leaves.extend(jax.tree.leaves(state.mu["stages"]))
+            adam_stage_leaves.extend(jax.tree.leaves(state.nu["stages"]))
+        elif isinstance(state, (tuple, list)):
+            for sub in state:
+                visit(sub)
+
+    visit(trainer.opt_state)
+    assert adam_stage_leaves  # the walk must actually find the adam state
+    for leaf in adam_stage_leaves:
+        assert leading_spec(leaf) == "stage", leaf.shape
+
+    cat, num, lab = _pp_batch(train_config.batch_size)
+    params, _, _ = trainer.step_fn(trainer.params, trainer.opt_state, cat, num, lab)
+    assert leading_spec(jax.tree.leaves(params["stages"])[0]) == "stage"
+
+
 def test_pp_config_validation():
     from mlops_tpu.config import ModelConfig
     from mlops_tpu.train.pipeline_parallel import make_pp_train_step
