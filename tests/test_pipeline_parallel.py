@@ -287,6 +287,15 @@ def test_run_layout_training_pp_trains_and_packages_servable_bundle(tmp_path):
     num = np.zeros((4, SCHEMA.num_numeric), np.float32)
     logits = bundle.model.apply(bundle.variables, cat, num, train=False)
     assert np.isfinite(np.asarray(logits)).all()
+    # ...and through the REAL serving path: engine encode -> fused
+    # classifier+drift+outlier -> reference response contract.
+    from mlops_tpu.schema import LoanApplicant
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    engine = InferenceEngine(bundle, buckets=(1,), enable_grouping=False)
+    response = engine.predict_records([LoanApplicant().model_dump()])
+    assert set(response) == {"predictions", "outliers", "feature_drift_batch"}
+    assert 0.0 <= response["predictions"][0] <= 1.0
 
 
 def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
