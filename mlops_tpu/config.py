@@ -100,6 +100,11 @@ class TrainConfig:
     # pipeline-parallel path (model.pipeline_stages > 0): bubble fraction
     # is (S-1)/(M+S-1), so raise M to amortize; batch_size must divide by
     # it (times the 'data' axis when composing DP x PP)
+    pipeline_remat: bool = False  # jax.checkpoint around each stage:
+    # recompute the stage's INTERNAL block activations (attention/MLP
+    # intermediates x layers-per-stage, the dominant backward-memory term
+    # at depth) from the stage-boundary input instead of storing them;
+    # the boundary inputs themselves stay stored (the scan needs them)
     ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
     # params (EMA folded into the compiled scan; eval/packaging use the
     # debiased average, raw params keep training). 0 disables. Applies to

@@ -211,6 +211,15 @@ def make_pp_train_step(
             )
         return h
 
+    if train_config.pipeline_remat:
+        # Drop the INTERNAL activations of each stage's L blocks
+        # (attention scores, MLP intermediates — the L x internals term
+        # that dominates at depth) and recompute them on backward from the
+        # stage-boundary input, which the scan must keep either way.
+        # prevent_cse=False: the barrier CSE protection is unnecessary —
+        # and fusion-hostile — when the checkpointed fn runs under scan.
+        stage_fn = jax.checkpoint(stage_fn, prevent_cse=False)
+
     batch_axis = "data" if "data" in mesh.axis_names else None
     pipeline = make_pipeline(mesh, stage_fn, batch_axis=batch_axis)
 

@@ -194,6 +194,34 @@ def test_pp_split_merge_roundtrip_and_packaging_parity():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_pp_remat_changes_nothing_numerically():
+    """train.pipeline_remat recomputes stage activations on backward
+    (jax.checkpoint) — one step must produce the same params as without."""
+    import dataclasses
+
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    cat, num, lab = _pp_batch(train_config.batch_size)
+    results = []
+    for remat in (False, True):
+        trainer = make_pp_train_step(
+            model_config,
+            dataclasses.replace(train_config, pipeline_remat=remat),
+            mesh,
+            seed=11,
+        )
+        params, _, loss = trainer.step_fn(
+            trainer.params, trainer.opt_state, cat, num, lab
+        )
+        results.append((jax.device_get(params), float(loss)))
+    (p0, l0), (p1, l1) = results
+    assert abs(l0 - l1) < 1e-6
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_pp_stage_params_shard_one_stage_per_device():
     """The memory claim behind PP: stage-stacked leaves shard their
     leading axis over 'stage' (each device holds depth/S blocks), the
