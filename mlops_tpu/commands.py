@@ -56,13 +56,14 @@ def _synth(config) -> int:
 def _train(config) -> int:
     from mlops_tpu.train.pipeline import run_layout_training, run_training
 
+    run_name = config.registry.run_name or None
     if config.model.uses_layout_trainer:
         # Multi-device training layouts (GPipe / ring-attention documents)
         # run through their dedicated trainers on a mesh built from the
         # available devices (train/pipeline.py run_layout_training).
-        result = run_layout_training(config)
+        result = run_layout_training(config, run_name=run_name)
     else:
-        result = run_training(config)
+        result = run_training(config, run_name=run_name)
     print(
         json.dumps(
             {
@@ -128,7 +129,9 @@ def _tune(config) -> int:
     # Shard the trial axis across every available chip; single-device runs
     # (laptops, 1-chip CI) skip the mesh and train trials vmapped in-place.
     mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
-    result, hpo_result = run_tuning(config, mesh=mesh)
+    result, hpo_result = run_tuning(
+        config, run_name=config.registry.run_name or None, mesh=mesh
+    )
     print(
         json.dumps(
             {

@@ -174,6 +174,11 @@ class RegistryConfig:
     experiment_name: str = "credit-default-uci-train"  # parity: parent
     # MLflow run name (`01-train-model.ipynb` cell 8)
     run_root: str = "runs"  # per-run artifacts: metrics.jsonl, checkpoints
+    run_name: str = ""  # stable run-directory name: a retried/preempted
+    # job that passes the same name (e.g. the K8s ${JOB_NAME}) lands in
+    # the same <run_root>/<run_name> and RESUMES from its checkpoints —
+    # provided run_root is on storage that survives the pod. Empty = a
+    # fresh timestamped directory per invocation.
     promote_version: str = ""  # `promote` CLI: version to move
     promote_stage: str = "staging"  # `promote` CLI: target stage
     gc_keep: int = 0  # `gc` CLI: also prune old unstaged versions beyond

@@ -328,11 +328,107 @@ def run_layout_training(
     return _run_doc_training(config, run_dir, train_ds, valid_ds)
 
 
-def _sample_batches(n_rows: int, batch: int, steps: int, seed: int):
-    """Step-indexed minibatch indices (with-replacement sampling)."""
-    rng = np.random.default_rng(seed)
-    for _ in range(steps):
-        yield rng.integers(0, n_rows, batch)
+def _journal_max_step(path: Path) -> int:
+    """Highest step already recorded in a metrics.jsonl (0 when absent):
+    a resumed run must not append duplicate rows for eval steps that were
+    journaled after the checkpoint it restored from. Bad lines are
+    skipped per-line — a write truncated by the preemption itself must
+    not blind the scan to the intact records before it."""
+    import json
+
+    best = 0
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return 0
+    for line in lines:
+        try:
+            best = max(best, int(json.loads(line)["step"]))
+        except (ValueError, KeyError, TypeError):
+            continue
+    return best
+
+
+def _batch_indices(n_rows: int, batch: int, seed: int, step: int) -> np.ndarray:
+    """Minibatch indices for ONE step, seeded by (seed, step): the data
+    order is a pure function of the step counter, so a checkpoint-resumed
+    run sees exactly the batches the preempted run would have."""
+    return np.random.default_rng((seed, step)).integers(0, n_rows, batch)
+
+
+def _layout_run_setup(tcfg, run_dir: Path, trainer):
+    """The shared resume preamble for both layout loops: eval/checkpoint
+    cadences (checkpoint_every=0 falls back to the eval window, as in
+    ``fit``), state restore from the newest checkpoint, and the journal
+    floor that suppresses duplicate metric rows on resume."""
+    eval_every = max(1, min(tcfg.eval_every, tcfg.steps))
+    ckpt_every = max(1, tcfg.checkpoint_every or eval_every)
+    ckpt_dir = run_dir / "checkpoints"
+    params, opt_state, start_step = _restore_layout_state(
+        ckpt_dir, trainer.params, trainer.opt_state
+    )
+    journal_floor = _journal_max_step(run_dir / "metrics.jsonl")
+    return eval_every, ckpt_every, ckpt_dir, params, opt_state, start_step, journal_floor
+
+
+def _maybe_checkpoint(ckpt_dir, params, opt_state, step, ckpt_every, steps):
+    from mlops_tpu.train.checkpoint import save_checkpoint
+
+    if step % ckpt_every == 0 or step == steps:
+        save_checkpoint(
+            ckpt_dir,
+            jax.device_get({"params": params, "opt_state": opt_state}),
+            step,
+        )
+
+
+def _final_validation_metrics(history, steps, fallback):
+    """The loop's last eval IS the final metric set on any run that
+    reached the step budget; ``fallback`` covers the zero-iteration
+    resume (checkpoint already at/past the budget)."""
+    if history and history[-1]["step"] == steps:
+        return {
+            k: v for k, v in history[-1].items() if k.startswith("validation_")
+        }
+    return fallback()
+
+
+def _restore_layout_state(ckpt_dir, params, opt_state):
+    """Resume {params, opt_state} from the newest checkpoint, re-placing
+    host arrays onto each template leaf's sharding (stage-sharded PP
+    leaves included). Returns (params, opt_state, start_step)."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from mlops_tpu.train.checkpoint import load_checkpoint
+
+    ckpt_dir = Path(ckpt_dir)
+    if not (ckpt_dir / "latest.json").exists() and not any(
+        ckpt_dir.glob("ckpt_*.msgpack")
+    ):
+        # Fresh start (the common case): skip building the host template —
+        # it would device_get params + the 2x-sized adam state for nothing.
+        return params, opt_state, 0
+    template = jax.device_get({"params": params, "opt_state": opt_state})
+    loaded = load_checkpoint(ckpt_dir, template)
+    if loaded is None:
+        return params, opt_state, 0
+    host_state, step = loaded
+
+    def put(t, h):
+        # Mesh-laid-out leaves (params, adam mu/nu) go back to their
+        # NamedSharding; scalar counters etc. stay UNCOMMITTED like
+        # optax's own init leaves them — committing those to one device
+        # would conflict with the mesh-committed arguments under jit.
+        if isinstance(t.sharding, NamedSharding):
+            return jax.device_put(h, t.sharding)
+        return jnp.asarray(h)
+
+    return (
+        jax.tree.map(put, params, host_state["params"]),
+        jax.tree.map(put, opt_state, host_state["opt_state"]),
+        step,
+    )
 
 
 def _run_pp_training(
@@ -364,16 +460,20 @@ def _run_pp_training(
         dataclasses.replace(config.model, pipeline_stages=0)
     )
     tcfg = config.train
-    # fit() tolerates eval_every=0 (clamps to a window); mirror that here.
-    eval_every = max(1, min(tcfg.eval_every, tcfg.steps))
-    params, opt_state = trainer.params, trainer.opt_state
+    (
+        eval_every,
+        ckpt_every,
+        ckpt_dir,
+        params,
+        opt_state,
+        start_step,
+        journal_floor,
+    ) = _layout_run_setup(tcfg, run_dir, trainer)
     history: list[dict] = []
     merged = None
     with JsonlWriter(run_dir / "metrics.jsonl") as writer:
-        for step, idx in enumerate(
-            _sample_batches(train_ds.n, tcfg.batch_size, tcfg.steps, tcfg.seed),
-            start=1,
-        ):
+        for step in range(start_step + 1, tcfg.steps + 1):
+            idx = _batch_indices(train_ds.n, tcfg.batch_size, tcfg.seed, step)
             params, opt_state, loss = trainer.step_fn(
                 params,
                 opt_state,
@@ -385,10 +485,19 @@ def _run_pp_training(
                 merged = merge_bert_params(jax.device_get(params))
                 metrics = evaluate(dense_model, merged, valid_ds)
                 record = {"step": step, "loss": round(float(loss), 6), **metrics}
-                writer.write(record)
+                if step > journal_floor:  # no duplicate rows on resume
+                    writer.write(record)
                 history.append(record)
+            _maybe_checkpoint(
+                ckpt_dir, params, opt_state, step, ckpt_every, tcfg.steps
+            )
 
-    final = {k: v for k, v in history[-1].items() if k.startswith("validation_")}
+    def fresh_eval():
+        nonlocal merged
+        merged = merge_bert_params(jax.device_get(params))
+        return evaluate(dense_model, merged, valid_ds)
+
+    final = _final_validation_metrics(history, tcfg.steps, fresh_eval)
     result = TrainResult(
         params=merged,
         metrics=final,
@@ -483,14 +592,19 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
         metrics = binary_metrics(logits, jnp.asarray(vlab))
         return {f"validation_{k}_score": round(float(v), 6) for k, v in metrics.items()}
 
-    eval_every = max(1, min(tcfg.eval_every, tcfg.steps))  # as in fit()
-    params, opt_state = trainer.params, trainer.opt_state
+    (
+        eval_every,
+        ckpt_every,
+        ckpt_dir,
+        params,
+        opt_state,
+        start_step,
+        journal_floor,
+    ) = _layout_run_setup(tcfg, run_dir, trainer)
     history: list[dict] = []
     with JsonlWriter(run_dir / "metrics.jsonl") as writer:
-        for step, idx in enumerate(
-            _sample_batches(dcat.shape[0], batch, tcfg.steps, tcfg.seed),
-            start=1,
-        ):
+        for step in range(start_step + 1, tcfg.steps + 1):
+            idx = _batch_indices(dcat.shape[0], batch, tcfg.seed, step)
             params, opt_state, loss = trainer.step_fn(
                 params,
                 opt_state,
@@ -504,12 +618,18 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
                     "loss": round(float(loss), 6),
                     **doc_eval(params),
                 }
-                writer.write(record)
+                if step > journal_floor:  # no duplicate rows on resume
+                    writer.write(record)
                 history.append(record)
+            _maybe_checkpoint(
+                ckpt_dir, params, opt_state, step, ckpt_every, tcfg.steps
+            )
 
     params_host = jax.device_get(params)
     atomic_write(run_dir / "doc_params.msgpack", tree_bytes(params_host))
-    final = {k: v for k, v in history[-1].items() if k.startswith("validation_")}
+    final = _final_validation_metrics(
+        history, tcfg.steps, lambda: doc_eval(params)
+    )
     result = TrainResult(
         params=params_host,
         metrics=final,

@@ -326,6 +326,63 @@ def test_run_layout_training_pp_trains_and_packages_servable_bundle(tmp_path):
     assert 0.0 <= response["predictions"][0] <= 1.0
 
 
+def test_run_layout_training_pp_resumes_from_checkpoint(tmp_path):
+    """Preemption elasticity for layout runs (SURVEY §5.4): a second
+    invocation of the same run resumes from the newest checkpoint instead
+    of restarting at step 1, and a fully-complete run re-invoked runs
+    zero steps but still packages."""
+    import json
+
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    def make_config(steps):
+        config = Config()
+        config.data.rows = 1500
+        config.model = ModelConfig(
+            family="bert", token_dim=16, depth=4, heads=2, dropout=0.0,
+            precision="f32", pipeline_stages=4,
+        )
+        config.train.batch_size = 16
+        config.train.steps = steps
+        config.train.eval_every = 100  # evals only at the final step
+        config.train.warmup_steps = 2
+        config.train.checkpoint_every = 2
+        config.train.pipeline_microbatches = 4
+        config.train.distill_bulk = False
+        config.registry.run_root = str(tmp_path / "runs")
+        config.registry.root = str(tmp_path / "registry")
+        return config
+
+    run_layout_training(make_config(2), register=False, run_name="resume-me")
+    ckpt_dir = tmp_path / "runs" / "resume-me" / "checkpoints"
+    assert json.loads((ckpt_dir / "latest.json").read_text())["step"] == 2
+
+    # Resume with a larger budget: continues 3..4, not 1..4.
+    result = run_layout_training(
+        make_config(4), register=False, run_name="resume-me"
+    )
+    assert json.loads((ckpt_dir / "latest.json").read_text())["step"] == 4
+    # metrics.jsonl appends across the preemption: run 1's final eval
+    # (step 2) plus the resumed run's (step 4) — and NO re-trained steps
+    # 1..2 records, which a fresh restart would have written again.
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "runs" / "resume-me" / "metrics.jsonl")
+        .read_text()
+        .splitlines()
+    ]
+    assert [rec["step"] for rec in lines] == [2, 4]
+    assert result.bundle_dir is not None
+
+    # Re-invoking the finished run trains zero steps and still packages.
+    again = run_layout_training(
+        make_config(4), register=False, run_name="resume-me"
+    )
+    assert again.bundle_dir is not None
+    assert "validation_roc_auc_score" in again.train_result.metrics
+
+
 def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
     """`train` on a doc_records+seq_parallel config runs the ring trainer
     end-to-end and saves params + metrics (document models have no
