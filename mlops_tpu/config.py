@@ -53,7 +53,8 @@ class ModelConfig:
     # axis — the training path is `train/long_context.py`.
     doc_records: int = 1
     seq_parallel: bool = False
-    # Pipeline parallelism (family bert): split the `depth` encoder blocks
+    # Pipeline parallelism (families bert / ft_transformer): split the
+    # `depth` encoder blocks
     # into `pipeline_stages` GPipe stages over the mesh's 'stage' axis
     # (`train/pipeline_parallel.py`); microbatches stream through the
     # ppermute ring (`parallel/pipeline.py`). 0 = off. Requires

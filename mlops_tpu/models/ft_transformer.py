@@ -97,6 +97,15 @@ class TransformerBlock(nn.Module):
         return x + h
 
 
+def apply_ft_head(mod: nn.Module, x: jnp.ndarray, dtype: jnp.dtype) -> jnp.ndarray:
+    """The FT read-out (ln_final on CLS → head logit), factored so the
+    pipeline-parallel split (`train/pipeline_parallel.py`) produces a
+    byte-compatible param tree."""
+    cls = nn.LayerNorm(dtype=dtype, name="ln_final")(x[:, 0])
+    logit = nn.Dense(1, dtype=dtype, name="head")(cls)
+    return logit[:, 0].astype(jnp.float32)
+
+
 class FTTransformer(nn.Module):
     cards: Sequence[int]
     num_numeric: int
@@ -110,8 +119,15 @@ class FTTransformer(nn.Module):
     def __call__(
         self, cat_ids: jnp.ndarray, numeric: jnp.ndarray, *, train: bool = False
     ) -> jnp.ndarray:
+        # Name pinned explicitly: it is a cross-file contract — the
+        # pipeline-parallel split slices the dense tree by this key
+        # (`train/pipeline_parallel.py` _FAMILY_SPLITS).
         tokens = FeatureTokenizer(
-            self.cards, self.num_numeric, self.token_dim, dtype=self.dtype
+            self.cards,
+            self.num_numeric,
+            self.token_dim,
+            dtype=self.dtype,
+            name="FeatureTokenizer_0",
         )(cat_ids, numeric)
         for i in range(self.depth):
             tokens = TransformerBlock(
@@ -121,6 +137,4 @@ class FTTransformer(nn.Module):
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(tokens, train=train)
-        cls = nn.LayerNorm(dtype=self.dtype, name="ln_final")(tokens[:, 0])
-        logit = nn.Dense(1, dtype=self.dtype, name="head")(cls)
-        return logit[:, 0].astype(jnp.float32)
+        return apply_ft_head(self, tokens, self.dtype)

@@ -1,19 +1,20 @@
-"""Pipeline-parallel training: GPipe over the BERT trunk as a REAL config.
+"""Pipeline-parallel training: GPipe over transformer trunks as a REAL config.
 
 SURVEY §2.7's pipeline-parallel obligation, made load-bearing the same way
 `train/long_context.py` did for sequence parallelism: a training
-configuration (``model.family=bert model.pipeline_stages=S``) splits the
-encoder's ``depth`` blocks into S GPipe stages over the mesh's 'stage'
-axis and streams ``train.pipeline_microbatches`` microbatches through the
+configuration (``model.pipeline_stages=S`` on a TransformerBlock-trunk
+family — bert or ft_transformer) splits the encoder's ``depth`` blocks
+into S GPipe stages over the mesh's 'stage' axis and streams
+``train.pipeline_microbatches`` microbatches through the
 ppermute ring (`parallel/pipeline.py`). Composes with data parallelism:
 on a ``('data','stage')`` mesh the microbatch batch dim shards over
 'data' while activations hand off stage-to-stage over 'stage'.
 
-The stage-stacked parameters are exactly the dense ``BertEncoder``'s
-``block_i`` subtrees stacked on a leading ``[S, L, ...]`` axis
-(L = depth // S layers per stage), so a PP-trained model converts
-losslessly back to the dense param tree (``merge_bert_params``) and
-packages/serves like any other bert bundle — pipeline parallelism is a
+The stage-stacked parameters are exactly the dense model's ``block_i``
+subtrees stacked on a leading ``[S, L, ...]`` axis (L = depth // S
+layers per stage), so a PP-trained model converts losslessly back to
+the dense param tree (``merge_trunk_params``) and packages/serves like
+any other bundle of its family — pipeline parallelism is a
 training-time layout, not a different model. Equivalence with the dense
 forward pass and trainability are pinned by
 ``tests/test_pipeline_parallel.py``; the multi-device step runs in
@@ -43,7 +44,11 @@ from mlops_tpu.models.bert import (
     apply_embed_front,
     tokenize,
 )
-from mlops_tpu.models.ft_transformer import TransformerBlock
+from mlops_tpu.models.ft_transformer import (
+    FeatureTokenizer,
+    TransformerBlock,
+    apply_ft_head,
+)
 from mlops_tpu.parallel.pipeline import make_pipeline
 from mlops_tpu.schema.features import SCHEMA
 from mlops_tpu.train.loop import make_optimizer, sigmoid_bce, warn_ema_unsupported
@@ -85,14 +90,59 @@ class BertPPHead(nn.Module):
         return apply_cls_head(self, x, self.hidden, self.dtype)
 
 
-_EMBED_KEYS = ("tok_embed", "pos_embed", "ln_embed")
-_HEAD_KEYS = ("ln_final", "pooler", "head")
+class FTPPEmbed(nn.Module):
+    """The dense ``FTTransformer``'s feature tokenizer as the PP front —
+    the SAME ``FeatureTokenizer`` module under its auto-assigned dense
+    name, so the param tree is a verbatim slice of the dense tree."""
+
+    cards: tuple[int, ...]
+    num_numeric: int
+    token_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, cat_ids: jnp.ndarray, numeric: jnp.ndarray) -> jnp.ndarray:
+        return FeatureTokenizer(
+            tuple(self.cards),
+            self.num_numeric,
+            self.token_dim,
+            dtype=self.dtype,
+            name="FeatureTokenizer_0",
+        )(cat_ids, numeric)
 
 
-def split_bert_params(dense: dict, stages: int) -> dict:
-    """Dense ``BertEncoder`` param tree → the PP layout:
+class FTPPHead(nn.Module):
+    """The dense ``FTTransformer``'s read-out, via the shared
+    ``apply_ft_head`` helper (`models/ft_transformer.py`)."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return apply_ft_head(self, x, self.dtype)
+
+
+# Per-family trunk split: which top-level keys of the dense param tree
+# belong to the (replicated) embedding front and read-out; everything
+# block_i in between stage-stacks. PP supports exactly the families whose
+# depth is a run of identical TransformerBlocks.
+_FAMILY_SPLITS = {
+    "bert": (
+        ("tok_embed", "pos_embed", "ln_embed"),
+        ("ln_final", "pooler", "head"),
+    ),
+    "ft_transformer": (
+        ("FeatureTokenizer_0",),
+        ("ln_final", "head"),
+    ),
+}
+
+
+def split_trunk_params(dense: dict, stages: int, family: str = "bert") -> dict:
+    """Dense param tree → the PP layout:
     ``{"embed": ..., "stages": [S, L, ...]-stacked blocks, "head": ...}``.
     """
+    embed_keys, head_keys = _FAMILY_SPLITS[family]
     depth = sum(1 for k in dense if k.startswith("block_"))
     if depth == 0 or depth % stages:
         raise ValueError(f"depth {depth} not divisible into {stages} stages")
@@ -103,15 +153,20 @@ def split_bert_params(dense: dict, stages: int) -> dict:
         for s in range(stages)
     ]
     return {
-        "embed": {k: dense[k] for k in _EMBED_KEYS},
+        "embed": {k: dense[k] for k in embed_keys},
         "stages": jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage),
-        "head": {k: dense[k] for k in _HEAD_KEYS},
+        "head": {k: dense[k] for k in head_keys},
     }
 
 
-def merge_bert_params(pp: dict) -> dict:
-    """Inverse of ``split_bert_params``: reassemble the dense tree so a
-    PP-trained model packages/serves as a normal bert bundle."""
+def split_bert_params(dense: dict, stages: int) -> dict:
+    return split_trunk_params(dense, stages, "bert")
+
+
+def merge_trunk_params(pp: dict) -> dict:
+    """Inverse of ``split_trunk_params`` (family-agnostic: the embed/head
+    subtrees carry their own keys): reassemble the dense tree so a
+    PP-trained model packages/serves as a normal bundle."""
     leaves = jax.tree.leaves(pp["stages"])
     stages, layers = leaves[0].shape[0], leaves[0].shape[1]
     dense = {**pp["embed"], **pp["head"]}
@@ -120,6 +175,9 @@ def merge_bert_params(pp: dict) -> dict:
             lambda a, i=i: a[i // layers, i % layers], pp["stages"]
         )
     return dense
+
+
+merge_bert_params = merge_trunk_params  # bert-era name, same function
 
 
 @dataclasses.dataclass
@@ -138,18 +196,22 @@ def make_pp_train_step(
     mesh: Mesh,
     seed: int = 0,
 ) -> PPTrainStep:
-    """One jitted (DP×)PP train step over the tabular BERT.
+    """One jitted (DP×)PP train step over a TransformerBlock-trunk family
+    (bert or ft_transformer — `_FAMILY_SPLITS`).
 
     The 'stage' mesh axis carries the encoder blocks (each device holds
     depth/S of them); 'data', when present, shards the microbatch batch
     dim. Params start from the SAME init as the dense model (split via
-    ``split_bert_params``) and train under the SAME optimizer
+    ``split_trunk_params``) and train under the SAME optimizer
     (``loop.make_optimizer``: global-norm clip + warmup-cosine); the
-    forward pass equals the dense model's exactly (pinned by
-    ``test_pp_bert_forward_matches_dense``).
+    forward pass equals the dense model's exactly (pinned per family by
+    ``test_pp_forward_matches_dense``).
     """
-    if model_config.family != "bert":
-        raise ValueError("pipeline_stages currently applies to family=bert")
+    if model_config.family not in _FAMILY_SPLITS:
+        raise ValueError(
+            "pipeline_stages applies to the TransformerBlock-trunk "
+            f"families {tuple(_FAMILY_SPLITS)}, not {model_config.family!r}"
+        )
     if "stage" not in mesh.axis_names:
         raise ValueError(
             "model.pipeline_stages needs a mesh with a 'stage' axis "
@@ -187,15 +249,26 @@ def make_pp_train_step(
     dense_variables = init_params(
         build_model(model_config), jax.random.PRNGKey(seed)
     )
-    pp_params = split_bert_params(dense_variables["params"], stages)
-
-    embed_mod = BertPPEmbed(
-        cards=tuple(SCHEMA.cards),
-        num_numeric=SCHEMA.num_numeric,
-        hidden=model_config.token_dim,
-        dtype=dtype,
+    pp_params = split_trunk_params(
+        dense_variables["params"], stages, model_config.family
     )
-    head_mod = BertPPHead(hidden=model_config.token_dim, dtype=dtype)
+
+    if model_config.family == "bert":
+        embed_mod = BertPPEmbed(
+            cards=tuple(SCHEMA.cards),
+            num_numeric=SCHEMA.num_numeric,
+            hidden=model_config.token_dim,
+            dtype=dtype,
+        )
+        head_mod = BertPPHead(hidden=model_config.token_dim, dtype=dtype)
+    else:  # ft_transformer
+        embed_mod = FTPPEmbed(
+            cards=tuple(SCHEMA.cards),
+            num_numeric=SCHEMA.num_numeric,
+            token_dim=model_config.token_dim,
+            dtype=dtype,
+        )
+        head_mod = FTPPHead(dtype=dtype)
     block = TransformerBlock(
         heads=model_config.heads,
         token_dim=model_config.token_dim,

@@ -90,11 +90,11 @@ def test_pipeline_with_dp_sharded_batch():
 # ------------------------- product path: train/pipeline_parallel.py ------
 
 
-def _pp_configs(depth=4, batch=32, micro=4):
+def _pp_configs(depth=4, batch=32, micro=4, family="bert"):
     from mlops_tpu.config import ModelConfig, TrainConfig
 
     model = ModelConfig(
-        family="bert",
+        family=family,
         token_dim=32,
         depth=depth,
         heads=4,
@@ -125,17 +125,18 @@ def _pp_batch(n, seed=0):
     return cat, num, lab
 
 
-def test_pp_bert_forward_matches_dense():
+@pytest.mark.parametrize("family", ["bert", "ft_transformer"])
+def test_pp_forward_matches_dense(family):
     """The PP forward (embed → staged pipeline → head) must equal the
-    dense BertEncoder on the SAME params — pipeline parallelism is a
-    layout, not a different model."""
+    dense model on the SAME params — pipeline parallelism is a layout,
+    not a different model — for every supported trunk family."""
     from mlops_tpu.models import build_model, init_params
     from mlops_tpu.train.pipeline_parallel import (
         make_pp_train_step,
-        split_bert_params,
+        split_trunk_params,
     )
 
-    model_config, train_config = _pp_configs()
+    model_config, train_config = _pp_configs(family=family)
     mesh = make_nd_mesh({"data": 2, "stage": 4})
     trainer = make_pp_train_step(model_config, train_config, mesh, seed=7)
 
@@ -144,7 +145,7 @@ def test_pp_bert_forward_matches_dense():
     cat, num, _ = _pp_batch(train_config.batch_size)
     want = dense.apply(variables, cat, num, train=False)
     got = trainer.forward_fn(
-        split_bert_params(variables["params"], 4), cat, num
+        split_trunk_params(variables["params"], 4, family), cat, num
     )
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
