@@ -245,6 +245,85 @@ def run_hpo(
     )
 
 
+def _group_fingerprint(
+    cfg: ModelConfig, group_hpo: HPOConfig, train_config: TrainConfig, rows: int
+) -> str:
+    """Everything a completed group's cached result is valid for: the FULL
+    group ModelConfig (not just the spec-overridden fields — an edit to a
+    base field like precision or dropout must invalidate too), the sweep
+    shape/seed/objective, the training recipe, and the dataset size."""
+    import json
+
+    return json.dumps(
+        {
+            "model": dataclasses.asdict(cfg),
+            "trials": group_hpo.trials,
+            "steps": group_hpo.steps,
+            "seed": group_hpo.seed,
+            "objective": group_hpo.objective,
+            "train": dataclasses.asdict(train_config),
+            "rows": rows,
+        },
+        sort_keys=True,
+        default=str,
+    )
+
+
+def _save_group_result(resume_dir, g: int, fingerprint: str, res: HPOResult):
+    """Persist a finished group so a retried sweep skips its recompute:
+    JSON record + the winning params as msgpack (restored against a
+    fresh init of the group's architecture)."""
+    import json
+
+    from mlops_tpu.train.checkpoint import tree_bytes
+    from mlops_tpu.utils.io import atomic_write
+
+    directory = resume_dir / "hpo_groups"
+    directory.mkdir(parents=True, exist_ok=True)
+    atomic_write(directory / f"group_{g}.msgpack", tree_bytes(res.best_params))
+    atomic_write(
+        directory / f"group_{g}.json",
+        json.dumps(
+            {
+                "fingerprint": fingerprint,
+                "best_index": res.best_index,
+                "best_hyperparams": res.best_hyperparams,
+                "best_metrics": res.best_metrics,
+                "trials": res.trials,
+            },
+            default=float,
+        ).encode(),
+    )
+
+
+def _load_group_result(resume_dir, g: int, fingerprint: str, cfg: ModelConfig):
+    """Restore a finished group when its fingerprint still matches; None
+    on any mismatch or unreadable/absent file (recompute)."""
+    import json
+
+    from mlops_tpu.models import init_params
+    from mlops_tpu.train.checkpoint import restore_tree
+
+    directory = resume_dir / "hpo_groups"
+    try:
+        meta = json.loads((directory / f"group_{g}.json").read_text())
+        if meta["fingerprint"] != fingerprint:
+            return None
+        template = init_params(build_model(cfg), jax.random.PRNGKey(0))["params"]
+        params = restore_tree(
+            template, (directory / f"group_{g}.msgpack").read_bytes()
+        )
+        return HPOResult(
+            best_index=int(meta["best_index"]),
+            best_hyperparams=meta["best_hyperparams"],
+            best_params=params,
+            best_metrics=meta["best_metrics"],
+            trials=meta["trials"],
+        )
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        return None
+
+
 def run_architecture_hpo(
     model_config: ModelConfig,
     train_config: TrainConfig,
@@ -252,6 +331,7 @@ def run_architecture_hpo(
     train_ds: EncodedDataset,
     valid_ds: EncodedDataset,
     mesh=None,
+    resume_dir=None,
 ) -> tuple[ModelConfig, HPOResult]:
     """Structural axis: loop architecture groups, vmap trials within each.
 
@@ -288,7 +368,21 @@ def run_architecture_hpo(
     merged_trials: list[dict[str, Any]] = []
     for g, (cfg, structural) in enumerate(groups):
         group_hpo = dataclasses.replace(hpo_config, seed=hpo_config.seed + g)
-        res = run_hpo(cfg, train_config, group_hpo, train_ds, valid_ds, mesh=mesh)
+        fingerprint = _group_fingerprint(cfg, group_hpo, train_config, train_ds.n)
+        res = (
+            _load_group_result(resume_dir, g, fingerprint, cfg)
+            if resume_dir is not None
+            else None
+        )
+        if res is None:
+            res = run_hpo(
+                cfg, train_config, group_hpo, train_ds, valid_ds, mesh=mesh
+            )
+            if resume_dir is not None:
+                # Group-granular resume: a retried/preempted sweep (K8s
+                # backoffLimit on the tune Job) recomputes only the
+                # groups that had not finished.
+                _save_group_result(resume_dir, g, fingerprint, res)
         results.append(res)
         for trial in res.trials:
             merged_trials.append(

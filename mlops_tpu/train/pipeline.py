@@ -658,7 +658,7 @@ def run_tuning(
     import json
 
     from mlops_tpu.train.hpo import run_architecture_hpo
-    from mlops_tpu.utils.jsonl import JsonlWriter
+    from mlops_tpu.utils.io import atomic_write
 
     if config.model.family in SKLEARN_FAMILIES:
         raise ValueError(
@@ -689,11 +689,26 @@ def run_tuning(
     # ModelConfig — calibration and the packaged bundle must describe THAT
     # architecture, not the base config's.
     win_model, hpo_result = run_architecture_hpo(
-        config.model, config.train, config.hpo, train_ds, valid_ds, mesh=mesh
+        config.model,
+        config.train,
+        config.hpo,
+        train_ds,
+        valid_ds,
+        mesh=mesh,
+        # Architecture groups persist as they finish; a retried job with a
+        # stable registry.run_name recomputes only unfinished groups.
+        resume_dir=run_dir,
     )
-    with JsonlWriter(run_dir / "trials.jsonl") as writer:
-        for i, trial in enumerate(hpo_result.trials):
-            writer.write({"trial": i, **trial})
+    # Full atomic rewrite, NOT append: the record set always covers every
+    # trial (restored groups included), so appending on a retried run
+    # would duplicate all rows.
+    atomic_write(
+        run_dir / "trials.jsonl",
+        "".join(
+            json.dumps({"trial": i, **trial}, default=float) + "\n"
+            for i, trial in enumerate(hpo_result.trials)
+        ).encode(),
+    )
     (run_dir / "best.json").write_text(
         json.dumps(
             {

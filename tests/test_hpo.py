@@ -1,5 +1,8 @@
 """HPO tests: vmapped trials, mesh-sharded trials, best-trial selection."""
 
+import dataclasses
+
+import jax
 import numpy as np
 import pytest
 
@@ -213,6 +216,66 @@ def test_architecture_sweep_selects_across_groups(splits):
     # Every trial record names its group + architecture.
     assert {t["group"] for t in result.trials} == {0, 1}
     assert all("architecture" in t for t in result.trials)
+
+
+def test_architecture_sweep_resumes_finished_groups(splits, tmp_path, monkeypatch):
+    """Group-granular resume: with a resume_dir, a re-run restores every
+    finished group from disk (run_hpo must NOT be called again) and
+    reproduces the identical selection; a fingerprint change (different
+    sweep budget) invalidates the cache and recomputes."""
+    import mlops_tpu.train.hpo as hpo_mod
+    from mlops_tpu.train.hpo import run_architecture_hpo
+
+    train_ds, valid_ds = splits
+    base = ModelConfig(family="mlp", hidden_dims=(32,), embed_dim=4)
+    hconfig = HPOConfig(
+        trials=2,
+        steps=40,
+        seed=7,
+        architectures=("hidden_dims=16", "hidden_dims=32x16,embed_dim=8"),
+    )
+    tconfig = TrainConfig(batch_size=256)
+    win_cfg, first = run_architecture_hpo(
+        base, tconfig, hconfig, train_ds, valid_ds, resume_dir=tmp_path
+    )
+    assert (tmp_path / "hpo_groups" / "group_1.json").exists()
+
+    def boom(*args, **kwargs):
+        raise AssertionError("run_hpo recomputed a cached group")
+
+    monkeypatch.setattr(hpo_mod, "run_hpo", boom)
+    win_cfg2, second = run_architecture_hpo(
+        base, tconfig, hconfig, train_ds, valid_ds, resume_dir=tmp_path
+    )
+    assert win_cfg2 == win_cfg
+    assert second.best_index == first.best_index
+    assert second.best_hyperparams == first.best_hyperparams
+    for a, b in zip(
+        jax.tree.leaves(first.best_params), jax.tree.leaves(second.best_params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # A changed sweep budget must invalidate the cache (and hit the boom).
+    with pytest.raises(AssertionError, match="recomputed"):
+        run_architecture_hpo(
+            base,
+            tconfig,
+            dataclasses.replace(hconfig, steps=41),
+            train_ds,
+            valid_ds,
+            resume_dir=tmp_path,
+        )
+    # So must an edit to a BASE model field no spec overrides (the
+    # fingerprint hashes the full group config, not just the overrides).
+    with pytest.raises(AssertionError, match="recomputed"):
+        run_architecture_hpo(
+            dataclasses.replace(base, dropout=0.05),
+            tconfig,
+            hconfig,
+            train_ds,
+            valid_ds,
+            resume_dir=tmp_path,
+        )
 
 
 def test_architecture_sweep_empty_is_passthrough(splits):
