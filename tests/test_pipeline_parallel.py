@@ -409,6 +409,22 @@ def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
     assert "validation_roc_auc_score" in result.train_result.metrics
 
 
+def test_journal_max_step_survives_truncated_line(tmp_path):
+    """A preemption can truncate metrics.jsonl mid-write; the journal
+    floor must still come from the intact lines, not collapse to 0 (which
+    would re-append duplicate rows on resume)."""
+    from mlops_tpu.train.pipeline import _journal_max_step
+
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(
+        '{"step": 2, "loss": 0.5}\n'
+        '{"step": 4, "loss": 0.4}\n'
+        '{"step": 6, "los'  # truncated by the kill
+    )
+    assert _journal_max_step(path) == 4
+    assert _journal_max_step(tmp_path / "absent.jsonl") == 0
+
+
 def test_run_training_rejects_multidevice_layout_knobs():
     """The dense entrypoint must fail LOUDLY on layout knobs it does not
     implement — a shipped pipeline/long-context config routed through
