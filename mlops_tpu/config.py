@@ -221,7 +221,17 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
 
-def _coerce(current: Any, raw: str) -> Any:
+def _tuple_element_type(owner: type, field: str) -> type:
+    """Element type of a ``tuple[X, ...]`` dataclass field, read from the
+    annotation — the one place the type is stated, instead of guessing
+    from the (possibly empty) current value."""
+    import typing
+
+    args = typing.get_args(typing.get_type_hints(owner).get(field, tuple))
+    return args[0] if args else str
+
+
+def _coerce(current: Any, raw: str, inner: type = str) -> Any:
     if isinstance(current, bool):
         return raw.lower() in ("1", "true", "yes", "on")
     if isinstance(current, int):
@@ -229,8 +239,14 @@ def _coerce(current: Any, raw: str) -> Any:
     if isinstance(current, float):
         return float(raw)
     if isinstance(current, tuple):
-        inner = type(current[0]) if current else int
-        return tuple(inner(x) for x in raw.strip("()[] ").split(",") if x.strip())
+        body = raw.strip("()[] ")
+        if inner is str:
+            # String tuples (hpo.architectures) hold comma-containing
+            # specs ("hidden_dims=16,embed_dim=8"), so their CLI/env
+            # items separate on ';':
+            # hpo.architectures='hidden_dims=16;family=bert'.
+            return tuple(x.strip() for x in body.split(";") if x.strip())
+        return tuple(inner(x) for x in body.split(",") if x.strip())
     return raw
 
 
@@ -240,7 +256,12 @@ def _apply(config: Config, section: str, field: str, value: Any) -> None:
         raise KeyError(f"unknown config key {section}.{field}")
     current = getattr(sub, field)
     if isinstance(value, str) and not isinstance(current, str):
-        value = _coerce(current, value)
+        inner = (
+            _tuple_element_type(type(sub), field)
+            if isinstance(current, tuple)
+            else str
+        )
+        value = _coerce(current, value, inner)
     if isinstance(current, tuple) and isinstance(value, list):
         value = tuple(value)
     setattr(sub, field, value)

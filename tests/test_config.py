@@ -30,6 +30,23 @@ def test_env_overrides():
     assert config.serve.port == 8080
 
 
+def test_architecture_specs_override_from_cli():
+    """String tuples separate items on ';' (each spec contains commas);
+    numeric tuples keep the ',' grammar."""
+    config = load_config(
+        overrides=[
+            "hpo.architectures=hidden_dims=16;family=ft_transformer,token_dim=32",
+            "model.hidden_dims=64,32",
+        ],
+        env={},
+    )
+    assert config.hpo.architectures == (
+        "hidden_dims=16",
+        "family=ft_transformer,token_dim=32",
+    )
+    assert config.model.hidden_dims == (64, 32)
+
+
 def test_unknown_key_rejected():
     with pytest.raises(KeyError):
         load_config(overrides=["nope.nope=1"], env={})
