@@ -195,6 +195,28 @@ def test_pp_split_merge_roundtrip_and_packaging_parity():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_pp_trains_at_bf16_like_the_shipped_config():
+    """configs/pipeline_job.toml runs bf16 compute; one DP×PP step at
+    that precision must produce a finite loss and keep param dtypes f32
+    (params stay f32, compute casts — the zoo convention)."""
+    import dataclasses
+
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    model_config = dataclasses.replace(model_config, precision="bf16")
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    trainer = make_pp_train_step(model_config, train_config, mesh)
+    cat, num, lab = _pp_batch(train_config.batch_size)
+    params, _, loss = trainer.step_fn(
+        trainer.params, trainer.opt_state, cat, num, lab
+    )
+    assert np.isfinite(float(loss))
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(params)
+    )
+
+
 def test_pp_remat_changes_nothing_numerically():
     """train.pipeline_remat recomputes stage activations on backward
     (jax.checkpoint) — one step must produce the same params as without."""
