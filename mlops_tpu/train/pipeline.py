@@ -231,17 +231,9 @@ def run_training(
                 "name, which cannot target the vmapped member axis of an "
                 "ensemble — use ensemble_size=1 for fine-tuning runs"
             )
-        if config.train.init_params:
-            # Fine-tune from masked-feature pretraining (`pretrain` CLI):
-            # trunk comes from the MLM run, heads stay freshly initialized.
-            from mlops_tpu.models import init_params as fresh_init
-            from mlops_tpu.train.pretrain import load_pretrained_variables
-
-            init_variables = load_pretrained_variables(
-                config.train.init_params,
-                config.model,
-                fresh_init(model, jax.random.PRNGKey(config.train.seed)),
-            )
+        # Fine-tune from masked-feature pretraining (`pretrain` CLI):
+        # trunk comes from the MLM run, heads stay freshly initialized.
+        init_variables = _load_init_variables(config, model) or init_variables
         result = fit(
             model,
             train_ds,
@@ -315,6 +307,20 @@ def run_layout_training(
             "(model.pipeline_stages / seq_parallel / doc_records>1); "
             "dense configs train via run_training"
         )
+    if config.train.init_params:
+        # Fail BEFORE the run dir and data load: an incompatible graft
+        # must not leave an orphan run directory or pay the encode.
+        if not config.model.pipeline_stages:
+            raise ValueError(
+                "train.init_params is not supported for document training: "
+                "the pretrained pos_embed covers one 48-token record, not "
+                "a 2+46R document sequence"
+            )
+        if config.model.family != "bert":
+            raise ValueError(
+                "train.init_params grafts a bert masked-LM trunk; "
+                f"family {config.model.family!r} shares no trunk with it"
+            )
     run_name = run_name or time.strftime("%Y%m%d-%H%M%S")
     run_dir = new_run_dir(config, run_name)
     columns, labels = load_training_data(config)
@@ -354,6 +360,22 @@ def _batch_indices(n_rows: int, batch: int, seed: int, step: int) -> np.ndarray:
     order is a pure function of the step counter, so a checkpoint-resumed
     run sees exactly the batches the preempted run would have."""
     return np.random.default_rng((seed, step)).integers(0, n_rows, batch)
+
+
+def _load_init_variables(config: Config, model) -> Any | None:
+    """Graft the pretrained masked-LM trunk (``train.init_params``) into a
+    fresh init of ``model``; None when unset. One helper for the dense
+    and pipeline-parallel fine-tune paths."""
+    if not config.train.init_params:
+        return None
+    from mlops_tpu.models import init_params as fresh_init
+    from mlops_tpu.train.pretrain import load_pretrained_variables
+
+    return load_pretrained_variables(
+        config.train.init_params,
+        config.model,
+        fresh_init(model, jax.random.PRNGKey(config.train.seed)),
+    )
 
 
 def _layout_run_setup(tcfg, run_dir: Path, trainer):
@@ -453,11 +475,18 @@ def _run_pp_training(
             f"fake {stages}-device env)"
         )
     mesh = make_nd_mesh({"data": n_dev // stages, "stage": stages})
-    trainer = make_pp_train_step(
-        config.model, config.train, mesh, seed=config.train.seed
-    )
     dense_model = build_model(
         dataclasses.replace(config.model, pipeline_stages=0)
+    )
+    # Pretrain -> PP fine-tune: graft the masked-LM trunk into a fresh
+    # dense tree (the shared helper; run_layout_training fail-fasts the
+    # incompatible cases), then split into the stage layout.
+    trainer = make_pp_train_step(
+        config.model,
+        config.train,
+        mesh,
+        seed=config.train.seed,
+        init_variables=_load_init_variables(config, dense_model),
     )
     tcfg = config.train
     (

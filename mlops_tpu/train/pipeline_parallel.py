@@ -195,6 +195,7 @@ def make_pp_train_step(
     train_config: TrainConfig,
     mesh: Mesh,
     seed: int = 0,
+    init_variables: Any | None = None,
 ) -> PPTrainStep:
     """One jitted (DP×)PP train step over a TransformerBlock-trunk family
     (bert or ft_transformer — `_FAMILY_SPLITS`).
@@ -246,7 +247,10 @@ def make_pp_train_step(
 
     from mlops_tpu.models import build_model, init_params
 
-    dense_variables = init_params(
+    # init_variables: a DENSE variables tree (e.g. a pretrained trunk
+    # grafted by `load_pretrained_variables`) — the PP layout is derived
+    # from it exactly as from a fresh init.
+    dense_variables = init_variables or init_params(
         build_model(model_config), jax.random.PRNGKey(seed)
     )
     pp_params = split_trunk_params(

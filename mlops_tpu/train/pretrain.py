@@ -159,6 +159,17 @@ def load_pretrained_variables(
 
     from mlops_tpu.train.checkpoint import restore_tree
 
+    if model_config.family != "bert":
+        # The graft matches subtrees by NAME. mlp/linear share nothing
+        # (the graft would be a silent no-op and "fine-tuning" would
+        # start from a fresh model); ft_transformer shares the block_i
+        # names and would silently absorb bert-pretrained blocks. Every
+        # caller must hit this, so the check lives here, not per site.
+        raise ValueError(
+            "train.init_params grafts a bert masked-LM trunk by name; "
+            f"family {model_config.family!r} shares no trunk with it"
+        )
+
     mlm = build_mlm(model_config)
     seq_len = mlm.layout.seq_len
     template = mlm.init(

@@ -195,6 +195,70 @@ def test_pp_split_merge_roundtrip_and_packaging_parity():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_pp_starts_from_provided_dense_variables():
+    """Pretrain → PP fine-tune: init_variables (a dense tree, e.g. a
+    grafted masked-LM trunk) must become the trainer's starting point —
+    embed/stage params equal the provided tree, not a fresh init."""
+    from mlops_tpu.models import build_model, init_params
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    model_config, train_config = _pp_configs()
+    mesh = make_nd_mesh({"data": 2, "stage": 4})
+    provided = init_params(build_model(model_config), jax.random.PRNGKey(99))
+    trainer = make_pp_train_step(
+        model_config, train_config, mesh, seed=0, init_variables=provided
+    )
+    np.testing.assert_array_equal(
+        np.asarray(trainer.params["embed"]["tok_embed"]["embedding"]),
+        np.asarray(provided["params"]["tok_embed"]["embedding"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(trainer.params["stages"])[0][0, 0]),
+        np.asarray(jax.tree.leaves(provided["params"]["block_0"])[0]),
+    )
+
+
+def test_layout_training_rejects_init_params_where_unsupported(tmp_path):
+    """Silent-ignore guards: doc runs cannot consume a pretrained trunk
+    (pos_embed shape differs), and non-bert PP families share no trunk."""
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    config = Config()
+    config.data.rows = 400
+    config.model = ModelConfig(
+        family="bert", doc_records=3, token_dim=16, depth=1, heads=2,
+        dropout=0.0, precision="f32",
+    )
+    config.train.init_params = str(tmp_path / "pre.msgpack")
+    config.registry.run_root = str(tmp_path / "runs")
+    with pytest.raises(ValueError, match="document training"):
+        run_layout_training(config)
+
+    config2 = Config()
+    config2.data.rows = 400
+    config2.model = ModelConfig(
+        family="ft_transformer", token_dim=16, depth=4, heads=2,
+        dropout=0.0, precision="f32", pipeline_stages=4,
+    )
+    config2.train.init_params = str(tmp_path / "pre.msgpack")
+    config2.registry.run_root = str(tmp_path / "runs")
+    with pytest.raises(ValueError, match="shares no trunk"):
+        run_layout_training(config2)
+
+    # The DENSE path hits the same guard inside load_pretrained_variables
+    # (an mlp graft would be a silent no-op — "fine-tuning" from fresh).
+    from mlops_tpu.train.pipeline import run_training
+
+    config3 = Config()
+    config3.data.rows = 400
+    config3.train.init_params = str(tmp_path / "pre.msgpack")
+    config3.train.steps = 1
+    config3.registry.run_root = str(tmp_path / "runs")
+    with pytest.raises(ValueError, match="shares no trunk"):
+        run_training(config3, register=False)
+
+
 def test_pp_trains_at_bf16_like_the_shipped_config():
     """configs/pipeline_job.toml runs bf16 compute; one DP×PP step at
     that precision must produce a finite loss and keep param dtypes f32
