@@ -7,7 +7,7 @@
 #   Log Analytics + omsagent-> Cloud Logging/Monitoring (built into GKE)
 #   Databricks workspace    -> none: training runs in-cluster on the TPU
 #                              pool via the framework's own trainer
-#   user-assigned identity  -> service accounts + workload identity (iam.tf)
+#   user-assigned identity  -> service accounts + workload identity (registry.tf)
 #   storage account         -> GCS bucket for datasets + model registry
 #
 # Same shape as the reference: one orchestrating entry point, staging and

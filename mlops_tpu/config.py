@@ -156,6 +156,13 @@ class ServeConfig:
     max_group: int = 64  # most requests one vmapped dispatch may carry;
     # clamped to the largest warmed slot bucket. Large groups are what
     # amortize the flat per-dispatch transport round trip into req/s
+    max_inflight: int = 4  # overlapped grouped dispatches the micro-batcher
+    # may have in flight at once. Sync constraint: must not exceed
+    # max_workers, or dispatches just queue inside the executor and the
+    # overlap is fiction (serve/batcher.py)
+    max_workers: int = 8  # predict thread pool size; >= max_inflight so
+    # every overlapped dispatch gets a thread, with headroom for the
+    # batcher's solo fast-path and bulk scoring
     request_timeout_s: float = 30.0  # per-request deadline on the predict
     # path: a stalled device (observed live: a remote-attached chip's
     # tunnel hanging dispatches for 40+ min) 503s requests fast instead

@@ -65,8 +65,19 @@ class HttpServer:
             )
             config.max_batch = engine.max_bucket
         self.metrics = ServingMetrics()
+        config.max_workers = max(1, config.max_workers)
+        if not 1 <= config.max_inflight <= config.max_workers:
+            logger.warning(
+                "serve.max_inflight=%d outside [1, max_workers=%d]; clamping "
+                "(beyond the pool dispatches just queue; 0 would wedge them)",
+                config.max_inflight,
+                config.max_workers,
+            )
+            config.max_inflight = min(
+                max(1, config.max_inflight), config.max_workers
+            )
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="predict"
+            max_workers=config.max_workers, thread_name_prefix="predict"
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
@@ -83,6 +94,7 @@ class HttpServer:
             self._executor,
             window_ms=config.batch_window_ms,
             max_group=config.max_group,
+            max_inflight=config.max_inflight,
         )
 
     # ----------------------------------------------------------- HTTP layer

@@ -245,13 +245,31 @@ def run_hpo(
     )
 
 
+def _dataset_digest(ds) -> str:
+    """Content digest of an encoded dataset. Row count alone is not an
+    identity: a retried sweep reusing the same run_name with different
+    data of the SAME size (new data.seed, updated train_path file) must
+    not restore stale cached group results. Full arrays, not a strided
+    sample — this tabular dataset is a few MB and blake2b hashes that in
+    milliseconds, while a sample would miss small in-place edits."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(np.ascontiguousarray(ds.cat_ids).tobytes())
+    h.update(np.ascontiguousarray(ds.numeric).tobytes())
+    if ds.labels is not None:
+        h.update(np.ascontiguousarray(ds.labels).tobytes())
+    return h.hexdigest()
+
+
 def _group_fingerprint(
-    cfg: ModelConfig, group_hpo: HPOConfig, train_config: TrainConfig, rows: int
+    cfg: ModelConfig, group_hpo: HPOConfig, train_config: TrainConfig, train_ds
 ) -> str:
     """Everything a completed group's cached result is valid for: the FULL
     group ModelConfig (not just the spec-overridden fields — an edit to a
     base field like precision or dropout must invalidate too), the sweep
-    shape/seed/objective, the training recipe, and the dataset size."""
+    shape/seed/objective, the training recipe, and the dataset identity
+    (row count + content digest)."""
     import json
 
     return json.dumps(
@@ -262,7 +280,8 @@ def _group_fingerprint(
             "seed": group_hpo.seed,
             "objective": group_hpo.objective,
             "train": dataclasses.asdict(train_config),
-            "rows": rows,
+            "rows": train_ds.n,
+            "data_digest": _dataset_digest(train_ds),
         },
         sort_keys=True,
         default=str,
@@ -368,7 +387,7 @@ def run_architecture_hpo(
     merged_trials: list[dict[str, Any]] = []
     for g, (cfg, structural) in enumerate(groups):
         group_hpo = dataclasses.replace(hpo_config, seed=hpo_config.seed + g)
-        fingerprint = _group_fingerprint(cfg, group_hpo, train_config, train_ds.n)
+        fingerprint = _group_fingerprint(cfg, group_hpo, train_config, train_ds)
         res = (
             _load_group_result(resume_dir, g, fingerprint, cfg)
             if resume_dir is not None

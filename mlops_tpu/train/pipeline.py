@@ -307,6 +307,7 @@ def run_layout_training(
             "(model.pipeline_stages / seq_parallel / doc_records>1); "
             "dense configs train via run_training"
         )
+    _check_layout_knobs(config)
     if config.train.init_params:
         # Fail BEFORE the run dir and data load: an incompatible graft
         # must not leave an orphan run directory or pay the encode.
@@ -332,6 +333,22 @@ def run_layout_training(
             config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
         )
     return _run_doc_training(config, run_dir, train_ds, valid_ds)
+
+
+def _check_layout_knobs(config: Config) -> None:
+    """Reject layout-knob combinations that have no trainer. Without this,
+    ``pipeline_stages`` would win the dispatch silently and a config that
+    also asked for ``doc_records>1``/``seq_parallel`` would train a
+    single-record PP model — the silent-route class every other entry
+    point (run_training / run_tuning / pretrain) guards loudly against."""
+    if config.model.pipeline_stages and (
+        config.model.doc_records > 1 or config.model.seq_parallel
+    ):
+        raise ValueError(
+            "model.pipeline_stages cannot combine with doc_records>1 or "
+            "seq_parallel: pipeline-parallel training covers single-record "
+            "models only; drop one of the layout knobs"
+        )
 
 
 def _journal_max_step(path: Path) -> int:

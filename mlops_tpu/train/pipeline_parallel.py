@@ -213,6 +213,15 @@ def make_pp_train_step(
             "pipeline_stages applies to the TransformerBlock-trunk "
             f"families {tuple(_FAMILY_SPLITS)}, not {model_config.family!r}"
         )
+    if model_config.ensemble_size > 1:
+        # A DeepEnsemble's param tree has no top-level block_* keys, so
+        # split_trunk_params would die with a cryptic stage-divisibility
+        # error; name the unsupported combination instead.
+        raise ValueError(
+            "pipeline_stages does not compose with ensemble_size>1: the "
+            "pipeline splits a single trunk's blocks across stages; train "
+            "the ensemble dense or set ensemble_size=1"
+        )
     if "stage" not in mesh.axis_names:
         raise ValueError(
             "model.pipeline_stages needs a mesh with a 'stage' axis "

@@ -191,7 +191,13 @@ def test_concurrent_registration_is_serialized(trained, tmp_path):
                 range(6),
             )
         )
-    with concurrent.futures.ProcessPoolExecutor(max_workers=4) as pool:
+    # spawn, not fork: the parent has initialized JAX (threads held), and
+    # fork-under-threads can deadlock the children before they exec.
+    import multiprocessing
+
+    with concurrent.futures.ProcessPoolExecutor(
+        max_workers=4, mp_context=multiprocessing.get_context("spawn")
+    ) as pool:
         proc_uris = list(
             pool.map(
                 _register_worker, [(str(root), str(result.bundle_dir))] * 4

@@ -276,6 +276,15 @@ def test_architecture_sweep_resumes_finished_groups(splits, tmp_path, monkeypatc
             valid_ds,
             resume_dir=tmp_path,
         )
+    # And so must CHANGED DATA of the same row count: the fingerprint
+    # digests dataset content, not just train_ds.n.
+    shuffled = dataclasses.replace(
+        train_ds, numeric=np.ascontiguousarray(train_ds.numeric[::-1])
+    )
+    with pytest.raises(AssertionError, match="recomputed"):
+        run_architecture_hpo(
+            base, tconfig, hconfig, shuffled, valid_ds, resume_dir=tmp_path
+        )
 
 
 def test_architecture_sweep_empty_is_passthrough(splits):

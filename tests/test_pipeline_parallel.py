@@ -246,6 +246,33 @@ def test_layout_training_rejects_init_params_where_unsupported(tmp_path):
     with pytest.raises(ValueError, match="shares no trunk"):
         run_layout_training(config2)
 
+    # pipeline_stages + doc_records/seq_parallel has no trainer: the PP
+    # dispatch must not win silently and drop the document layout.
+    config4 = Config()
+    config4.data.rows = 400
+    config4.model = ModelConfig(
+        family="bert", doc_records=3, token_dim=16, depth=4, heads=2,
+        dropout=0.0, precision="f32", pipeline_stages=4,
+    )
+    config4.registry.run_root = str(tmp_path / "runs")
+    with pytest.raises(ValueError, match="cannot combine"):
+        run_layout_training(config4)
+
+    # ensemble_size>1 has no block_* trunk to split across stages; the
+    # guard must name the combination, not die in split_trunk_params.
+    from mlops_tpu.parallel import make_nd_mesh
+    from mlops_tpu.train.pipeline_parallel import make_pp_train_step
+
+    with pytest.raises(ValueError, match="ensemble_size"):
+        make_pp_train_step(
+            ModelConfig(
+                family="bert", token_dim=16, depth=4, heads=2, dropout=0.0,
+                precision="f32", pipeline_stages=4, ensemble_size=2,
+            ),
+            Config().train,
+            make_nd_mesh({"stage": 4}),
+        )
+
     # The DENSE path hits the same guard inside load_pretrained_variables
     # (an mlp graft would be a silent no-op — "fine-tuning" from fresh).
     from mlops_tpu.train.pipeline import run_training
