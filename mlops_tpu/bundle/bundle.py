@@ -29,10 +29,14 @@ MONITOR_NAME = "monitor.npz"
 class Bundle:
     """A loaded bundle: rebuilt model + fitted state, ready to serve.
 
-    Two flavors behind one interface (manifest ``flavor``):
+    Three flavors behind one interface (manifest ``flavor``):
     ``flax`` carries a params pytree for a zoo module; ``sklearn`` carries
     the CPU tree-ensemble floor (BASELINE config 1) — the reference ships
-    only the sklearn kind (`02-register-model.ipynb:305-353`).
+    only the sklearn kind (`02-register-model.ipynb:305-353`); ``doc``
+    carries a long-context document model (``doc_records > 1``,
+    `train/long_context.py`) whose inputs are record HISTORIES
+    ``[D, R, C]`` — it scores offline via ``predict-file``/bulk paths,
+    not the single-record HTTP endpoint.
     """
 
     manifest: dict[str, Any]
@@ -122,7 +126,12 @@ def save_bundle(
 
     directory = Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
-    flavor = "sklearn" if model_config.family in SKLEARN_FAMILIES else "flax"
+    if model_config.family in SKLEARN_FAMILIES:
+        flavor = "sklearn"
+    elif model_config.doc_records > 1:
+        flavor = "doc"
+    else:
+        flavor = "flax"
     manifest = {
         "format_version": 1,
         "flavor": flavor,
@@ -186,8 +195,25 @@ def load_bundle(directory: str | Path) -> Bundle:
             monitor=monitor,
             estimator=SklearnBaseline.load(directory / ESTIMATOR_NAME),
         )
-    model = build_model(model_config)
-    template = init_params(model, jax.random.PRNGKey(0))
+    if manifest.get("flavor") == "doc":
+        # Long-context document model: the DENSE BertDocEncoder (the
+        # ring is a training-time layout) with a doc-shaped init template.
+        import jax.numpy as jnp
+
+        from mlops_tpu.train.long_context import build_doc_model
+
+        model = build_doc_model(
+            dataclasses.replace(model_config, seq_parallel=False)
+        )
+        template = model.init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.zeros((2, model_config.doc_records, SCHEMA.num_categorical), jnp.int32),
+            jnp.zeros((2, model_config.doc_records, SCHEMA.num_numeric), jnp.float32),
+            train=False,
+        )
+    else:
+        model = build_model(model_config)
+        template = init_params(model, jax.random.PRNGKey(0))
     try:
         params = restore_tree(
             template["params"], (directory / PARAMS_NAME).read_bytes()

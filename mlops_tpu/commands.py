@@ -58,9 +58,10 @@ def _train(config) -> int:
 
     run_name = config.registry.run_name or None
     if config.model.uses_layout_trainer:
-        # Multi-device training layouts (GPipe / ring-attention documents)
-        # run through their dedicated trainers on a mesh built from the
-        # available devices (train/pipeline.py run_layout_training).
+        # Multi-device training layouts (GPipe / DP×TP Megatron sharding /
+        # ring-attention documents) run through their dedicated trainers
+        # on a mesh built from the available devices
+        # (train/pipeline.py run_layout_training).
         result = run_layout_training(config, run_name=run_name)
     else:
         result = run_training(config, run_name=run_name)
@@ -273,7 +274,9 @@ def _versions(config) -> int:
 
 def _predict_file(config) -> int:
     """Batch-score a schema CSV offline with the full fused predict (works
-    for both bundle flavors — flax on device, sklearn floor on host)."""
+    for every bundle flavor — flax on device, sklearn floor on host, and
+    ``doc`` long-context bundles, which group consecutive rows into
+    record histories and emit one prediction per document)."""
     from mlops_tpu.bundle import load_bundle
     from mlops_tpu.native import encode_csv
     from mlops_tpu.serve import InferenceEngine
@@ -282,10 +285,63 @@ def _predict_file(config) -> int:
     if not source:
         raise SystemExit("pass the input csv via data.train_path=<csv>")
     bundle = load_bundle(_resolve_bundle(config))
-    engine = InferenceEngine(bundle, buckets=(config.serve.max_batch,))
     ds = encode_csv(source, bundle.preprocessor)
+    if bundle.flavor == "doc":
+        print(json.dumps(
+            _predict_documents(bundle, ds, config.serve.max_batch)
+        ))
+        return 0
+    engine = InferenceEngine(bundle, buckets=(config.serve.max_batch,))
     print(json.dumps(engine.predict_arrays(ds.cat_ids, ds.numeric)))
     return 0
+
+
+def _predict_documents(bundle, ds, max_batch: int = 256) -> dict:
+    """Score a record-history dataset with a doc bundle: consecutive rows
+    group into ``doc_records``-length documents (the training-time
+    `make_documents` convention: the prediction targets the LAST record's
+    default) and the calibrated per-document probabilities come back with
+    the grouping accounted for. Documents stream through one jitted
+    forward in ``max_batch``-sized chunks (the tail chunk pads up to the
+    same shape) — this is the doc flavor's bulk surface, so a 1M-row
+    history file must not materialize one giant forward."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mlops_tpu.train.long_context import group_documents
+
+    r = bundle.model_config.doc_records
+    if ds.cat_ids.shape[0] < r:
+        raise SystemExit(
+            f"doc bundle needs at least doc_records={r} rows per document; "
+            f"file has {ds.cat_ids.shape[0]}"
+        )
+    cat, num = group_documents(ds.cat_ids, ds.numeric, r)
+    docs = cat.shape[0]
+    chunk = max(1, min(int(max_batch), docs))
+    forward = jax.jit(
+        lambda c, x: bundle.model.apply(
+            {"params": bundle.variables["params"]}, c, x, train=False
+        )
+    )
+    probs = np.empty(docs, np.float32)
+    for lo in range(0, docs, chunk):
+        hi = min(lo + chunk, docs)
+        pad = chunk - (hi - lo)  # pad the tail to the compiled shape
+        c = np.pad(cat[lo:hi], ((0, pad), (0, 0), (0, 0)))
+        x = np.pad(num[lo:hi], ((0, pad), (0, 0), (0, 0)))
+        logits = forward(jnp.asarray(c), jnp.asarray(x))
+        probs[lo:hi] = np.asarray(
+            jax.nn.sigmoid(logits / bundle.temperature), np.float32
+        )[: hi - lo]
+    dropped = int(ds.cat_ids.shape[0] - docs * r)
+    return {
+        "predictions": [round(float(p), 6) for p in probs],
+        "documents": int(docs),
+        "records_per_document": r,
+        "rows_dropped": dropped,  # tail rows short of a full document
+    }
 
 
 def _score_batch(config) -> int:
@@ -301,6 +357,12 @@ def _score_batch(config) -> int:
     from mlops_tpu.parallel.bulk import score_dataset
 
     bundle = load_bundle(_resolve_bundle(config))
+    if bundle.flavor == "doc":
+        raise SystemExit(
+            "doc bundles score record histories via "
+            "`predict-file data.train_path=<history csv>`; the bulk "
+            "scorer's per-record contract does not apply"
+        )
     if config.score.streaming:
         # Out-of-core path (the Spark-scale analogue): the dataset never
         # materializes; peak memory is one chunk, each chunk data-parallel

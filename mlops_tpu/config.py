@@ -60,6 +60,13 @@ class ModelConfig:
     # ppermute ring (`parallel/pipeline.py`). 0 = off. Requires
     # depth % pipeline_stages == 0 and dropout == 0.
     pipeline_stages: int = 0
+    # Tensor parallelism (Flax families): lay params out over a
+    # ('data','model') mesh with 'model' axis = tensor_parallel, per the
+    # Megatron column/row/head PARAM_RULES (`parallel/sharding.py`);
+    # the train step is `parallel/steps.py make_sharded_train_step`, the
+    # product loop `train/tensor_parallel.py`. 0 = off. The device count
+    # must be a multiple of it.
+    tensor_parallel: int = 0
 
     @property
     def uses_layout_trainer(self) -> bool:
@@ -68,7 +75,10 @@ class ModelConfig:
         ``run_training`` path — the ONE predicate both the CLI dispatch
         and run_training's guard share."""
         return bool(
-            self.pipeline_stages or self.seq_parallel or self.doc_records > 1
+            self.pipeline_stages
+            or self.seq_parallel
+            or self.doc_records > 1
+            or self.tensor_parallel
         )
 
 
@@ -107,10 +117,10 @@ class TrainConfig:
     # at depth) from the stage-boundary input instead of storing them;
     # the boundary inputs themselves stay stored (the scan needs them)
     ema_decay: float = 0.0  # >0 serves bias-corrected Polyak-averaged
-    # params (EMA folded into the compiled scan; eval/packaging use the
-    # debiased average, raw params keep training). 0 disables. Applies to
-    # the `train` path (loop.fit); the vmapped HPO sweep and the raw
-    # sharded step warn and ignore it.
+    # params (EMA folded into the compiled step; eval/packaging use the
+    # debiased average, raw params keep training). 0 disables. Supported
+    # by EVERY trainer: dense fit, the DP/TP sharded step, the vmapped
+    # HPO sweep, the long-context/document loop, and pipeline parallel.
 
 
 @dataclasses.dataclass
@@ -124,6 +134,20 @@ class HPOConfig:
     objective: str = "roc_auc"  # selection metric, parity with
     # `mlflow.search_runs(order_by validation_roc_auc_score DESC)` (cell 10)
     steps: int = 1000
+    strategy: str = "random"  # random | sha. "sha" = successive halving
+    # (the ADAPTIVE analogue of the reference's TPE, `01-train-model.ipynb:349`):
+    # train all `trials` candidates one rung in ONE vmapped program, keep
+    # the top 1/eta by `objective`, continue the survivors — total step
+    # budget stays <= trials*steps (equal-budget vs random search), but
+    # most of it lands on the candidates that earn it.
+    eta: int = 3  # sha survivor fraction per rung (keep top 1/eta)
+    sha_rungs: int = 3  # sha rung count (last rung trains the finalists)
+    # Continuous search space (both strategies sample from these — the
+    # reference's TPE space is RandomForest-shaped; these are the neural
+    # optimizer's knobs). log10 bounds for the log-uniform draws:
+    lr_log10: tuple[float, float] = (-3.7, -2.0)
+    wd_log10: tuple[float, float] = (-6.0, -3.0)
+    pos_weight_range: tuple[float, float] = (1.0, 4.0)  # uniform
     architectures: tuple[str, ...] = ()  # structural sweep axis (the
     # reference's n_estimators/max_depth/criterion analogue,
     # `01-train-model.ipynb:342-353`): each spec is comma-separated

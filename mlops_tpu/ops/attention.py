@@ -13,10 +13,14 @@ and the BERT stretch config (config 5). Two execution paths:
   for BERT-length sequences (128–512+) and the building block the ring
   variant (``mlops_tpu.parallel.ring_attention``) reuses per-shard.
 
-Backward: ``flash_attention`` carries a custom VJP whose forward runs the
-Pallas kernel and whose backward rematerializes dense attention with XLA ops
-(O(S²) only inside the backward, standard remat trade). Training at BERT
-scale fits comfortably; the serving hot path is forward-only.
+Backward: ``flash_attention`` carries a custom VJP whose backward is TWO
+Pallas kernels (VERDICT r4 #5, the FlashAttention-2 recipe): the forward
+additionally emits the per-row logsumexp ``L = m + log l``; the backward
+recomputes the probability tiles ``p = exp(s - L)`` from it — one kernel
+walks k-blocks accumulating dq, one walks q-blocks accumulating dk/dv —
+so the backward, like the forward, never materializes the O(S²) score
+matrix in HBM. (Round 4 rematerialized DENSE attention in XLA here,
+which walled training at the 2k–8k lengths the forward was tuned for.)
 
 Layout convention matches Flax: ``[batch, seq, heads, head_dim]``.
 """
@@ -51,7 +55,8 @@ def reference_attention(
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, scale, kv_len, block_k
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, scale, kv_len, block_k,
 ):
     """One (batch*head, q_block) tile; grid axis 2 walks k blocks.
 
@@ -102,6 +107,25 @@ def _flash_kernel(
     @pl.when(ki == nk - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        # Per-row logsumexp for the Pallas backward: p = exp(s - L)
+        # reconstructs the probability tile without storing it. l == 0
+        # cannot happen for real rows (kv_len >= 1 unmasked key), but
+        # guard the log anyway — padded-q rows still sum real keys.
+        lse_ref[0] = (
+            m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        )
+
+
+def _fold_heads(x: jnp.ndarray) -> jnp.ndarray:
+    """[B,S,H,D] -> [B*H, S, D]: batch and heads fold into one parallel
+    grid axis."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _pad_seq(x: jnp.ndarray, block: int) -> jnp.ndarray:
+    pad = (-x.shape[1]) % block
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0))) if pad else x
 
 
 def _flash_forward(
@@ -112,25 +136,17 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns ``(out [B,S,H,D], lse [B*H, padded_Sq])`` — the logsumexp
+    stays in the folded/padded layout the backward kernels consume."""
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
 
-    # [B,S,H,D] -> [B*H, S, D]: fold batch and heads into one parallel axis.
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
-
     block_q = min(block_q, max(8, s_q))
     block_k = min(block_k, max(8, s_kv))
-    pad_q = (-s_q) % block_q
-    pad_k = (-s_kv) % block_k
-    if pad_q:
-        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
-    if pad_k:
-        kf = jnp.pad(kf, ((0, 0), (0, pad_k), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, pad_k), (0, 0)))
+    qf = _pad_seq(_fold_heads(q), block_q)
+    kf = _pad_seq(_fold_heads(k), block_k)
+    vf = _pad_seq(_fold_heads(v), block_k)
     nq = qf.shape[1] // block_q
     nk = kf.shape[1] // block_k
 
@@ -138,7 +154,7 @@ def _flash_forward(
     kernel = functools.partial(
         _flash_kernel, scale=scale, kv_len=s_kv, block_k=block_k
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -146,8 +162,14 @@ def _flash_forward(
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, ki: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((b * h, qf.shape[1]), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m
             pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer l
@@ -164,7 +186,177 @@ def _flash_forward(
     )(qf, kf, vf)
 
     out = out[:, :s_q].reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
-    return out
+    return out, lse
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, kv_len, block_k,
+):
+    """dq tile: grid (B*H, q blocks, k blocks); the k loop accumulates
+    ``dq_i = scale * sum_j p_ij (dp_ij - delta_i) k_j`` in VMEM scratch,
+    with ``p`` recomputed from the stored logsumexp."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0]  # [bq, d]
+    k = k_ref[0]  # [bk, d]
+    v = v_ref[0]
+    do = do_ref[0]  # [bq, d]
+    lse = lse_ref[0]  # [bq]
+    delta = delta_ref[0]  # [bq]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+    col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])  # [bq, bk]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    ds = p * (dp - delta[:, None]) * scale
+    dq_acc[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, scale, kv_len, block_k,
+):
+    """dk/dv tiles: grid (B*H, k blocks, q blocks); the q loop accumulates
+    ``dv_j = sum_i p_ij do_i`` and
+    ``dk_j = scale * sum_i p_ij (dp_ij - delta_i) q_i``. Probabilities
+    recompute transposed (``[bk, bq]``) from the same logsumexp."""
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    k = k_ref[0]  # [bk, d]
+    v = v_ref[0]
+    q = q_ref[0]  # [bq, d]
+    do = do_ref[0]
+    lse = lse_ref[0]  # [bq]
+    delta = delta_ref[0]
+
+    # s_t[j, i] = k_j . q_i * scale (the transposed score tile). The
+    # kv_len mask lands on ROWS here; masked rows only touch dk/dv tiles
+    # that are sliced off after the call, but masking keeps them zero so
+    # the f32 accumulator never sees garbage.
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bk, bq]
+    row = (
+        pl.program_id(1) * k.shape[0]
+        + jax.lax.broadcasted_iota(jnp.int32, s_t.shape, 0)
+    )
+    s_t = jnp.where(row < kv_len, s_t, NEG_INF)
+    p_t = jnp.exp(s_t - lse[None, :])  # [bk, bq]
+    dv_acc[:] += jax.lax.dot_general(
+        p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bk, bq]
+    ds_t = p_t * (dp_t - delta[None, :]) * scale
+    dk_acc[:] += jax.lax.dot_general(
+        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, scale, block_q, block_k, interpret
+):
+    """Assemble dq/dk/dv from the two Pallas kernels. ``lse`` arrives in
+    the folded/padded ``[B*H, padded_Sq]`` layout the forward produced."""
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    block_q = min(block_q, max(8, s_q))
+    block_k = min(block_k, max(8, s_kv))
+
+    qf = _pad_seq(_fold_heads(q), block_q)
+    kf = _pad_seq(_fold_heads(k), block_k)
+    vf = _pad_seq(_fold_heads(v), block_k)
+    dof = _pad_seq(_fold_heads(g), block_q)
+    # delta_i = do_i . out_i (rowsum, [B*H, Sq]) — the softmax-jacobian
+    # correction term; tiny, so XLA computes it outside the kernels.
+    delta = jnp.sum(
+        _fold_heads(g).astype(jnp.float32) * _fold_heads(out).astype(jnp.float32),
+        axis=-1,
+    )
+    pad_q = (-s_q) % block_q
+    if pad_q:
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q)))
+
+    bh = b * h
+    nq = qf.shape[1] // block_q
+    nk = kf.shape[1] // block_k
+    common = dict(scale=scale, kv_len=s_kv, block_k=block_k)
+    qspec = pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0))
+    rowspec = pl.BlockSpec((1, block_q), lambda bhi, qi, ki: (bhi, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dk/dv walk the grid transposed: axis 1 = k blocks, axis 2 = q loop.
+    kspec_t = pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0))
+    qspec_t = pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0))
+    rowspec_t = pl.BlockSpec((1, block_q), lambda bhi, ki, qi: (bhi, qi))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[kspec_t, kspec_t, qspec_t, qspec_t, rowspec_t, rowspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse, delta)
+
+    def unfold(x, s):
+        return x[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return unfold(dq, s_q), unfold(dk, s_kv), unfold(dv, s_kv)
 
 
 def _use_interpret() -> bool:
@@ -174,18 +366,22 @@ def _use_interpret() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attention(q, k, v, scale, block_q, block_k):
-    return _flash_forward(q, k, v, scale, block_q, block_k, _use_interpret())
+    out, _ = _flash_forward(q, k, v, scale, block_q, block_k, _use_interpret())
+    return out
 
 
 def _flash_fwd(q, k, v, scale, block_q, block_k):
-    out = _flash_forward(q, k, v, scale, block_q, block_k, _use_interpret())
-    return out, (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, scale, block_q, block_k, _use_interpret()
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, block_q, block_k, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(
+        q, k, v, out, lse, g, scale, block_q, block_k, _use_interpret()
+    )
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from mlops_tpu.config import TrainConfig
 from mlops_tpu.parallel.sharding import batch_sharding, param_shardings, replicated
-from mlops_tpu.train.loop import TrainState, training_loss, warn_ema_unsupported
+from mlops_tpu.train.loop import TrainState, training_loss, update_ema
 
 
 def make_sharded_train_step(
@@ -34,14 +34,16 @@ def make_sharded_train_step(
     laid out per ``PARAM_RULES`` over 'model'. Gradients reduce over ICI via
     XLA-inserted psums.
     """
-    warn_ema_unsupported(config, "the sharded train step")
     p_shard = param_shardings(mesh, params_template)
-    # Optimizer state mirrors the param layout (adamw: mu/nu per param).
+    # Optimizer state mirrors the param layout (adamw: mu/nu per param);
+    # so does the EMA accumulator — one shadow copy per param shard, no
+    # extra collectives (the update is elementwise on co-located tiles).
     state_shardings = TrainState(
         params=p_shard,
         opt_state=_opt_shardings(optimizer, params_template, p_shard, mesh),
         step=replicated(mesh),
         rng=replicated(mesh),
+        ema=p_shard if config.ema_decay else None,
     )
     data_in = batch_sharding(mesh)
     label_in = batch_sharding(mesh, ndim=1)
@@ -55,8 +57,13 @@ def make_sharded_train_step(
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        ema = state.ema
+        if config.ema_decay:  # static at trace time
+            ema = update_ema(ema, params, config.ema_decay)
         return (
-            state.replace(params=params, opt_state=opt_state, step=state.step + 1),
+            state.replace(
+                params=params, opt_state=opt_state, step=state.step + 1, ema=ema
+            ),
             loss,
         )
 

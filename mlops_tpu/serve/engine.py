@@ -54,6 +54,12 @@ class InferenceEngine:
         enable_grouping: bool = True,
     ):
         self.bundle = bundle
+        if bundle.flavor == "doc":
+            raise ValueError(
+                "doc bundles score record HISTORIES, not single records — "
+                "the HTTP predict contract does not apply; score offline "
+                "via `predict-file data.train_path=<history csv>`"
+            )
         self.buckets = sorted(buckets)
         self.max_bucket = self.buckets[-1]
         self.service_name = service_name

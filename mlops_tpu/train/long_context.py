@@ -31,7 +31,21 @@ from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.models.bert import BertDocEncoder
 from mlops_tpu.parallel.ring_attention import make_ring_attention
 from mlops_tpu.schema.features import SCHEMA
-from mlops_tpu.train.loop import sigmoid_bce, warn_ema_unsupported
+from mlops_tpu.train.loop import sigmoid_bce, update_ema
+
+
+def group_documents(
+    cat_ids: np.ndarray, numeric: np.ndarray, doc_records: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Group consecutive encoded rows into record histories:
+    ``[N,C]`` -> ``[D,R,C]``. Rows past the last full document drop.
+    The label-free half of ``make_documents`` — the inference path
+    (``predict-file`` on a doc bundle) scores unlabeled histories."""
+    docs = cat_ids.shape[0] // doc_records
+    take = docs * doc_records
+    cat = cat_ids[:take].reshape(docs, doc_records, -1)
+    num = numeric[:take].reshape(docs, doc_records, -1)
+    return cat, num
 
 
 def make_documents(
@@ -44,11 +58,9 @@ def make_documents(
     """
     if ds.labels is None:
         raise ValueError("document training needs labels")
-    docs = ds.n // doc_records
-    take = docs * doc_records
-    cat = ds.cat_ids[:take].reshape(docs, doc_records, -1)
-    num = ds.numeric[:take].reshape(docs, doc_records, -1)
-    labels = ds.labels[:take].reshape(docs, doc_records)[:, -1]
+    cat, num = group_documents(ds.cat_ids, ds.numeric, doc_records)
+    docs = cat.shape[0]
+    labels = ds.labels[: docs * doc_records].reshape(docs, doc_records)[:, -1]
     return cat, num, labels.astype(np.float32)
 
 
@@ -86,9 +98,12 @@ def build_doc_model(
 @dataclasses.dataclass
 class DocTrainStep:
     model: BertDocEncoder
-    step_fn: Callable  # (params, opt_state, cat, num, lab) -> (params, opt_state, loss)
+    step_fn: Callable  # (params, opt_state, ema, cat, num, lab) ->
+    # (params, opt_state, ema, loss); ema is None (empty pytree) when
+    # train.ema_decay == 0 and threads through untouched
     params: Any
     opt_state: Any
+    ema: Any = None  # zero-init Polyak accumulator when ema_decay > 0
 
 
 def make_doc_train_step(
@@ -104,7 +119,6 @@ def make_doc_train_step(
     the attention inner loop rides the explicit ppermute ring. Without a
     mesh: the same step, dense, single device.
     """
-    warn_ema_unsupported(train_config, "the long-context trainer")
     model = build_doc_model(model_config, mesh)
     r = model_config.doc_records
     dummy_cat = jnp.zeros((2, r, SCHEMA.num_categorical), jnp.int32)
@@ -115,15 +129,20 @@ def make_doc_train_step(
         train_config.learning_rate, weight_decay=train_config.weight_decay
     )
     opt_state = optimizer.init(params)
+    decay = train_config.ema_decay
+    ema0 = jax.tree_util.tree_map(jnp.zeros_like, params) if decay else None
 
-    def step(params, opt_state, cat, num, lab):
+    def step(params, opt_state, ema, cat, num, lab):
         def loss_of(p):
             logits = model.apply({"params": p}, cat, num, train=True)
             return sigmoid_bce(logits, lab, train_config.pos_weight)
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        params = optax.apply_updates(params, updates)
+        if decay:  # static at trace time; ema=None threads through otherwise
+            ema = update_ema(ema, params, decay)
+        return params, opt_state, ema, loss
 
     # No donation on either path: DocTrainStep exposes the initial
     # params/opt_state, and a donated first step would delete those
@@ -142,9 +161,10 @@ def make_doc_train_step(
         rep = NamedSharding(mesh, P())
         step_fn = jax.jit(
             step,
-            in_shardings=(rep, rep, doc_in, doc_in, lab_in),
-            out_shardings=(rep, rep, rep),
+            in_shardings=(rep, rep, rep, doc_in, doc_in, lab_in),
+            out_shardings=(rep, rep, rep, rep),
         )
     return DocTrainStep(
-        model=model, step_fn=step_fn, params=params, opt_state=opt_state
+        model=model, step_fn=step_fn, params=params, opt_state=opt_state,
+        ema=ema0,
     )

@@ -43,12 +43,34 @@ class TrainState(struct.PyTreeNode):
     ema: Any = None
 
 
-def ema_debiased(state: TrainState, decay: float):
+def update_ema(ema: Any, params: Any, decay: float) -> Any:
+    """One Polyak step of the raw (biased) accumulator — THE recurrence
+    every trainer shares (dense scan, sharded step, vmapped HPO,
+    long-context, pipeline parallel); a fix here fixes all of them."""
+    return jax.tree_util.tree_map(
+        lambda e, q: decay * e + (1.0 - decay) * q, ema, params
+    )
+
+
+def packaged_or_raw(ema: Any, params: Any, decay: float, step) -> Any:
+    """What ships/evals: the debiased EMA when enabled and at least one
+    step has run (a zero-step run's all-zeros accumulator would debias to
+    0/0), else the raw params. Shared by the layout-loop packaging
+    closures."""
+    return debias_ema(ema, decay, step) if decay and step > 0 else params
+
+
+def debias_ema(ema: Any, decay: float, step) -> Any:
     """Bias-corrected Polyak average: ``ema / (1 - decay^step)`` — exact
     from step 1, so short runs (bench trains 600 steps) are not dragged
-    toward the zero init the raw accumulator starts from."""
-    correction = 1.0 - decay ** state.step.astype(jnp.float32)
-    return jax.tree_util.tree_map(lambda e: e / correction, state.ema)
+    toward the zero init the raw accumulator starts from. ``step`` may be
+    a traced array or a plain int (the layout loops' Python counter)."""
+    correction = 1.0 - decay ** jnp.asarray(step, jnp.float32)
+    return jax.tree_util.tree_map(lambda e: e / correction, ema)
+
+
+def ema_debiased(state: TrainState, decay: float):
+    return debias_ema(state.ema, decay, state.step)
 
 
 @dataclasses.dataclass
@@ -104,19 +126,6 @@ def training_loss(
     for leaf in jax.tree_util.tree_leaves(aux_state):
         loss = loss + jnp.mean(leaf)
     return loss
-
-
-def warn_ema_unsupported(config: TrainConfig, where: str) -> None:
-    """train.ema_decay is applied only by ``fit``; every other trainer must
-    say so out loud instead of silently shipping raw params."""
-    if getattr(config, "ema_decay", 0.0):
-        import warnings
-
-        warnings.warn(
-            f"train.ema_decay is only applied by the `train` path "
-            f"(loop.fit); {where} packages raw params and ignores it",
-            stacklevel=3,
-        )
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -180,10 +189,7 @@ def make_train_window(
             params = optax.apply_updates(state.params, updates)
             ema = state.ema
             if config.ema_decay:  # static at trace time
-                d = config.ema_decay
-                ema = jax.tree_util.tree_map(
-                    lambda e, q: d * e + (1.0 - d) * q, ema, params
-                )
+                ema = update_ema(ema, params, config.ema_decay)
             new_state = state.replace(
                 params=params, opt_state=opt_state, step=state.step + 1, ema=ema
             )

@@ -32,8 +32,9 @@ from mlops_tpu.train.loop import TrainResult, fit
 
 @dataclasses.dataclass
 class PipelineResult:
-    bundle_dir: Path | None  # None for runs with no serving artifact
-    # (document models — see run_layout_training)
+    bundle_dir: Path | None  # None only when this process is not the
+    # multi-host coordinator (every trained model otherwise packages —
+    # doc models as the 'doc' bundle flavor)
     model_uri: str | None
     train_result: TrainResult
     run_dir: Path
@@ -311,7 +312,7 @@ def run_layout_training(
     if config.train.init_params:
         # Fail BEFORE the run dir and data load: an incompatible graft
         # must not leave an orphan run directory or pay the encode.
-        if not config.model.pipeline_stages:
+        if not (config.model.pipeline_stages or config.model.tensor_parallel):
             raise ValueError(
                 "train.init_params is not supported for document training: "
                 "the pretrained pos_embed covers one 48-token record, not "
@@ -332,22 +333,33 @@ def run_layout_training(
         return _run_pp_training(
             config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
         )
-    return _run_doc_training(config, run_dir, train_ds, valid_ds)
+    if config.model.tensor_parallel:
+        return _run_tp_training(
+            config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+        )
+    return _run_doc_training(
+        config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+    )
 
 
 def _check_layout_knobs(config: Config) -> None:
     """Reject layout-knob combinations that have no trainer. Without this,
-    ``pipeline_stages`` would win the dispatch silently and a config that
-    also asked for ``doc_records>1``/``seq_parallel`` would train a
-    single-record PP model — the silent-route class every other entry
-    point (run_training / run_tuning / pretrain) guards loudly against."""
-    if config.model.pipeline_stages and (
-        config.model.doc_records > 1 or config.model.seq_parallel
-    ):
+    the dispatch order would win silently and a config asking for two
+    layouts would train only one — the silent-route class every other
+    entry point (run_training / run_tuning / pretrain) guards loudly
+    against."""
+    knobs = {
+        "pipeline_stages": bool(config.model.pipeline_stages),
+        "tensor_parallel": bool(config.model.tensor_parallel),
+        "doc_records>1/seq_parallel": (
+            config.model.doc_records > 1 or config.model.seq_parallel
+        ),
+    }
+    active = [name for name, on in knobs.items() if on]
+    if len(active) > 1:
         raise ValueError(
-            "model.pipeline_stages cannot combine with doc_records>1 or "
-            "seq_parallel: pipeline-parallel training covers single-record "
-            "models only; drop one of the layout knobs"
+            f"layout knobs {active} cannot combine: each selects its own "
+            "trainer (PP / DP×TP / DP×SP documents); set exactly one"
         )
 
 
@@ -403,22 +415,32 @@ def _layout_run_setup(tcfg, run_dir: Path, trainer):
     eval_every = max(1, min(tcfg.eval_every, tcfg.steps))
     ckpt_every = max(1, tcfg.checkpoint_every or eval_every)
     ckpt_dir = run_dir / "checkpoints"
-    params, opt_state, start_step = _restore_layout_state(
-        ckpt_dir, trainer.params, trainer.opt_state
+    params, opt_state, ema, start_step = _restore_layout_state(
+        ckpt_dir, trainer.params, trainer.opt_state, trainer.ema
     )
     journal_floor = _journal_max_step(run_dir / "metrics.jsonl")
-    return eval_every, ckpt_every, ckpt_dir, params, opt_state, start_step, journal_floor
+    return (
+        eval_every,
+        ckpt_every,
+        ckpt_dir,
+        params,
+        opt_state,
+        ema,
+        start_step,
+        journal_floor,
+    )
 
 
-def _maybe_checkpoint(ckpt_dir, params, opt_state, step, ckpt_every, steps):
+def _maybe_checkpoint(ckpt_dir, params, opt_state, ema, step, ckpt_every, steps):
     from mlops_tpu.train.checkpoint import save_checkpoint
 
     if step % ckpt_every == 0 or step == steps:
-        save_checkpoint(
-            ckpt_dir,
-            jax.device_get({"params": params, "opt_state": opt_state}),
-            step,
-        )
+        state = {"params": params, "opt_state": opt_state}
+        if ema is not None:
+            # Only when enabled: the key's presence must match the resume
+            # template, which is derived from the same config toggle.
+            state["ema"] = ema
+        save_checkpoint(ckpt_dir, jax.device_get(state), step)
 
 
 def _final_validation_metrics(history, steps, fallback):
@@ -432,10 +454,12 @@ def _final_validation_metrics(history, steps, fallback):
     return fallback()
 
 
-def _restore_layout_state(ckpt_dir, params, opt_state):
-    """Resume {params, opt_state} from the newest checkpoint, re-placing
-    host arrays onto each template leaf's sharding (stage-sharded PP
-    leaves included). Returns (params, opt_state, start_step)."""
+def _restore_layout_state(ckpt_dir, params, opt_state, ema=None):
+    """Resume {params, opt_state[, ema]} from the newest checkpoint,
+    re-placing host arrays onto each template leaf's sharding
+    (stage-sharded PP leaves included). ``ema`` joins the template only
+    when the trainer carries one (train.ema_decay > 0). Returns
+    (params, opt_state, ema, start_step)."""
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
@@ -447,11 +471,13 @@ def _restore_layout_state(ckpt_dir, params, opt_state):
     ):
         # Fresh start (the common case): skip building the host template —
         # it would device_get params + the 2x-sized adam state for nothing.
-        return params, opt_state, 0
-    template = jax.device_get({"params": params, "opt_state": opt_state})
-    loaded = load_checkpoint(ckpt_dir, template)
+        return params, opt_state, ema, 0
+    template = {"params": params, "opt_state": opt_state}
+    if ema is not None:
+        template["ema"] = ema
+    loaded = load_checkpoint(ckpt_dir, jax.device_get(template))
     if loaded is None:
-        return params, opt_state, 0
+        return params, opt_state, ema, 0
     host_state, step = loaded
 
     def put(t, h):
@@ -466,6 +492,11 @@ def _restore_layout_state(ckpt_dir, params, opt_state):
     return (
         jax.tree.map(put, params, host_state["params"]),
         jax.tree.map(put, opt_state, host_state["opt_state"]),
+        (
+            jax.tree.map(put, ema, host_state["ema"])
+            if ema is not None
+            else None
+        ),
         step,
     )
 
@@ -512,35 +543,46 @@ def _run_pp_training(
         ckpt_dir,
         params,
         opt_state,
+        ema,
         start_step,
         journal_floor,
     ) = _layout_run_setup(tcfg, run_dir, trainer)
+
+    def packaged_params(step):
+        # Metrics must describe the params that will be PACKAGED — the
+        # debiased EMA when enabled (fit keeps the same invariant).
+        from mlops_tpu.train.loop import packaged_or_raw
+
+        pp = packaged_or_raw(ema, params, tcfg.ema_decay, step)
+        return merge_bert_params(jax.device_get(pp))
+
     history: list[dict] = []
     merged = None
     with JsonlWriter(run_dir / "metrics.jsonl") as writer:
         for step in range(start_step + 1, tcfg.steps + 1):
             idx = _batch_indices(train_ds.n, tcfg.batch_size, tcfg.seed, step)
-            params, opt_state, loss = trainer.step_fn(
+            params, opt_state, ema, loss = trainer.step_fn(
                 params,
                 opt_state,
+                ema,
                 jnp.asarray(train_ds.cat_ids[idx]),
                 jnp.asarray(train_ds.numeric[idx]),
                 jnp.asarray(train_ds.labels[idx]),
             )
             if step % eval_every == 0 or step == tcfg.steps:
-                merged = merge_bert_params(jax.device_get(params))
+                merged = packaged_params(step)
                 metrics = evaluate(dense_model, merged, valid_ds)
                 record = {"step": step, "loss": round(float(loss), 6), **metrics}
                 if step > journal_floor:  # no duplicate rows on resume
                     writer.write(record)
                 history.append(record)
             _maybe_checkpoint(
-                ckpt_dir, params, opt_state, step, ckpt_every, tcfg.steps
+                ckpt_dir, params, opt_state, ema, step, ckpt_every, tcfg.steps
             )
 
     def fresh_eval():
         nonlocal merged
-        merged = merge_bert_params(jax.device_get(params))
+        merged = packaged_params(start_step)
         return evaluate(dense_model, merged, valid_ds)
 
     final = _final_validation_metrics(history, tcfg.steps, fresh_eval)
@@ -583,7 +625,133 @@ def _run_pp_training(
     )
 
 
-def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
+def _run_tp_training(
+    config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+) -> PipelineResult:
+    """DP×TP product training (`model.tensor_parallel=K`): the Megatron-
+    laid-out sharded step over a ('data','model') mesh, with the same
+    checkpoint/resume, EMA, and packaging tail as the PP path. The params
+    are the DENSE family tree (TP is a layout), so the packaged bundle
+    serves through the standard engine unchanged."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.train.loop import evaluate, packaged_or_raw
+    from mlops_tpu.train.tensor_parallel import make_tp_trainer
+    from mlops_tpu.utils.jsonl import JsonlWriter
+
+    dense_model_cfg = dataclasses.replace(config.model, tensor_parallel=0)
+    trainer = make_tp_trainer(
+        config,
+        init_variables=_load_init_variables(
+            config, build_model(dense_model_cfg)
+        ),
+    )
+    tcfg = config.train
+    (
+        eval_every,
+        ckpt_every,
+        ckpt_dir,
+        params,
+        opt_state,
+        ema,
+        start_step,
+        journal_floor,
+    ) = _layout_run_setup(tcfg, run_dir, trainer)
+    state = trainer.state.replace(
+        params=params,
+        opt_state=opt_state,
+        ema=ema,
+        step=jnp.asarray(start_step, jnp.int32),
+    )
+    # Deterministic dropout stream, pure in the step counter — a resumed
+    # run sees exactly the per-step rngs the preempted run would have.
+    drop_key = jax.random.fold_in(
+        jax.random.PRNGKey(tcfg.seed), 0x7EA50000
+    )
+
+    def packaged_params(step_count):
+        return jax.device_get(
+            packaged_or_raw(state.ema, state.params, tcfg.ema_decay, step_count)
+        )
+
+    history: list[dict] = []
+    packaged = None
+    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+        for step in range(start_step + 1, tcfg.steps + 1):
+            idx = _batch_indices(train_ds.n, tcfg.batch_size, tcfg.seed, step)
+            state, loss = trainer.step_fn(
+                state,
+                jnp.asarray(train_ds.cat_ids[idx]),
+                jnp.asarray(train_ds.numeric[idx]),
+                jnp.asarray(train_ds.labels[idx]),
+                jax.random.fold_in(drop_key, step),
+            )
+            if step % eval_every == 0 or step == tcfg.steps:
+                packaged = packaged_params(step)
+                metrics = evaluate(trainer.model, packaged, valid_ds)
+                record = {"step": step, "loss": round(float(loss), 6), **metrics}
+                if step > journal_floor:  # no duplicate rows on resume
+                    writer.write(record)
+                history.append(record)
+            _maybe_checkpoint(
+                ckpt_dir, state.params, state.opt_state, state.ema,
+                step, ckpt_every, tcfg.steps,
+            )
+
+    def fresh_eval():
+        nonlocal packaged
+        packaged = packaged_params(start_step)
+        return evaluate(trainer.model, packaged, valid_ds)
+
+    final = _final_validation_metrics(history, tcfg.steps, fresh_eval)
+    result = TrainResult(
+        params=packaged,
+        metrics=final,
+        history=history,
+        steps=tcfg.steps,
+        packaged_step=tcfg.steps,
+    )
+    calibration = _fit_calibration(valid_ds, packaged, trainer.model)
+    bulk = _maybe_distill(
+        config, dense_model_cfg, trainer.model, packaged, train_ds, valid_ds
+    )
+    mesh_shape = dict(
+        zip(trainer.mesh.axis_names, trainer.mesh.devices.shape)
+    )
+    bundle_dir, model_uri = _package_and_register(
+        config,
+        run_dir,
+        packaged,
+        preprocessor,
+        train_ds,
+        metrics=final,
+        bundle_tags={
+            "run_name": run_name,
+            "experiment": config.registry.experiment_name,
+            "trained_with": (
+                f"tensor_parallel dp{mesh_shape.get('data', 1)}x"
+                f"tp{mesh_shape.get('model', 1)}"
+            ),
+        },
+        registry_tags={
+            "run_name": run_name,
+            **{k: f"{v:.6f}" for k, v in final.items()},
+        },
+        register=register,
+        calibration=calibration,
+        bulk=bulk,
+    )
+    return PipelineResult(
+        bundle_dir=bundle_dir,
+        model_uri=model_uri,
+        train_result=result,
+        run_dir=run_dir,
+    )
+
+
+def _run_doc_training(
+    config, run_dir, run_name, preprocessor, train_ds, valid_ds, register
+) -> PipelineResult:
     import jax.numpy as jnp
 
     from mlops_tpu.parallel import make_nd_mesh
@@ -624,18 +792,20 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
     tcfg = config.train
     batch = max(dp, tcfg.batch_size - tcfg.batch_size % dp)
 
-    def doc_eval(params) -> dict[str, float]:
+    def valid_doc_logits(params) -> jnp.ndarray:
         # Pad the valid docs to a multiple of the 'data' axis (the ring's
         # shard_map requires an even batch split), then slice back.
         n = vcat.shape[0]
         pad = (-n) % dp
-        logits = trainer.model.apply(
+        return trainer.model.apply(
             {"params": params},
             jnp.asarray(np.pad(vcat, ((0, pad), (0, 0), (0, 0)))),
             jnp.asarray(np.pad(vnum, ((0, pad), (0, 0), (0, 0)))),
             train=False,
         )[:n]
-        metrics = binary_metrics(logits, jnp.asarray(vlab))
+
+    def doc_eval(params) -> dict[str, float]:
+        metrics = binary_metrics(valid_doc_logits(params), jnp.asarray(vlab))
         return {f"validation_{k}_score": round(float(v), 6) for k, v in metrics.items()}
 
     (
@@ -644,16 +814,26 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
         ckpt_dir,
         params,
         opt_state,
+        ema,
         start_step,
         journal_floor,
     ) = _layout_run_setup(tcfg, run_dir, trainer)
+
+    def packaged_doc_params(step):
+        # Same invariant as fit/PP: evals and the shipped artifact use the
+        # debiased EMA when enabled.
+        from mlops_tpu.train.loop import packaged_or_raw
+
+        return packaged_or_raw(ema, params, tcfg.ema_decay, step)
+
     history: list[dict] = []
     with JsonlWriter(run_dir / "metrics.jsonl") as writer:
         for step in range(start_step + 1, tcfg.steps + 1):
             idx = _batch_indices(dcat.shape[0], batch, tcfg.seed, step)
-            params, opt_state, loss = trainer.step_fn(
+            params, opt_state, ema, loss = trainer.step_fn(
                 params,
                 opt_state,
+                ema,
                 jnp.asarray(dcat[idx]),
                 jnp.asarray(dnum[idx]),
                 jnp.asarray(dlab[idx]),
@@ -662,19 +842,22 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
                 record = {
                     "step": step,
                     "loss": round(float(loss), 6),
-                    **doc_eval(params),
+                    **doc_eval(packaged_doc_params(step)),
                 }
                 if step > journal_floor:  # no duplicate rows on resume
                     writer.write(record)
                 history.append(record)
             _maybe_checkpoint(
-                ckpt_dir, params, opt_state, step, ckpt_every, tcfg.steps
+                ckpt_dir, params, opt_state, ema, step, ckpt_every, tcfg.steps
             )
 
-    params_host = jax.device_get(params)
+    final_params = packaged_doc_params(max(start_step, tcfg.steps))
+    params_host = jax.device_get(final_params)
+    # Kept alongside the bundle for backward compatibility with round-4
+    # tooling that read the raw tree.
     atomic_write(run_dir / "doc_params.msgpack", tree_bytes(params_host))
     final = _final_validation_metrics(
-        history, tcfg.steps, lambda: doc_eval(params)
+        history, tcfg.steps, lambda: doc_eval(final_params)
     )
     result = TrainResult(
         params=params_host,
@@ -683,9 +866,43 @@ def _run_doc_training(config, run_dir, train_ds, valid_ds) -> PipelineResult:
         steps=tcfg.steps,
         packaged_step=tcfg.steps,
     )
+    # Deployment path (VERDICT r4 #4): every trained model becomes a
+    # servable, versioned artifact — doc models package as the 'doc'
+    # bundle flavor (params + preprocessor + doc layout in the manifest)
+    # and register a models:/ URI; scoring runs offline via
+    # `predict-file` over record-history CSVs
+    # (ref: `02-register-model.ipynb:431-440` invariant).
+    from mlops_tpu.train.calibrate import calibration_record
+
+    calibration = calibration_record(
+        np.asarray(valid_doc_logits(final_params)), np.asarray(vlab)
+    )
+    mesh_desc = (
+        f"long_context dp{dp}xsp{mesh.shape['seq']}" if mesh is not None
+        else "long_context dense"
+    )
+    bundle_dir, model_uri = _package_and_register(
+        config,
+        run_dir,
+        params_host,
+        preprocessor,
+        train_ds,
+        metrics=final,
+        bundle_tags={
+            "run_name": run_name or run_dir.name,
+            "experiment": config.registry.experiment_name,
+            "trained_with": mesh_desc,
+        },
+        registry_tags={
+            "run_name": run_name or run_dir.name,
+            **{k: f"{v:.6f}" for k, v in final.items()},
+        },
+        register=register,
+        calibration=calibration,
+    )
     return PipelineResult(
-        bundle_dir=None,
-        model_uri=None,
+        bundle_dir=bundle_dir,
+        model_uri=model_uri,
         train_result=result,
         run_dir=run_dir,
     )

@@ -51,7 +51,7 @@ from mlops_tpu.models.ft_transformer import (
 )
 from mlops_tpu.parallel.pipeline import make_pipeline
 from mlops_tpu.schema.features import SCHEMA
-from mlops_tpu.train.loop import make_optimizer, sigmoid_bce, warn_ema_unsupported
+from mlops_tpu.train.loop import make_optimizer, sigmoid_bce, update_ema
 
 
 class BertPPEmbed(nn.Module):
@@ -183,11 +183,15 @@ merge_bert_params = merge_trunk_params  # bert-era name, same function
 @dataclasses.dataclass
 class PPTrainStep:
     forward_fn: Callable  # (pp_params, cat, num) -> logits[N]
-    step_fn: Callable  # (pp_params, opt_state, cat, num, lab) -> (pp_params, opt_state, loss)
+    step_fn: Callable  # (pp_params, opt_state, ema, cat, num, lab) ->
+    # (pp_params, opt_state, ema, loss); ema is None (empty pytree) when
+    # train.ema_decay == 0 and threads through untouched
     params: Any  # PP layout, stage leaves sharded over 'stage'
     opt_state: Any
     stages: int
     microbatches: int
+    ema: Any = None  # zero-init Polyak accumulator in the PP layout
+    # (inherits each param's sharding) when ema_decay > 0
 
 
 def make_pp_train_step(
@@ -243,7 +247,6 @@ def make_pp_train_step(
             "shard_map without an rng stream; long_context.py makes the "
             "same trade for the ring)"
         )
-    warn_ema_unsupported(train_config, "the pipeline-parallel trainer")
     micro = train_config.pipeline_microbatches
     dp = mesh.shape.get("data", 1)
     if train_config.batch_size % micro or (train_config.batch_size // micro) % dp:
@@ -318,13 +321,18 @@ def make_pp_train_step(
 
     optimizer = make_optimizer(train_config)
 
-    def step(pp, opt_state, cat, num, lab):
+    decay = train_config.ema_decay
+
+    def step(pp, opt_state, ema, cat, num, lab):
         def loss_of(p):
             return sigmoid_bce(forward(p, cat, num), lab, train_config.pos_weight)
 
         loss, grads = jax.value_and_grad(loss_of)(pp)
         updates, opt_state = optimizer.update(grads, opt_state, pp)
-        return optax.apply_updates(pp, updates), opt_state, loss
+        pp = optax.apply_updates(pp, updates)
+        if decay:  # static at trace time; ema=None threads through otherwise
+            ema = update_ema(ema, pp, decay)
+        return pp, opt_state, ema, loss
 
     # Placement: stage-stacked leaves shard their leading axis over
     # 'stage'; embed/head replicate. The optimizer state inherits the
@@ -343,6 +351,9 @@ def make_pp_train_step(
     # a donated first step would delete those buffers on TPU (the fit()
     # donation bug class) — for this trainer activations dominate memory,
     # so donation buys ~nothing.
+    # zeros_like inherits each leaf's committed sharding, so the EMA
+    # shadow lives stage-sharded next to its param with no collectives.
+    ema0 = jax.tree_util.tree_map(jnp.zeros_like, pp_params) if decay else None
     return PPTrainStep(
         forward_fn=jax.jit(forward),
         step_fn=jax.jit(step),
@@ -350,4 +361,5 @@ def make_pp_train_step(
         opt_state=opt_state,
         stages=stages,
         microbatches=micro,
+        ema=ema0,
     )

@@ -93,13 +93,41 @@ def test_sp_training_step_loss_decreases(documents):
     losses = []
     for i in range(25):
         idx = rng.integers(0, cat.shape[0], batch)
-        params, opt_state, loss = trainer.step_fn(
-            params, opt_state,
+        params, opt_state, _, loss = trainer.step_fn(
+            params, opt_state, trainer.ema,
             jnp.asarray(cat[idx]), jnp.asarray(num[idx]), jnp.asarray(lab[idx]),
         )
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_doc_trainer_accumulates_ema(documents):
+    """ema_decay>0 on the document trainer: the accumulator threads
+    through step_fn and the one-step debiased average equals the updated
+    params (zero init ⇒ ema/(1-d) == params after step 1)."""
+    from mlops_tpu.train.loop import debias_ema
+
+    cat, num, lab = documents
+    trainer = make_doc_train_step(
+        doc_config(),
+        TrainConfig(learning_rate=1e-3, ema_decay=0.9),
+        mesh=None,
+    )
+    assert trainer.ema is not None
+    take = 8
+    params, opt_state, ema, _ = trainer.step_fn(
+        trainer.params, trainer.opt_state, trainer.ema,
+        jnp.asarray(cat[:take]), jnp.asarray(num[:take]),
+        jnp.asarray(lab[:take]),
+    )
+    debiased = debias_ema(ema, 0.9, 1)
+    for e, p in zip(
+        jax.tree_util.tree_leaves(debiased), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(e), np.asarray(p), rtol=1e-5, atol=1e-7
+        )
 
 
 def test_sp_step_matches_dense_step(documents):
@@ -116,11 +144,11 @@ def test_sp_step_matches_dense_step(documents):
         doc_config(seq_parallel=True), tconfig, mesh=mesh, seed=3
     )
     # Identical seeds -> identical init (same module tree/names).
-    p_d, o_d, loss_d = dense.step_fn(
-        dense.params, dense.opt_state, cat_j, num_j, lab_j
+    p_d, o_d, _, loss_d = dense.step_fn(
+        dense.params, dense.opt_state, None, cat_j, num_j, lab_j
     )
-    p_r, o_r, loss_r = ring.step_fn(
-        ring.params, ring.opt_state, cat_j, num_j, lab_j
+    p_r, o_r, _, loss_r = ring.step_fn(
+        ring.params, ring.opt_state, None, cat_j, num_j, lab_j
     )
     np.testing.assert_allclose(float(loss_d), float(loss_r), atol=1e-4)
     flat_d = jax.tree_util.tree_leaves(p_d)

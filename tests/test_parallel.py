@@ -101,6 +101,50 @@ def test_sharded_train_step_runs_and_matches_single_device(family, model_paralle
     assert abs(float(loss) - ref_loss) < 1e-4
 
 
+def test_sharded_train_step_accumulates_ema():
+    """ema_decay>0 on the DP/TP step: the accumulator starts at zero,
+    updates to (1-d)*params after one step, and lives on the params'
+    shardings (no replicated shadow of a TP-sharded layer)."""
+    from mlops_tpu.train.loop import debias_ema
+
+    config = ModelConfig(
+        family="mlp", hidden_dims=(32, 32), dropout=0.0, precision="f32"
+    )
+    tconfig = TrainConfig(
+        batch_size=32, steps=1, learning_rate=1e-3, ema_decay=0.9
+    )
+    model = build_model(config)
+    variables = init_params(model, jax.random.PRNGKey(0))
+    optimizer = make_optimizer(tconfig)
+    mesh = make_mesh(8, model_parallel=2)
+    step_fn, shardings = make_sharded_train_step(
+        model, optimizer, tconfig, mesh, variables["params"]
+    )
+    assert shardings.ema is not None
+    state = TrainState(
+        params=variables["params"],
+        opt_state=optimizer.init(variables["params"]),
+        step=jnp.asarray(0, jnp.int32),
+        rng=jax.random.PRNGKey(1),
+        ema=jax.tree_util.tree_map(jnp.zeros_like, variables["params"]),
+    )
+    cat, num, lab = _batch(32)
+    new_state, _ = step_fn(state, cat, num, lab, jax.random.PRNGKey(2))
+    # One step from a zero accumulator: debiased EMA == updated params.
+    debiased = debias_ema(new_state.ema, tconfig.ema_decay, new_state.step)
+    for e, p in zip(
+        jax.tree_util.tree_leaves(debiased),
+        jax.tree_util.tree_leaves(new_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(e), np.asarray(p), rtol=1e-5)
+    # The accumulator adopted the param shardings (spec match, not device).
+    for e_sh, p_sh in zip(
+        jax.tree_util.tree_leaves(shardings.ema),
+        jax.tree_util.tree_leaves(shardings.params),
+    ):
+        assert e_sh.spec == p_sh.spec
+
+
 def test_sharded_batch_scorer_matches_local(tiny_pipeline):
     from mlops_tpu.bundle import load_bundle
 

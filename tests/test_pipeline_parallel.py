@@ -160,7 +160,7 @@ def test_pp_train_step_decreases_loss():
     params, opt_state = trainer.params, trainer.opt_state
     losses = []
     for _ in range(8):
-        params, opt_state, loss = trainer.step_fn(params, opt_state, cat, num, lab)
+        params, opt_state, _, loss = trainer.step_fn(params, opt_state, trainer.ema, cat, num, lab)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
     assert np.isfinite(losses).all()
@@ -189,7 +189,7 @@ def test_pp_split_merge_roundtrip_and_packaging_parity():
     trainer = make_pp_train_step(model_config, train_config, mesh)
     cat, num, lab = _pp_batch(train_config.batch_size)
     params, opt_state = trainer.params, trainer.opt_state
-    params, _, _ = trainer.step_fn(params, opt_state, cat, num, lab)
+    params, _, _, _ = trainer.step_fn(params, opt_state, None, cat, num, lab)
     merged = merge_bert_params(jax.device_get(params))
     logits = dense.apply({"params": merged}, cat, num, train=False)
     assert np.isfinite(np.asarray(logits)).all()
@@ -299,8 +299,8 @@ def test_pp_trains_at_bf16_like_the_shipped_config():
     mesh = make_nd_mesh({"data": 2, "stage": 4})
     trainer = make_pp_train_step(model_config, train_config, mesh)
     cat, num, lab = _pp_batch(train_config.batch_size)
-    params, _, loss = trainer.step_fn(
-        trainer.params, trainer.opt_state, cat, num, lab
+    params, _, _, loss = trainer.step_fn(
+        trainer.params, trainer.opt_state, None, cat, num, lab
     )
     assert np.isfinite(float(loss))
     assert all(
@@ -326,8 +326,8 @@ def test_pp_remat_changes_nothing_numerically():
             mesh,
             seed=11,
         )
-        params, _, loss = trainer.step_fn(
-            trainer.params, trainer.opt_state, cat, num, lab
+        params, _, _, loss = trainer.step_fn(
+            trainer.params, trainer.opt_state, None, cat, num, lab
         )
         results.append((jax.device_get(params), float(loss)))
     (p0, l0), (p1, l1) = results
@@ -370,7 +370,7 @@ def test_pp_stage_params_shard_one_stage_per_device():
         assert leading_spec(leaf) == "stage", leaf.shape
 
     cat, num, lab = _pp_batch(train_config.batch_size)
-    params, _, _ = trainer.step_fn(trainer.params, trainer.opt_state, cat, num, lab)
+    params, _, _, _ = trainer.step_fn(trainer.params, trainer.opt_state, None, cat, num, lab)
     assert leading_spec(jax.tree.leaves(params["stages"])[0]) == "stage"
 
 
@@ -497,11 +497,71 @@ def test_run_layout_training_pp_resumes_from_checkpoint(tmp_path):
     assert "validation_roc_auc_score" in again.train_result.metrics
 
 
-def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
-    """`train` on a doc_records+seq_parallel config runs the ring trainer
-    end-to-end and saves params + metrics (document models have no
-    single-record serving artifact)."""
+def test_run_layout_training_pp_with_ema_packages_and_resumes(tmp_path):
+    """ema_decay>0 on the PP product path: the EMA accumulator trains,
+    checkpoints, RESUMES (the ema tree rides the layout checkpoint), and
+    the packaged bundle carries the debiased average — which must differ
+    from the raw last-step params."""
+    import json
+
+    from mlops_tpu.bundle import load_bundle
     from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.pipeline import run_layout_training
+    from mlops_tpu.train.pipeline_parallel import merge_bert_params
+
+    def make_config(steps, decay):
+        config = Config()
+        config.data.rows = 1500
+        config.model = ModelConfig(
+            family="bert", token_dim=16, depth=4, heads=2, dropout=0.0,
+            precision="f32", pipeline_stages=4,
+        )
+        config.train.batch_size = 16
+        config.train.steps = steps
+        config.train.eval_every = 100
+        config.train.warmup_steps = 2
+        config.train.checkpoint_every = 2
+        config.train.pipeline_microbatches = 4
+        config.train.ema_decay = decay
+        config.train.distill_bulk = False
+        config.registry.run_root = str(tmp_path / "runs")
+        config.registry.root = str(tmp_path / "registry")
+        return config
+
+    run_layout_training(make_config(2, 0.9), register=False, run_name="ema-pp")
+    ckpt_dir = tmp_path / "runs" / "ema-pp" / "checkpoints"
+    assert json.loads((ckpt_dir / "latest.json").read_text())["step"] == 2
+
+    result = run_layout_training(
+        make_config(4, 0.9), register=False, run_name="ema-pp"
+    )
+    assert json.loads((ckpt_dir / "latest.json").read_text())["step"] == 4
+    bundle = load_bundle(result.bundle_dir)
+
+    # An identically-seeded run WITHOUT ema ships different (raw) params.
+    raw = run_layout_training(
+        make_config(4, 0.0), register=False, run_name="raw-pp"
+    )
+    raw_bundle = load_bundle(raw.bundle_dir)
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(
+            jax.tree.leaves(bundle.variables), jax.tree.leaves(raw_bundle.variables)
+        )
+    ]
+    assert max(diffs) > 1e-7, diffs
+
+
+def test_run_layout_training_doc_trains_and_deploys(tmp_path):
+    """`train` on a doc_records+seq_parallel config runs the ring trainer
+    end-to-end AND deploys (VERDICT r4 #4): the run registers a models:/
+    URI, the 'doc' bundle flavor reloads, and the loaded artifact scores
+    record histories — one calibrated probability per document."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.long_context import group_documents
     from mlops_tpu.train.pipeline import run_layout_training
 
     config = Config()
@@ -514,12 +574,79 @@ def test_run_layout_training_doc_trains_and_saves_params(tmp_path):
     config.train.steps = 4
     config.train.eval_every = 2
     config.registry.run_root = str(tmp_path / "runs")
+    config.registry.root = str(tmp_path / "registry")
     result = run_layout_training(config)
 
-    assert result.bundle_dir is None and result.model_uri is None
+    assert result.model_uri and result.bundle_dir is not None
     assert (result.run_dir / "doc_params.msgpack").exists()
     assert (result.run_dir / "metrics.jsonl").exists()
     assert "validation_roc_auc_score" in result.train_result.metrics
+
+    bundle = load_bundle(result.bundle_dir)
+    assert bundle.flavor == "doc"
+    assert bundle.model_config.doc_records == 3
+    rng = np.random.default_rng(0)
+    from mlops_tpu.schema import SCHEMA
+
+    rows = 7  # 2 full documents + 1 dropped tail row
+    cat = rng.integers(0, 2, (rows, SCHEMA.num_categorical)).astype(np.int32)
+    num = rng.normal(size=(rows, SCHEMA.num_numeric)).astype(np.float32)
+    dcat, dnum = group_documents(cat, num, 3)
+    assert dcat.shape == (2, 3, SCHEMA.num_categorical)
+    logits = bundle.model.apply(
+        {"params": bundle.variables["params"]},
+        jnp.asarray(dcat), jnp.asarray(dnum), train=False,
+    )
+    probs = jax.nn.sigmoid(jnp.asarray(logits) / bundle.temperature)
+    assert probs.shape == (2,)
+    assert np.isfinite(np.asarray(probs)).all()
+
+    # The single-record serving engine refuses the flavor loudly.
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    with pytest.raises(ValueError, match="predict-file"):
+        InferenceEngine(bundle)
+
+
+def test_predict_file_scores_doc_bundle(tmp_path, capsys):
+    """The offline deployment surface: `predict-file` on a doc bundle
+    groups a record-history CSV into documents and prints one calibrated
+    probability per document (plus the grouping audit fields)."""
+    import json
+
+    from mlops_tpu.cli import main
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    config = Config()
+    config.data.rows = 900
+    config.model = ModelConfig(
+        family="bert", token_dim=16, depth=1, heads=2, dropout=0.0,
+        precision="f32", doc_records=3,  # dense doc trainer (no ring)
+    )
+    config.train.batch_size = 8
+    config.train.steps = 2
+    config.train.eval_every = 2
+    config.registry.run_root = str(tmp_path / "runs")
+    config.registry.root = str(tmp_path / "registry")
+    result = run_layout_training(config, register=False)
+
+    csv_path = tmp_path / "history.csv"
+    columns, labels = generate_synthetic(8, seed=3)  # 2 docs + 2 tail rows
+    write_csv_columns(csv_path, columns, labels)
+    rc = main([
+        "predict-file",
+        f"data.train_path={csv_path}",
+        f"serve.model_directory={result.bundle_dir}",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["documents"] == 2
+    assert out["records_per_document"] == 3
+    assert out["rows_dropped"] == 2
+    assert len(out["predictions"]) == 2
+    assert all(0.0 <= p <= 1.0 for p in out["predictions"])
 
 
 def test_journal_max_step_survives_truncated_line(tmp_path):
