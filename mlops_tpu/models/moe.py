@@ -80,17 +80,26 @@ class MoEFeedForward(nn.Module):
         )
         b_out = self.param("experts_out_bias", nn.initializers.zeros_init(), (e, d))
 
-        xb = x.astype(self.dtype)
+        # Expert einsums run on [N*S, ...] tokens: the backward's dW then
+        # has ONE contracting dim (tokens) per expert instead of the
+        # two-contracting-dims dot_general XLA:CPU can't map to a fast
+        # GEMM (same fix as the shared attention/MLP layers). Params and
+        # numerics unchanged — pure reshape.
+        n, s, _ = x.shape
+        xb = x.astype(self.dtype).reshape(n * s, d)
         h = (
-            jnp.einsum("nsd,edf->nsef", xb, w_in.astype(self.dtype))
-            + b_in.astype(self.dtype)[None, None]
+            jnp.einsum("td,edf->tef", xb, w_in.astype(self.dtype))
+            + b_in.astype(self.dtype)[None]
         )
         h = nn.gelu(h)
         y = (
-            jnp.einsum("nsef,efd->nsed", h, w_out.astype(self.dtype))
-            + b_out.astype(self.dtype)[None, None]
+            jnp.einsum("tef,efd->ted", h, w_out.astype(self.dtype))
+            + b_out.astype(self.dtype)[None]
         )
-        return jnp.einsum("nse,nsed->nsd", weights.astype(self.dtype), y)
+        out = jnp.einsum(
+            "te,ted->td", weights.astype(self.dtype).reshape(n * s, e), y
+        )
+        return out.reshape(n, s, d)
 
 
 class MoEBlock(nn.Module):

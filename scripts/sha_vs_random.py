@@ -70,14 +70,11 @@ def main() -> None:
             rows[strategy].append(
                 res.best_metrics["validation_roc_auc_score"]
             )
-    # Mirror run_sha's budgeting from the SAME hconfig fields (eta clamp
-    # included) so the reported budget tracks the steps actually spent.
-    eta = max(2, hconfig.eta)
     budget = trials * steps
-    sha_counts = [
-        max(1, trials // eta**r) for r in range(max(1, hconfig.sha_rungs))
-    ]
-    sha_budget = max(1, budget // sum(sha_counts)) * sum(sha_counts)
+    # ACTUAL sha spend, not a re-derivation of run_sha's plan: each trial
+    # record carries the steps it had trained when it was eliminated (or
+    # finished), so the sum is what the sweep really spent.
+    sha_budget = sum(t["steps"] for t in res.trials)
     print(
         json.dumps(
             {
