@@ -16,6 +16,7 @@ Python (`01-train-model.ipynb:252-330`). TPU-first structure:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from pathlib import Path
 from typing import Any, Callable
@@ -30,8 +31,6 @@ from mlops_tpu.config import TrainConfig
 from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.train import checkpoint as ckpt
 from mlops_tpu.train.metrics import binary_metrics
-from mlops_tpu.utils.jsonl import JsonlWriter
-
 
 class TrainState(struct.PyTreeNode):
     params: Any
@@ -126,6 +125,38 @@ def training_loss(
     for leaf in jax.tree_util.tree_leaves(aux_state):
         loss = loss + jnp.mean(leaf)
     return loss
+
+
+@contextlib.contextmanager
+def metric_writers(metrics_path, config: TrainConfig):
+    """THE metric-sink contract, shared by ``fit`` and every layout loop
+    (train/pipeline.py): jsonl when a path is given, TensorBoard when
+    ``train.tensorboard_dir`` is set — no trainer may silently ignore
+    either knob. Yields ``emit(record)``; both sinks close on every exit
+    (the TB writer buffers events, and a mid-run crash must not lose
+    exactly the records a debugging session needs)."""
+    from mlops_tpu.utils.jsonl import JsonlWriter
+
+    writer = JsonlWriter(metrics_path) if metrics_path else None
+    tb = None
+    if config.tensorboard_dir:
+        from mlops_tpu.utils.tboard import TensorBoardWriter
+
+        tb = TensorBoardWriter(config.tensorboard_dir)
+
+    def emit(record: dict) -> None:
+        if writer is not None:
+            writer.write(record)
+        if tb is not None:
+            tb.write(record)
+
+    try:
+        yield emit
+    finally:
+        if writer is not None:
+            writer.close()
+        if tb is not None:
+            tb.close()
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -281,16 +312,10 @@ def fit(
             best_params, best_record = restored_best
             best_auc = best_record["validation_roc_auc_score"]
 
-    writer = JsonlWriter(metrics_path) if metrics_path else None
-    tb_writer = None
-    if config.tensorboard_dir:
-        from mlops_tpu.utils.tboard import TensorBoardWriter
-
-        tb_writer = TensorBoardWriter(config.tensorboard_dir)
     history: list[dict[str, float]] = []
     step = start_step
     last_ckpt = start_step
-    try:
+    with metric_writers(metrics_path, config) as emit:
         while step < config.steps:
             # Final window shrinks so the step budget is honored exactly even
             # when steps % eval_every != 0 or when resuming mid-window.
@@ -328,10 +353,7 @@ def fit(
                 if checkpoint_dir is not None:
                     ckpt.save_best(Path(checkpoint_dir), best_params, best_record)
             history.append(record)
-            if writer:
-                writer.write(record)
-            if tb_writer:
-                tb_writer.write(record)
+            emit(record)
             if (
                 checkpoint_dir is not None
                 and step - last_ckpt >= config.checkpoint_every
@@ -340,14 +362,6 @@ def fit(
                 last_ckpt = step
         if checkpoint_dir is not None and step > last_ckpt:
             ckpt.save_checkpoint(checkpoint_dir, state, step)
-    finally:
-        # Close on every exit: the tensorboard writer buffers events
-        # (flush_secs), so a mid-run crash would otherwise lose exactly
-        # the records the interactive debugging session needs.
-        if writer:
-            writer.close()
-        if tb_writer:
-            tb_writer.close()
 
     # step == 0 (eval-only / fully-resumed-with-no-new-steps runs that never
     # entered the loop THIS process but restored step>0 are fine; a literal

@@ -431,6 +431,15 @@ def _layout_run_setup(tcfg, run_dir: Path, trainer):
     )
 
 
+def _metric_writers(run_dir: Path, tcfg):
+    """The layout loops' metric sinks — the ONE shared contract
+    (`train/loop.py metric_writers`, also used by ``fit``): metrics.jsonl
+    always, TensorBoard when ``train.tensorboard_dir`` is set."""
+    from mlops_tpu.train.loop import metric_writers
+
+    return metric_writers(run_dir / "metrics.jsonl", tcfg)
+
+
 def _maybe_checkpoint(ckpt_dir, params, opt_state, ema, step, ckpt_every, steps):
     from mlops_tpu.train.checkpoint import save_checkpoint
 
@@ -512,7 +521,6 @@ def _run_pp_training(
         make_pp_train_step,
         merge_bert_params,
     )
-    from mlops_tpu.utils.jsonl import JsonlWriter
 
     stages = config.model.pipeline_stages
     n_dev = len(jax.devices())
@@ -558,7 +566,7 @@ def _run_pp_training(
 
     history: list[dict] = []
     merged = None
-    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+    with _metric_writers(run_dir, tcfg) as emit:
         for step in range(start_step + 1, tcfg.steps + 1):
             idx = _batch_indices(train_ds.n, tcfg.batch_size, tcfg.seed, step)
             params, opt_state, ema, loss = trainer.step_fn(
@@ -574,7 +582,7 @@ def _run_pp_training(
                 metrics = evaluate(dense_model, merged, valid_ds)
                 record = {"step": step, "loss": round(float(loss), 6), **metrics}
                 if step > journal_floor:  # no duplicate rows on resume
-                    writer.write(record)
+                    emit(record)
                 history.append(record)
             _maybe_checkpoint(
                 ckpt_dir, params, opt_state, ema, step, ckpt_every, tcfg.steps
@@ -637,7 +645,6 @@ def _run_tp_training(
 
     from mlops_tpu.train.loop import evaluate, packaged_or_raw
     from mlops_tpu.train.tensor_parallel import make_tp_trainer
-    from mlops_tpu.utils.jsonl import JsonlWriter
 
     dense_model_cfg = dataclasses.replace(config.model, tensor_parallel=0)
     trainer = make_tp_trainer(
@@ -676,7 +683,7 @@ def _run_tp_training(
 
     history: list[dict] = []
     packaged = None
-    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+    with _metric_writers(run_dir, tcfg) as emit:
         for step in range(start_step + 1, tcfg.steps + 1):
             idx = _batch_indices(train_ds.n, tcfg.batch_size, tcfg.seed, step)
             state, loss = trainer.step_fn(
@@ -691,7 +698,7 @@ def _run_tp_training(
                 metrics = evaluate(trainer.model, packaged, valid_ds)
                 record = {"step": step, "loss": round(float(loss), 6), **metrics}
                 if step > journal_floor:  # no duplicate rows on resume
-                    writer.write(record)
+                    emit(record)
                 history.append(record)
             _maybe_checkpoint(
                 ckpt_dir, state.params, state.opt_state, state.ema,
@@ -759,7 +766,6 @@ def _run_doc_training(
     from mlops_tpu.train.long_context import make_doc_train_step, make_documents
     from mlops_tpu.train.metrics import binary_metrics
     from mlops_tpu.utils.io import atomic_write
-    from mlops_tpu.utils.jsonl import JsonlWriter
 
     n_dev = len(jax.devices())
     mesh = None
@@ -827,7 +833,7 @@ def _run_doc_training(
         return packaged_or_raw(ema, params, tcfg.ema_decay, step)
 
     history: list[dict] = []
-    with JsonlWriter(run_dir / "metrics.jsonl") as writer:
+    with _metric_writers(run_dir, tcfg) as emit:
         for step in range(start_step + 1, tcfg.steps + 1):
             idx = _batch_indices(dcat.shape[0], batch, tcfg.seed, step)
             params, opt_state, ema, loss = trainer.step_fn(
@@ -845,7 +851,7 @@ def _run_doc_training(
                     **doc_eval(packaged_doc_params(step)),
                 }
                 if step > journal_floor:  # no duplicate rows on resume
-                    writer.write(record)
+                    emit(record)
                 history.append(record)
             _maybe_checkpoint(
                 ckpt_dir, params, opt_state, ema, step, ckpt_every, tcfg.steps

@@ -65,6 +65,40 @@ def test_tp_training_packages_servable_bundle(tmp_path):
     assert 0.0 <= response["predictions"][0] <= 1.0
 
 
+def test_tp_moe_trains_expert_parallel_and_serves(tmp_path):
+    """The EP stretch (VERDICT r4 #3): family=moe + tensor_parallel=K is
+    expert parallelism as a PRODUCT config — the stacked expert weights
+    shard over 'model' (PARAM_RULES 'experts_'), the run packages, and
+    the bundle serves the single-record contract."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.schema import LoanApplicant
+    from mlops_tpu.serve.engine import InferenceEngine
+    from mlops_tpu.train.pipeline import run_layout_training
+    from mlops_tpu.train.tensor_parallel import make_tp_trainer
+
+    config = _tp_config(
+        tmp_path, family="moe", num_experts=4, depth=1, heads=2,
+    )
+    # The expert axis really lands on 'model': check the trainer's own
+    # shardings before the full run.
+    trainer = make_tp_trainer(config)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(
+            trainer.shardings.params
+        )[0]
+    }
+    expert_specs = [s.spec for name, s in flat.items() if "experts_in" in name]
+    assert expert_specs and all("model" in str(sp) for sp in expert_specs)
+
+    result = run_layout_training(config)
+    assert result.model_uri and result.bundle_dir is not None
+    bundle = load_bundle(result.bundle_dir)
+    engine = InferenceEngine(bundle, buckets=(1,), enable_grouping=False)
+    response = engine.predict_records([LoanApplicant().model_dump()])
+    assert 0.0 <= response["predictions"][0] <= 1.0
+
+
 def test_tp_training_resumes_from_checkpoint(tmp_path):
     """Preemption elasticity on the TP path: a re-invocation continues
     from the newest checkpoint (no duplicate metric rows), and the state

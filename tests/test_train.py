@@ -139,6 +139,32 @@ def test_tensorboard_writer_emits_event_file(tmp_path):
     assert events and events[0].stat().st_size > 0
 
 
+def test_tensorboard_writer_honored_by_layout_trainers(tmp_path):
+    """The knob must work on EVERY trainer, not just fit (the silently-
+    ignored-knob class): a TP layout run with tensorboard_dir set writes
+    event files through the shared _metric_writers sink."""
+    pytest.importorskip("torch.utils.tensorboard")
+    from mlops_tpu.config import Config, ModelConfig
+    from mlops_tpu.train.pipeline import run_layout_training
+
+    config = Config()
+    config.data.rows = 800
+    config.model = ModelConfig(
+        family="mlp", hidden_dims=(16,), dropout=0.0, precision="f32",
+        tensor_parallel=2,
+    )
+    config.train.batch_size = 32
+    config.train.steps = 2
+    config.train.eval_every = 2
+    config.train.distill_bulk = False
+    config.train.tensorboard_dir = str(tmp_path / "tb")
+    config.registry.run_root = str(tmp_path / "runs")
+    config.registry.root = str(tmp_path / "reg")
+    run_layout_training(config, register=False)
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+
+
 def test_ema_debias_matches_closed_form():
     """ema_t = d*ema + (1-d)*p from zeros; debiased by 1-d^t equals the
     geometrically-weighted average of the params seen so far."""
