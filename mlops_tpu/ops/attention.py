@@ -421,9 +421,17 @@ def attend(
     scale: float | None = None,
     use_flash: bool | None = None,
 ) -> jnp.ndarray:
-    """Dispatch: flash kernel for long sequences, XLA einsum for short."""
+    """Dispatch: flash kernel for long sequences ON TPU, XLA einsum
+    otherwise. The backend gate matters for product paths: off-TPU the
+    Pallas kernels run in INTERPRET mode (orders of magnitude slower than
+    XLA's fused dense attention), so a CPU-fallback doc-model run must
+    not auto-route into them — and with the round-5 Pallas backward that
+    would now cover training too. ``use_flash=True`` still forces the
+    kernel anywhere (the equivalence tests exercise it on CPU)."""
     if use_flash is None:
-        use_flash = q.shape[1] >= FLASH_MIN_SEQ
+        use_flash = (
+            q.shape[1] >= FLASH_MIN_SEQ and jax.default_backend() == "tpu"
+        )
     if use_flash:
         return flash_attention(q, k, v, scale)
     return reference_attention(q, k, v, scale)

@@ -32,6 +32,7 @@ from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.train import checkpoint as ckpt
 from mlops_tpu.train.metrics import binary_metrics
 
+
 class TrainState(struct.PyTreeNode):
     params: Any
     opt_state: Any
