@@ -135,6 +135,29 @@ def test_run_sha_sharded_matches_unsharded(splits):
     )
 
 
+def test_architecture_sweep_composes_with_sha(splits, tmp_path):
+    """hpo.strategy='sha' must flow through the architecture-group
+    driver: each group's inner sweep runs successive halving, the
+    cross-group winner carries rung metadata, and group-granular resume
+    caches the sha results too."""
+    from mlops_tpu.train.hpo import run_architecture_hpo
+
+    train_ds, valid_ds = splits
+    base = ModelConfig(family="mlp", hidden_dims=(32,), embed_dim=4)
+    hconfig = HPOConfig(
+        trials=4, steps=30, seed=9, strategy="sha", eta=2, sha_rungs=2,
+        architectures=("hidden_dims=16", "hidden_dims=32"),
+    )
+    win_cfg, result = run_architecture_hpo(
+        base, TrainConfig(batch_size=256), hconfig, train_ds, valid_ds,
+        resume_dir=tmp_path,
+    )
+    assert win_cfg.hidden_dims in ((16,), (32,))
+    assert len(result.trials) == 8
+    assert all("rung" in t for t in result.trials)
+    assert (tmp_path / "hpo_groups" / "group_1.json").exists()
+
+
 def test_hpo_rejects_unknown_strategy(splits):
     train_ds, valid_ds = splits
     with pytest.raises(ValueError, match="strategy"):

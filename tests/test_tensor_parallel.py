@@ -170,6 +170,24 @@ def test_tp_guards(tmp_path):
         run_layout_training(config)
 
 
+def test_tp_trainer_starts_from_provided_dense_variables(tmp_path):
+    """Pretrain → TP fine-tune: init_variables (e.g. a grafted masked-LM
+    trunk) must become the TP trainer's starting point, same contract as
+    the PP path — not a fresh init."""
+    from mlops_tpu.models import build_model, init_params
+    from mlops_tpu.train.tensor_parallel import make_tp_trainer
+
+    config = _tp_config(tmp_path)
+    dense_cfg = dataclasses.replace(config.model, tensor_parallel=0)
+    provided = init_params(build_model(dense_cfg), jax.random.PRNGKey(99))
+    trainer = make_tp_trainer(config, init_variables=provided)
+    for a, b in zip(
+        jax.tree.leaves(trainer.state.params),
+        jax.tree.leaves(provided["params"]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_tp_with_ema_ships_averaged_params(tmp_path):
     """ema_decay>0 on the TP product path: trains, resumes, and the
     bundle's params differ from an identically-seeded raw run."""
