@@ -1,0 +1,39 @@
+"""tpulint — two-layer static analysis for the TPU hot paths.
+
+The production path (train -> register -> serve -> monitor) only hits its
+latency/goodput targets while the compiled hot paths STAY compiled: one
+stray host sync inside a jitted function, or a dtype-driven recompile, and
+the <5 ms p50 serving target silently dies without any test failing. This
+package keeps the codebase honest on every PR:
+
+- **Layer 1** (`astrules`): named AST rules over the package source — pure
+  ``ast``, no JAX import, so it runs anywhere in milliseconds. Catches
+  TPU-hostile patterns at the source level (host syncs under trace, Python
+  RNG/clock under trace, tracer-dependent branches, jit signatures missing
+  ``static_argnames``/``donate_argnums``, broad excepts, mutable defaults).
+- **Layer 2** (`traces` + `entrypoints`): the framework's REGISTERED jitted
+  entry points (train step, TP step, serve predict) are abstract-evaluated
+  via ``jax.make_jaxpr`` on schema-derived dummy batches — no device code
+  executes — and the resulting jaxprs are checked for recompile and
+  numerics hazards (float64 leaks, weak-type outputs, convert_element_type
+  round-trips, per-bucket shape polymorphism, producer/consumer sharding
+  mismatches).
+
+CLI: ``mlops-tpu analyze [--strict] [paths ...]`` (`analysis/cli.py`);
+CI runs it as a gate before pytest. Suppress a finding inline with
+``# tpulint: disable=TPU101`` (see `docs/static-analysis.md`).
+"""
+
+from __future__ import annotations
+
+from mlops_tpu.analysis.findings import Finding, Severity, format_findings
+from mlops_tpu.analysis.astrules import RULES, analyze_paths, analyze_source
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "Severity",
+    "analyze_paths",
+    "analyze_source",
+    "format_findings",
+]

@@ -1,0 +1,639 @@
+"""Layer 1: AST lint rules over the package source. Pure ``ast`` — this
+module must never import JAX, so the lint runs in milliseconds on any
+machine (pre-commit, docs builds, containers without an accelerator stack).
+
+The rules encode the failure modes that kill compiled-hot-path performance
+without failing any functional test:
+
+======== ============================== =======================================
+ID       name                           catches
+======== ============================== =======================================
+TPU101   host-sync-under-jit            ``.item()/.tolist()/np.asarray/
+                                        jax.device_get/float(tracer)`` inside a
+                                        traced scope — a device->host sync that
+                                        serializes the pipelined dispatch queue
+TPU102   host-rng-or-clock-under-jit    ``random.*`` / ``np.random.*`` /
+                                        ``time.*`` under trace — baked in as a
+                                        compile-time constant, not re-evaluated
+TPU103   tracer-branch                  Python ``if``/``while`` on a traced
+                                        value — either a ConcretizationError or
+                                        a silent per-value recompile
+TPU104   jit-config-arg-needs-static    ``jax.jit`` over a function taking a
+                                        dict/config argument without
+                                        ``static_argnames`` — unhashable args
+                                        fail; hashable ones recompile per value
+TPU105   train-step-missing-donate      a train-step-shaped jit without
+                                        ``donate_argnums`` — params + optimizer
+                                        state get double-buffered in HBM
+TPU201   broad-except                   ``except Exception:`` that does not
+                                        re-raise — swallows device errors
+                                        (XlaRuntimeError, checkify) silently
+TPU202   mutable-default-arg            list/dict/set defaults — shared state
+                                        across calls
+======== ============================== =======================================
+
+Traced-scope detection is heuristic but framework-aware: a function counts
+as traced when it is decorated with (or passed to) ``jax.jit``/``pjit``, or
+passed to a tracing combinator (``lax.scan``, ``vmap``, ``grad``,
+``checkpoint``, …, or this repo's ``checked`` wrapper), including functions
+defined in one scope and jitted in another (`make_train_window`'s
+``run_window`` pattern). Nested functions inherit the traced scope.
+
+Suppress any finding inline with ``# tpulint: disable=TPU101`` on (or
+directly above) the flagged line; see `docs/static-analysis.md`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Iterable
+
+from mlops_tpu.analysis.findings import (
+    Finding,
+    Severity,
+    file_skipped,
+    is_suppressed,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    rule: str
+    name: str
+    severity: Severity
+    summary: str
+
+
+RULES: dict[str, RuleInfo] = {
+    r.rule: r
+    for r in (
+        RuleInfo(
+            "TPU101",
+            "host-sync-under-jit",
+            Severity.ERROR,
+            "host synchronization inside a traced scope",
+        ),
+        RuleInfo(
+            "TPU102",
+            "host-rng-or-clock-under-jit",
+            Severity.ERROR,
+            "Python RNG/clock call inside a traced scope",
+        ),
+        RuleInfo(
+            "TPU103",
+            "tracer-branch",
+            Severity.ERROR,
+            "data-dependent Python branch on a traced value",
+        ),
+        RuleInfo(
+            "TPU104",
+            "jit-config-arg-needs-static",
+            Severity.ERROR,
+            "jit over a dict/config argument without static_argnames",
+        ),
+        RuleInfo(
+            "TPU105",
+            "train-step-missing-donate",
+            Severity.ERROR,
+            "train-step jit without donate_argnums",
+        ),
+        RuleInfo(
+            "TPU201",
+            "broad-except",
+            Severity.ERROR,
+            "broad except swallowing device errors",
+        ),
+        RuleInfo(
+            "TPU202",
+            "mutable-default-arg",
+            Severity.ERROR,
+            "mutable default argument",
+        ),
+    )
+}
+
+# Callables whose FUNCTION argument(s) run under trace. Matched on the last
+# dotted component so ``jax.jit``, ``jax.experimental.pjit.pjit`` and a bare
+# ``jit`` all hit.
+_JIT_NAMES = {"jit", "pjit"}
+_TRACING_COMBINATORS = {
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "associative_scan",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "eval_shape",
+    "make_jaxpr",
+    "custom_vjp",
+    "custom_jvp",
+    "checked",  # utils/debug.py: checkify + jit wrapper
+}
+# Attribute accesses on a traced value that stay STATIC at trace time (shape
+# metadata) — branching on these is fine and idiomatic.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+# Host-sync method calls on any value inside a traced scope.
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Host-sync/materialization calls by dotted name inside a traced scope.
+_SYNC_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "onp.asarray",
+    "onp.array",
+    "jax.device_get",
+    "device_get",
+}
+_RNG_CLOCK_ROOTS = ("random.", "np.random.", "numpy.random.")
+_CLOCK_CALLS = {
+    "time.time",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.process_time",
+    "time.sleep",
+    "datetime.now",
+    "datetime.datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.utcnow",
+}
+_CONFIG_ARG_NAMES = {"config", "cfg", "conf", "options", "opts", "settings"}
+_STEP_NAME_HINTS = ("step", "train", "window")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _fn_args(node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda):
+    a = node.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+
+def _annotation_text(arg: ast.arg) -> str:
+    return ast.unparse(arg.annotation) if arg.annotation is not None else ""
+
+
+def _is_config_like(arg: ast.arg) -> bool:
+    ann = _annotation_text(arg)
+    return (
+        arg.arg.lower() in _CONFIG_ARG_NAMES
+        or "Config" in ann
+        or "dict" in ann
+        or "Dict" in ann
+        or "Mapping" in ann
+    )
+
+
+def _looks_like_train_step(
+    name: str, fn: ast.FunctionDef | ast.AsyncFunctionDef | None
+) -> bool:
+    lowered = name.lower()
+    if any(h in lowered for h in _STEP_NAME_HINTS):
+        return True
+    if fn is not None:
+        args = _fn_args(fn)
+        return bool(args) and args[0].arg == "state"
+    return False
+
+
+_FnDef = ast.FunctionDef | ast.AsyncFunctionDef
+
+
+def _scope_nodes(body: list[ast.stmt]) -> Iterable[ast.AST]:
+    """Every node lexically in this scope: descends into statements and
+    expressions but NOT into nested function/lambda bodies (those are new
+    scopes). Function nodes themselves are yielded (their decorators and
+    default expressions evaluate in THIS scope)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(d for d in node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+        elif isinstance(node, ast.Lambda):
+            stack.extend(node.args.defaults)
+            stack.extend(d for d in node.args.kw_defaults if d is not None)
+        else:
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class _TraceCollector:
+    """Module pre-pass, SCOPE-AWARE: which function-def nodes end up under
+    a JAX trace, and which jit sites need signature checks (TPU104/105).
+
+    ``jax.jit(f)`` marks the ``f`` visible from the call's lexical scope
+    (innermost def outward), so two unrelated functions that share a name
+    in different scopes — common for closure factories that all return a
+    ``predict`` — never contaminate each other."""
+
+    def __init__(self) -> None:
+        self.traced_fns: set[int] = set()  # id() of traced def nodes
+        self.traced_lambdas: set[int] = set()
+        # (site_node, fn_name, resolved_def_or_None, jit_kwargs)
+        self.jit_sites: list[
+            tuple[ast.AST, str, _FnDef | None, set[str]]
+        ] = []
+
+    def collect(self, tree: ast.Module) -> None:
+        self._scope(tree.body, [])
+
+    def _scope(
+        self, body: list[ast.stmt], env: list[dict[str, _FnDef]]
+    ) -> None:
+        local: dict[str, _FnDef] = {}
+        env = [*env, local]
+        nested: list[_FnDef] = []
+        for node in _scope_nodes(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local[node.name] = node
+                nested.append(node)
+        for node in _scope_nodes(body):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorators(node)
+            elif isinstance(node, ast.Call):
+                self._call(node, env)
+        for fn in nested:
+            self._scope(fn.body, env)
+        # Lambda bodies contain no defs/jit calls worth collecting beyond
+        # what _call already marked; rule checks happen in the visitor.
+
+    @staticmethod
+    def _resolve(name: str, env: list[dict[str, _FnDef]]) -> _FnDef | None:
+        for scope in reversed(env):
+            if name in scope:
+                return scope[name]
+        return None
+
+    def _decorators(self, node: _FnDef) -> None:
+        for dec in node.decorator_list:
+            name = _dotted(dec)
+            if name is not None:
+                leaf = name.split(".")[-1]
+                if leaf in _JIT_NAMES | _TRACING_COMBINATORS:
+                    self.traced_fns.add(id(node))
+                    if leaf in _JIT_NAMES:
+                        # bare @jax.jit: no kwargs possible
+                        self.jit_sites.append((node, node.name, node, set()))
+            elif isinstance(dec, ast.Call):
+                dec_name = _dotted(dec.func) or ""
+                leaf = dec_name.split(".")[-1]
+                kwargs = {k.arg for k in dec.keywords if k.arg}
+                if leaf in _JIT_NAMES | _TRACING_COMBINATORS:
+                    self.traced_fns.add(id(node))
+                    if leaf in _JIT_NAMES:
+                        self.jit_sites.append((node, node.name, node, kwargs))
+                elif leaf == "partial" and dec.args:
+                    # @partial(jax.jit, static_argnames=...)
+                    inner = (_dotted(dec.args[0]) or "").split(".")[-1]
+                    if inner in _JIT_NAMES:
+                        self.traced_fns.add(id(node))
+                        self.jit_sites.append((node, node.name, node, kwargs))
+                    elif inner in _TRACING_COMBINATORS:
+                        self.traced_fns.add(id(node))
+
+    def _call(self, node: ast.Call, env: list[dict[str, _FnDef]]) -> None:
+        name = _dotted(node.func) or ""
+        leaf = name.split(".")[-1]
+        if leaf in _JIT_NAMES and node.args:
+            target = node.args[0]
+            kwargs = {k.arg for k in node.keywords if k.arg}
+            if isinstance(target, ast.Name):
+                fn = self._resolve(target.id, env)
+                if fn is not None:
+                    self.traced_fns.add(id(fn))
+                self.jit_sites.append((node, target.id, fn, kwargs))
+            elif isinstance(target, ast.Lambda):
+                self.traced_lambdas.add(id(target))
+        elif leaf in _TRACING_COMBINATORS:
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    fn = self._resolve(arg.id, env)
+                    if fn is not None:
+                        self.traced_fns.add(id(fn))
+                elif isinstance(arg, ast.Lambda):
+                    self.traced_lambdas.add(id(arg))
+
+
+class _RuleVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, collector: _TraceCollector) -> None:
+        self.path = path
+        self.c = collector
+        self.findings: list[Finding] = []
+        self._traced_depth = 0  # >0 while inside a traced scope
+        self._tracer_names: list[set[str]] = []  # param names per traced fn
+
+    # ------------------------------------------------------------- helpers
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        info = RULES[rule]
+        self.findings.append(
+            Finding(
+                rule=info.rule,
+                name=info.name,
+                severity=info.severity,
+                path=self.path,
+                line=getattr(node, "lineno", 0),
+                message=message,
+            )
+        )
+
+    @property
+    def _in_trace(self) -> bool:
+        return self._traced_depth > 0
+
+    def _tracers(self) -> set[str]:
+        out: set[str] = set()
+        for names in self._tracer_names:
+            out |= names
+        return out
+
+    # ------------------------------------------------------ scope tracking
+    def _enter_fn(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    ) -> None:
+        traced = (
+            id(node) in self.c.traced_fns
+            or id(node) in self.c.traced_lambdas
+            or self._in_trace  # nested defs run under the enclosing trace
+        )
+        if traced:
+            self._traced_depth += 1
+            self._tracer_names.append({a.arg for a in _fn_args(node)})
+        else:
+            self._tracer_names.append(set())
+        if not isinstance(node, ast.Lambda):
+            self._check_mutable_defaults(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+        self._tracer_names.pop()
+        if traced:
+            self._traced_depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_fn(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_fn(node)
+
+    # ------------------------------------------------------------- TPU202
+    def _check_mutable_defaults(
+        self, node: ast.FunctionDef | ast.AsyncFunctionDef
+    ) -> None:
+        defaults = [*node.args.defaults, *node.args.kw_defaults]
+        for default in defaults:
+            if default is None:
+                continue
+            mutable = isinstance(default, (ast.List, ast.Dict, ast.Set))
+            if isinstance(default, ast.Call):
+                callee = _dotted(default.func) or ""
+                mutable = callee in {"list", "dict", "set", "bytearray"}
+            if mutable:
+                self._flag(
+                    "TPU202",
+                    default,
+                    f"mutable default argument in {node.name}() is shared "
+                    "across calls; default to None and construct inside",
+                )
+
+    # ------------------------------------------------------------- TPU201
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        broad_names = ("Exception", "BaseException")
+
+        def is_broad_name(t: ast.AST) -> bool:
+            return isinstance(t, ast.Name) and t.id in broad_names
+
+        broad = (
+            node.type is None
+            or is_broad_name(node.type)
+            # Tuple form: `except (ValueError, Exception):` is just as broad
+            or (
+                isinstance(node.type, ast.Tuple)
+                and any(is_broad_name(e) for e in node.type.elts)
+            )
+        )
+        # A re-raise anywhere in the handler (incl. the conditional
+        # narrow-by-message pattern `if ...: raise`) means nothing is
+        # swallowed; nested defs are their own scope and don't count.
+        reraises = any(
+            isinstance(sub, ast.Raise)
+            for stmt in node.body
+            for sub in _scope_nodes([stmt])
+        )
+        if broad and not reraises:
+            caught = "bare except" if node.type is None else (
+                f"except {ast.unparse(node.type)}"
+            )
+            self._flag(
+                "TPU201",
+                node,
+                f"{caught} without re-raise swallows device errors "
+                "(XlaRuntimeError, checkify) — catch the specific "
+                "exceptions or justify with a disable comment",
+            )
+        self.generic_visit(node)
+
+    # ------------------------------------------------------ TPU101/TPU102
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_trace:
+            self._check_host_sync(node)
+            self._check_rng_clock(node)
+        self.generic_visit(node)
+
+    def _check_host_sync(self, node: ast.Call) -> None:
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+        ):
+            self._flag(
+                "TPU101",
+                node,
+                f".{node.func.attr}() inside a traced scope forces a "
+                "device->host sync on every call",
+            )
+            return
+        name = _dotted(node.func) or ""
+        if name in _SYNC_CALLS:
+            self._flag(
+                "TPU101",
+                node,
+                f"{name}() inside a traced scope materializes the value on "
+                "host — keep the computation in jnp",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and self._mentions_tracer(node.args[0])
+        ):
+            self._flag(
+                "TPU101",
+                node,
+                f"{node.func.id}() on a traced value concretizes it "
+                "(ConcretizationTypeError under jit, silent sync under "
+                "eager) — use jnp casts instead",
+            )
+
+    def _check_rng_clock(self, node: ast.Call) -> None:
+        name = _dotted(node.func) or ""
+        if name.startswith(_RNG_CLOCK_ROOTS) or name in _CLOCK_CALLS:
+            self._flag(
+                "TPU102",
+                node,
+                f"{name}() under trace is evaluated ONCE at compile time "
+                "and baked into the program — use jax.random with an "
+                "explicit key (or pass host values in as arguments)",
+            )
+
+    # ------------------------------------------------------------- TPU103
+    def _mentions_tracer(self, test: ast.AST) -> bool:
+        """Does ``test`` read a probable tracer (a traced-fn parameter) in
+        a way that is data-dependent (not just shape/dtype metadata)?"""
+        tracers = self._tracers()
+        if not tracers:
+            return False
+        static_values: set[int] = set()
+        for sub in ast.walk(test):
+            # x.shape / x.ndim / ... — static at trace time
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in _STATIC_ATTRS
+            ):
+                for inner in ast.walk(sub.value):
+                    static_values.add(id(inner))
+            # len(x) / isinstance(x, T) — static
+            if isinstance(sub, ast.Call):
+                callee = _dotted(sub.func) or ""
+                if callee in ("len", "isinstance", "type", "hasattr"):
+                    for arg in sub.args:
+                        for inner in ast.walk(arg):
+                            static_values.add(id(inner))
+            # x is None / x is not None — identity, not data
+            if isinstance(sub, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops
+            ):
+                for inner in ast.walk(sub):
+                    static_values.add(id(inner))
+        for sub in ast.walk(test):
+            if (
+                isinstance(sub, ast.Name)
+                and isinstance(sub.ctx, ast.Load)
+                and sub.id in tracers
+                and id(sub) not in static_values
+            ):
+                return True
+        return False
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def _check_branch(self, node: ast.If | ast.While, kind: str) -> None:
+        if self._in_trace and self._mentions_tracer(node.test):
+            self._flag(
+                "TPU103",
+                node,
+                f"Python `{kind}` on a traced value — use jnp.where / "
+                "lax.cond / lax.while_loop (a Python branch either raises "
+                "ConcretizationTypeError or recompiles per value)",
+            )
+
+    # ------------------------------------------------------ TPU104/TPU105
+    def check_jit_sites(self) -> None:
+        for site, fn_name, fn, kwargs in self.c.jit_sites:
+            if fn is not None and not (
+                kwargs & {"static_argnames", "static_argnums"}
+            ):
+                for arg in _fn_args(fn):
+                    if _is_config_like(arg):
+                        self._flag(
+                            "TPU104",
+                            site,
+                            f"jit of {fn_name}() takes config-like argument "
+                            f"{arg.arg!r} without static_argnames — "
+                            "unhashable args fail at dispatch, hashable "
+                            "ones recompile per value",
+                        )
+                        break
+            if (
+                fn_name
+                and _looks_like_train_step(fn_name, fn)
+                and not (kwargs & {"donate_argnums", "donate_argnames"})
+            ):
+                self._flag(
+                    "TPU105",
+                    site,
+                    f"jit of {fn_name}() looks like a train step but does "
+                    "not donate its state — params + optimizer buffers get "
+                    "double-buffered in HBM; pass donate_argnums",
+                )
+
+
+def analyze_source(source: str, path: str | Path) -> list[Finding]:
+    """Run every Layer-1 rule over one file's source text."""
+    path = str(path)
+    if file_skipped(source):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as err:
+        return [
+            Finding(
+                rule="TPU000",
+                name="syntax-error",
+                severity=Severity.ERROR,
+                path=path,
+                line=err.lineno or 0,
+                message=f"file does not parse: {err.msg}",
+            )
+        ]
+    collector = _TraceCollector()
+    collector.collect(tree)
+    visitor = _RuleVisitor(path, collector)
+    visitor.visit(tree)
+    visitor.check_jit_sites()
+    lines = source.splitlines()
+    return [f for f in visitor.findings if not is_suppressed(f, lines)]
+
+
+def analyze_paths(paths: Iterable[str | Path]) -> list[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    for path in paths:
+        path = Path(path)
+        files = sorted(path.rglob("*.py")) if path.is_dir() else [path]
+        for file in files:
+            if "__pycache__" in file.parts:
+                continue
+            findings.extend(
+                analyze_source(
+                    file.read_text(encoding="utf-8"), file.as_posix()
+                )
+            )
+    return findings

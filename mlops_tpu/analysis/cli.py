@@ -1,0 +1,89 @@
+"""``mlops-tpu analyze`` — orchestrates both layers and gates the exit code.
+
+Exit codes: 0 clean, 1 findings that gate (errors always; warnings too
+under ``--strict``), 2 internal analyzer failure. Layer 1 never imports
+JAX; Layer 2 does (skip it with ``--no-trace`` on JAX-less machines).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+from mlops_tpu.analysis.astrules import analyze_paths
+from mlops_tpu.analysis.findings import Finding, format_findings
+
+
+def _default_paths() -> list[str]:
+    """Lint the installed package when run without paths — works from any
+    cwd, matching how CI invokes the gate."""
+    return [str(Path(__file__).resolve().parents[1])]
+
+
+def run_analyze(args: argparse.Namespace) -> int:
+    """Exit 2 (usage/analyzer failure) is distinct from 1 (findings):
+    scripts keying on the gate must not read a typo'd path or an analyzer
+    crash as lint violations."""
+    try:
+        return _run_analyze(args)
+    # The boundary that implements the documented exit-code contract:
+    # any analyzer crash becomes a visible 2, never a fake 1.
+    except Exception as err:  # tpulint: disable=TPU201
+        print(f"tpulint: internal analyzer failure: {type(err).__name__}: {err}")
+        return 2
+
+
+def _run_analyze(args: argparse.Namespace) -> int:
+    paths = list(getattr(args, "paths", []) or []) or _default_paths()
+    strict = bool(getattr(args, "strict", False))
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"tpulint: error: no such path: {', '.join(missing)}")
+        return 2
+    findings: list[Finding] = analyze_paths(paths)
+
+    notes: list[str] = []
+    if not getattr(args, "no_trace", False):
+        # First jax touch of the command: re-assert an explicit
+        # JAX_PLATFORMS before any backend initializes (commands.py does
+        # this for every other subcommand; analyze defers it to here so
+        # --no-trace stays importable on JAX-less machines).
+        from mlops_tpu.commands import _honor_jax_platforms_env
+
+        _honor_jax_platforms_env()
+        from mlops_tpu.analysis.traces import run_trace_checks
+
+        trace_findings, notes = run_trace_checks()
+        findings.extend(trace_findings)
+
+    if getattr(args, "numeric", False):
+        from jax.experimental import checkify
+
+        from mlops_tpu.analysis.entrypoints import numeric_audit
+
+        try:
+            notes.extend(numeric_audit())
+        except checkify.JaxRuntimeError as err:
+            from mlops_tpu.analysis.findings import Severity
+
+            findings.append(
+                Finding(
+                    rule="TPU307",
+                    name="numeric-audit-failure",
+                    severity=Severity.ERROR,
+                    path="<numeric:serve-predict>",
+                    line=0,
+                    message=f"checkify float checks tripped: {err}",
+                )
+            )
+
+    for note in notes:
+        print(f"tpulint: {note}")
+    if findings:
+        print(format_findings(findings))
+    gating = [f for f in findings if f.gates(strict)]
+    print(
+        f"tpulint: {len(findings)} finding(s), {len(gating)} gating"
+        f"{' (strict)' if strict else ''} over {len(paths)} path(s)"
+    )
+    return 1 if gating else 0

@@ -1,0 +1,304 @@
+"""The framework's registered jitted entry points for the trace layer.
+
+Each entry wraps a REAL production builder (not a re-implementation) so the
+jaxpr the analyzer inspects is the program production compiles:
+
+- ``train-step-dense``   — `train/loop.py make_train_window` (the scan the
+  `train` CLI runs), traced at two dataset sizes.
+- ``train-step-tp``      — `parallel/steps.py make_sharded_train_step`
+  (the DP×TP pjit step); needs a multi-device mesh, skipped (loudly) on
+  single-device hosts.
+- ``serve-predict``      — `ops/predict.py make_padded_predict_fn` (the
+  serving hot path), traced at every warmup bucket the engine compiles.
+- ``serve-predict-group``— `ops/predict.py make_grouped_predict_fn` (the
+  micro-batcher's vmapped dispatch), traced across slot buckets.
+
+Everything is built from ``jax.ShapeDtypeStruct`` pytrees: params come from
+``jax.eval_shape(model.init, ...)``, batches from the SCHEMA shapes, so the
+whole registry traces abstractly — no parameter materialization, no device
+execution. Adding an entry point = appending to ``registered_entry_points``
+(see docs/static-analysis.md "Registering a Layer-2 entry point").
+
+``--numeric`` additionally runs the serve entry through `utils/debug.py
+checked()` (checkify float checks) on tiny CONCRETE batches — that one
+executes on the current backend, so it is opt-in, not part of the gate.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from mlops_tpu.analysis.traces import EntryPoint, ShardingLink
+
+
+def _schema_batch(batch: int):
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.schema import SCHEMA
+
+    S = jax.ShapeDtypeStruct
+    return (
+        S((batch, SCHEMA.num_categorical), jnp.int32),
+        S((batch, SCHEMA.num_numeric), jnp.float32),
+    )
+
+
+def _tiny_model_config():
+    from mlops_tpu.config import ModelConfig
+
+    # Smallest real family: the analyzer checks program STRUCTURE, which
+    # width does not change, so keep tracing cheap.
+    return ModelConfig(family="mlp", hidden_dims=(8,), embed_dim=4)
+
+
+def _abstract_variables(model) -> Any:
+    """Variable shapes via eval_shape — init never runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.schema import SCHEMA
+
+    def init():
+        cat = jnp.zeros((2, SCHEMA.num_categorical), jnp.int32)
+        num = jnp.zeros((2, SCHEMA.num_numeric), jnp.float32)
+        return model.init({"params": jax.random.PRNGKey(0)}, cat, num, train=False)
+
+    return jax.eval_shape(init)
+
+
+def _abstract_monitor():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import MonitorConfig
+    from mlops_tpu.monitor.state import MonitorState
+    from mlops_tpu.schema import SCHEMA
+
+    S = jax.ShapeDtypeStruct
+    ref = MonitorConfig().drift_ref_size
+    return MonitorState(
+        cat_ref_counts=S(
+            (SCHEMA.num_categorical, max(SCHEMA.cards)), jnp.float32
+        ),
+        num_ref_sorted=S((SCHEMA.num_numeric, ref), jnp.float32),
+        num_ref_cdf=S((SCHEMA.num_numeric, ref), jnp.float32),
+        out_mean=S((SCHEMA.num_numeric,), jnp.float32),
+        out_precision=S((SCHEMA.num_numeric, SCHEMA.num_numeric), jnp.float32),
+        out_threshold=S((), jnp.float32),
+    )
+
+
+def _abstract_train_state(model, optimizer):
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.train.loop import TrainState
+
+    variables = _abstract_variables(model)
+    params = variables["params"]
+    S = jax.ShapeDtypeStruct
+    return TrainState(
+        params=params,
+        opt_state=jax.eval_shape(optimizer.init, params),
+        step=S((), jnp.int32),
+        rng=S((2,), jnp.uint32),
+        ema=None,
+    )
+
+
+# --------------------------------------------------------------- builders
+def _build_train_step_dense():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import TrainConfig
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import make_optimizer, make_train_window
+
+    model = build_model(_tiny_model_config())
+    config = TrainConfig(batch_size=32, steps=8, eval_every=4)
+    optimizer = make_optimizer(config)
+    window = make_train_window(model, optimizer, config, window=4)
+    state = _abstract_train_state(model, optimizer)
+
+    def args(rows: int):
+        cat, num = _schema_batch(rows)
+        lab = jax.ShapeDtypeStruct((rows,), jnp.float32)
+        return (state, cat, num, lab)
+
+    # Two dataset sizes: the scan must be the same program at any row
+    # count (minibatches are gathered from indices, never data-dependent).
+    return window, {256: args(256), 512: args(512)}
+
+
+def _build_train_step_tp():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import TrainConfig
+    from mlops_tpu.models import build_model
+    from mlops_tpu.parallel import make_mesh
+    from mlops_tpu.parallel.steps import make_sharded_train_step
+    from mlops_tpu.train.loop import make_optimizer
+
+    model = build_model(_tiny_model_config())
+    config = TrainConfig(batch_size=32, steps=8, eval_every=4)
+    optimizer = make_optimizer(config)
+    mesh = make_mesh(jax.device_count())
+    params = _abstract_variables(model)["params"]
+    step_fn, _ = make_sharded_train_step(
+        model, optimizer, config, mesh, params
+    )
+    state = _abstract_train_state(model, optimizer)
+
+    def args(rows: int):
+        cat, num = _schema_batch(rows)
+        lab = jax.ShapeDtypeStruct((rows,), jnp.float32)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        return (state, cat, num, lab, rng)
+
+    return step_fn, {64: args(64), 128: args(128)}
+
+
+def _build_serve_predict():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.models import build_model
+    from mlops_tpu.ops.predict import make_padded_predict_fn
+
+    model = build_model(_tiny_model_config())
+    variables = _abstract_variables(model)
+    monitor = _abstract_monitor()
+
+    def entry(variables, monitor, cat, num, mask):
+        fn = make_padded_predict_fn(model, variables, monitor, temperature=1.3)
+        return fn(cat, num, mask)
+
+    def args(bucket: int):
+        cat, num = _schema_batch(bucket)
+        mask = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
+        return (variables, monitor, cat, num, mask)
+
+    # Trace at every bucket the engine warms: the padded-bucket serving
+    # contract ("zero steady-state recompiles") is exactly TPU304.
+    buckets = ServeConfig().warmup_batch_sizes
+    return entry, {b: args(b) for b in buckets}
+
+
+def _build_serve_predict_group():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.models import build_model
+    from mlops_tpu.ops.predict import make_grouped_predict_fn
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+
+    model = build_model(_tiny_model_config())
+    variables = _abstract_variables(model)
+    monitor = _abstract_monitor()
+
+    def entry(variables, monitor, cat, num, mask):
+        fn = make_grouped_predict_fn(model, variables, monitor, temperature=1.3)
+        return fn(cat, num, mask)
+
+    S = jax.ShapeDtypeStruct
+
+    def args(slots: int):
+        rows = GROUP_ROW_BUCKET
+        return (
+            variables,
+            monitor,
+            S((slots, rows, SCHEMA.num_categorical), jnp.int32),
+            S((slots, rows, SCHEMA.num_numeric), jnp.float32),
+            S((slots, rows), jnp.bool_),
+        )
+
+    smallest, largest = GROUP_SLOT_BUCKETS[0], GROUP_SLOT_BUCKETS[-1]
+    return entry, {smallest: args(smallest), largest: args(largest)}
+
+
+def registered_entry_points() -> list[EntryPoint]:
+    return [
+        EntryPoint(
+            name="train-step-dense",
+            build=_build_train_step_dense,
+            # Dense training packages replicated (host) params.
+            params_out_spec=None,
+        ),
+        EntryPoint(
+            name="train-step-tp",
+            build=_build_train_step_tp,
+            min_devices=2,
+            # The TP product loop (train/tensor_parallel.py) merges the
+            # PARAM_RULES-sharded tree back to a dense servable tree at
+            # packaging — declared here as replicated-after-merge.
+            params_out_spec=None,
+        ),
+        EntryPoint(
+            name="serve-predict",
+            build=_build_serve_predict,
+            # The engine loads bundle params replicated on the serving chip.
+            params_in_spec=None,
+            # Two DECLARED program families (monitor/state.py drift_scores):
+            # buckets <= 64 rows run the dense small-batch K-S, larger ones
+            # the sort-based K-S. Each bucket still compiles exactly once
+            # at warmup; what TPU304 guards is NEW polymorphism inside a
+            # family.
+            bucket_families=((1, 8, 64), (256,)),
+        ),
+        EntryPoint(
+            name="serve-predict-group",
+            build=_build_serve_predict_group,
+            params_in_spec=None,
+        ),
+    ]
+
+
+# Packaged-params handoffs the sharding check guards (TPU305).
+LINKS = [
+    ShardingLink("train-step-dense", "serve-predict"),
+    ShardingLink("train-step-tp", "serve-predict", transport="merge-to-dense"),
+]
+
+
+def numeric_audit() -> list[str]:
+    """Opt-in one-shot numeric audit (``analyze --numeric``): run the serve
+    predict through `utils/debug.py checked()` — checkify float checks — on
+    a tiny CONCRETE synthetic batch. This executes on the current backend
+    (CPU under JAX_PLATFORMS=cpu), so it is not part of the abstract gate.
+
+    Returns human-readable result lines; raises
+    ``checkify.JaxRuntimeError`` if a NaN/Inf escapes the fused predict.
+    """
+    import jax
+    import numpy as np
+
+    from mlops_tpu.data import Preprocessor, generate_synthetic
+    from mlops_tpu.models import build_model, init_params
+    from mlops_tpu.monitor.state import fit_monitor
+    from mlops_tpu.ops.predict import make_padded_predict_fn
+    from mlops_tpu.utils.debug import checked
+
+    columns, labels = generate_synthetic(512, seed=0)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns, labels)
+    model = build_model(_tiny_model_config())
+    variables = init_params(model, jax.random.PRNGKey(0))
+    monitor = fit_monitor(ds)
+    predict = make_padded_predict_fn(model, variables, monitor)
+    audited = checked(predict, jit=True)
+    batch = 8
+    out = audited(
+        ds.cat_ids[:batch],
+        ds.numeric[:batch].astype(np.float32),
+        np.ones((batch,), bool),
+    )
+    preds = np.asarray(out["predictions"])
+    return [
+        f"numeric audit: serve-predict x{batch} rows under checkify "
+        f"float_checks — clean (p50 prediction {float(np.median(preds)):.4f})"
+    ]

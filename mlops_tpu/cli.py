@@ -43,6 +43,35 @@ def build_parser() -> argparse.ArgumentParser:
             nargs="*",
             help="config overrides, e.g. train.steps=500",
         )
+    # `analyze` takes paths + flags, not config overrides: static analysis
+    # must run identically with zero configuration (CI, pre-commit).
+    analyze = sub.add_parser(
+        "analyze",
+        help="tpulint: static TPU-correctness lint (AST rules + jaxpr "
+        "trace checks over the registered entry points)",
+    )
+    analyze.add_argument(
+        "--strict",
+        action="store_true",
+        help="warnings gate the exit code too (the CI mode)",
+    )
+    analyze.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="skip the jaxpr trace layer (no JAX import; AST rules only)",
+    )
+    analyze.add_argument(
+        "--numeric",
+        action="store_true",
+        help="also run the checkify numeric audit on the serve entry "
+        "point (executes on the current backend; not part of the "
+        "abstract gate)",
+    )
+    analyze.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the mlops_tpu package)",
+    )
     return parser
 
 

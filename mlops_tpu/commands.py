@@ -9,6 +9,14 @@ from mlops_tpu.config import load_config
 
 
 def run(args: argparse.Namespace) -> int:
+    if args.command == "analyze":
+        # Static analysis BEFORE any jax import: no config tree, no
+        # distributed init, no backend warmup — `analyze --no-trace` must
+        # run identically on a JAX-less machine (_honor_jax_platforms_env
+        # would import jax whenever JAX_PLATFORMS is set).
+        from mlops_tpu.analysis.cli import run_analyze
+
+        return run_analyze(args)
     _honor_jax_platforms_env()
     # Multi-host launches (GKE JobSet / TPU pod) wire up DCN before any
     # backend use; single-host is a no-op (parallel/distributed.py).
@@ -487,8 +495,18 @@ def _serve(config) -> int:
     return 0
 
 
+def _analyze(config) -> int:
+    """Handler-table entry for parser/handler sync (tests/test_cli.py);
+    ``run()`` intercepts `analyze` before config loading, so this shim only
+    runs when dispatched directly — lint the package with defaults."""
+    from mlops_tpu.analysis.cli import run_analyze
+
+    return run_analyze(argparse.Namespace())
+
+
 _HANDLERS = {
     "synth": _synth,
+    "analyze": _analyze,
     "train": _train,
     "pretrain": _pretrain,
     "tune": _tune,

@@ -11,8 +11,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 import warnings
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomllib landed in 3.11
+    import tomli as tomllib  # type: ignore[no-redef]
 from pathlib import Path
 from typing import Any
 

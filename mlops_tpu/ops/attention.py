@@ -35,6 +35,20 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax >= 0.5 renamed TPUCompilerParams -> CompilerParams; support both so
+# the kernels run on the container's pinned jax as well as current ones.
+# Fail HERE, by name, if a future rename breaks both — not as an opaque
+# "'NoneType' object is not callable" at the first kernel build.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+if _CompilerParams is None:
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams — update the compat alias in ops/attention.py "
+        "for this jax version"
+    )
+
 NEG_INF = -1e30
 
 
@@ -175,7 +189,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 128), jnp.float32),  # running normalizer l
             pltpu.VMEM((block_q, d), jnp.float32),  # unnormalized accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 pltpu.PARALLEL,
                 pltpu.PARALLEL,
@@ -324,7 +338,7 @@ def _flash_backward(
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,
@@ -347,7 +361,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,

@@ -16,13 +16,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mlops_tpu.parallel.compat import shard_map
+
 
 def pmean_over_data(fn: Callable, mesh: Mesh) -> Callable:
     """Wrap ``fn(batch_shard) -> scalar`` into a data-parallel mean over the
     'data' axis (the gradient-reduction primitive, made explicit)."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=P("data"),
         out_specs=P(),
@@ -38,7 +40,7 @@ def all_gather_rows(mesh: Mesh) -> Callable:
     """Gather row-sharded arrays onto every device (diagnostics, eval)."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=P("data"),
         out_specs=P(),
@@ -57,7 +59,7 @@ def ring_shift(mesh: Mesh, axis: str = "data") -> Callable:
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mlops_tpu.parallel.compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -134,7 +136,7 @@ def make_ring_attention(
     )
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -172,7 +172,10 @@ class MicroBatcher:
             responses = await loop.run_in_executor(
                 self._executor, self.engine.predict_group, requests
             )
-        except Exception as err:
+        # Not swallowed: whatever the dispatch raised (device error,
+        # encode bug) is re-routed onto every waiter's future, where the
+        # request handler surfaces it as a 500.
+        except Exception as err:  # tpulint: disable=TPU201
             for _, future in batch:
                 if not future.done():
                     future.set_exception(err)

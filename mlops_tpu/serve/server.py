@@ -292,8 +292,9 @@ class HttpServer:
                 jax.profiler.stop_trace()
                 self._profiling = False
                 return 200, {"status": "stopped", "dir": self.config.profile_dir}, "application/json"
-        except Exception as err:  # unwritable dir, profiler state errors:
-            # report, don't drop the connection
+        # Unwritable dir, profiler state errors: logged + reported as a
+        # 500 body, never a dropped connection on a debug endpoint.
+        except Exception as err:  # tpulint: disable=TPU201
             logger.exception("profiler %s failed", action)
             self._profiling = False
             return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
@@ -365,7 +366,11 @@ class HttpServer:
                 },
                 "application/json",
             )
-        except Exception:
+        # Top-of-handler boundary: ANY prediction failure (device error
+        # included) must become a logged 500, not a dropped connection —
+        # the breadth is the contract here, and logger.exception keeps
+        # the traceback.
+        except Exception:  # tpulint: disable=TPU201
             logger.exception("prediction failed request_id=%s", request_id)
             return 500, {"detail": "prediction failed"}, "application/json"
         self.metrics.observe_prediction(response)
@@ -406,8 +411,10 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
         try:
             await loop.run_in_executor(None, engine.warmup)
             logger.info("warmup complete; ready")
-        except BaseException as err:  # compile failure/OOM: die loudly so
-            # the orchestrator restarts the pod instead of a forever-503 zombie
+        # Compile failure/OOM: die loudly so the orchestrator restarts the
+        # pod instead of a forever-503 zombie. Not swallowed — the error is
+        # stored and re-raised by _serve after the server closes.
+        except BaseException as err:  # tpulint: disable=TPU201
             warmup_error.append(err)
             logger.error("warmup failed, shutting down: %s", err)
             srv.close()

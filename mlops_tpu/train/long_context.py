@@ -149,7 +149,7 @@ def make_doc_train_step(
     # buffers on TPU (the fit() donation bug class); activations dominate
     # this trainer's memory anyway.
     if mesh is None:
-        step_fn = jax.jit(step)
+        step_fn = jax.jit(step)  # tpulint: disable=TPU105
     else:
         batch = "data" if "data" in mesh.axis_names else None
         # Inputs shard over 'data' only: the R record axis (11 for a
@@ -159,7 +159,7 @@ def make_doc_train_step(
         doc_in = NamedSharding(mesh, P(batch, None, None))
         lab_in = NamedSharding(mesh, P(batch))
         rep = NamedSharding(mesh, P())
-        step_fn = jax.jit(
+        step_fn = jax.jit(  # tpulint: disable=TPU105
             step,
             in_shardings=(rep, rep, rep, doc_in, doc_in, lab_in),
             out_shardings=(rep, rep, rep, rep),

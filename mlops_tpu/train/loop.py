@@ -230,7 +230,13 @@ def make_train_window(
         state, losses = jax.lax.scan(one_step, state, xs=None, length=window)
         return state, losses.mean()
 
-    return jax.jit(run_window, donate_argnums=0)
+    from mlops_tpu.parallel.compat import donation_argnums
+
+    # Donation gated off only on the 0.4.x CPU backend, where a cached
+    # donated executable silently corrupts its results after
+    # deserialization (parallel/compat.py); everywhere else the train
+    # state updates in place in HBM.
+    return jax.jit(run_window, donate_argnums=donation_argnums(0))
 
 
 def make_eval_fn(model) -> Callable:

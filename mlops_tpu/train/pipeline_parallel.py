@@ -356,7 +356,7 @@ def make_pp_train_step(
     ema0 = jax.tree_util.tree_map(jnp.zeros_like, pp_params) if decay else None
     return PPTrainStep(
         forward_fn=jax.jit(forward),
-        step_fn=jax.jit(step),
+        step_fn=jax.jit(step),  # tpulint: disable=TPU105
         params=pp_params,
         opt_state=opt_state,
         stages=stages,

@@ -102,8 +102,10 @@ def pretrain_bert(
         mask = jnp.zeros((batch, seq_len), bool)
         return mask.at[:, value_pos].set(draw)
 
+    # Scan body — run() below owns (and donates) the carry buffers; a
+    # second donation here would double-free them.
     @jax.jit
-    def step(carry, _):
+    def step(carry, _):  # tpulint: disable=TPU105
         params, opt_state, rng = carry
         rng, bkey, mkey, dkey = jax.random.split(rng, 4)
         idx = jax.random.randint(bkey, (batch_size,), 0, n)
@@ -121,7 +123,15 @@ def pretrain_bert(
         params = optax.apply_updates(params, updates)
         return (params, opt_state, rng), loss
 
-    @partial(jax.jit, static_argnums=1)
+    # The initial carry is never reused after the call: donate it so the
+    # params + adam moments update in place in HBM instead of
+    # double-buffering (tpulint TPU105). Gated off on the 0.4.x CPU
+    # backend (cached donated executables misbehave — parallel/compat.py).
+    from mlops_tpu.parallel.compat import donation_argnums
+
+    @partial(
+        jax.jit, static_argnums=1, donate_argnums=donation_argnums(0)
+    )
     def run(carry, n_steps):
         return jax.lax.scan(step, carry, None, length=n_steps)
 

@@ -15,10 +15,13 @@ compiler actually scheduled. Peak FLOP/s is a small device-kind table
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any
 
 import jax
+
+logger = logging.getLogger(__name__)
 
 # Published per-chip dense matmul peaks (FLOP/s). Values are bf16 peaks for
 # TPUs (the compute dtype the framework puts on the MXU) and deliberately
@@ -61,7 +64,12 @@ def compile_with_flops(fn, *args) -> tuple[Any | None, float | None]:
     compiled = None
     try:
         compiled = jax.jit(fn).lower(*args).compile()
-    except Exception:
+    # Plugin backends raise backend-specific compile errors that share no
+    # base class (XlaRuntimeError, RuntimeError, ValueError, ...); the
+    # contract here is "None when this backend can't compile it", so the
+    # breadth is the point — logged so the cause is never silent.
+    except Exception as err:  # tpulint: disable=TPU201
+        logger.debug("compile for FLOP counting failed: %s", err)
         return None, None
     try:
         analysis = compiled.cost_analysis()
@@ -69,7 +77,16 @@ def compile_with_flops(fn, *args) -> tuple[Any | None, float | None]:
             analysis = analysis[0]
         flops = float(analysis.get("flops", 0.0))
         return compiled, (flops if flops > 0 else None)
-    except Exception:
+    except (
+        AttributeError,  # backend exposes no cost_analysis / returns None
+        IndexError,  # empty per-device analysis list
+        KeyError,
+        TypeError,  # non-mapping analysis object
+        ValueError,
+        NotImplementedError,  # plugin declines the query
+        RuntimeError,  # XLA-side analysis failure
+    ) as err:
+        logger.debug("cost_analysis unavailable: %s", err)
         return compiled, None
 
 

@@ -25,7 +25,9 @@ class TensorBoardWriter:
             from torch.utils.tensorboard import SummaryWriter
 
             self._writer = SummaryWriter(log_dir=str(logdir))
-        except Exception as err:  # any import/init failure -> no-op
+        # Any import/init failure (torch absent, incompatible protobuf,
+        # unwritable logdir) -> warn-and-no-op; metrics still reach jsonl.
+        except Exception as err:  # tpulint: disable=TPU201
             warnings.warn(
                 f"tensorboard writer unavailable ({err}); metrics go to "
                 "metrics.jsonl only",

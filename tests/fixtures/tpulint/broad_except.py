@@ -1,0 +1,45 @@
+"""TPU201 fixture: broad excepts that swallow device errors."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:  # PLANT: TPU201
+        return None
+
+
+def bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722  # PLANT: TPU201
+        return None
+
+
+def tuple_form_is_still_broad(fn):
+    try:
+        return fn()
+    except (ValueError, Exception):  # PLANT: TPU201
+        return None
+
+
+def rethrown_is_fine(fn):
+    try:
+        return fn()
+    except Exception:
+        raise
+
+
+def conditional_reraise_is_fine(fn):
+    try:
+        return fn()
+    except Exception as err:
+        if "capability" not in str(err):
+            raise
+        return None
+
+
+def narrow_is_fine(fn):
+    try:
+        return fn()
+    except (ValueError, KeyError):
+        return None

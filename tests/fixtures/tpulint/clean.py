@@ -1,0 +1,47 @@
+"""False-positive guard fixture: TPU-idiomatic code the analyzer must pass
+clean — every pattern here appears in the real codebase."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_padded_predict(model, variables):
+    @jax.jit
+    def monitors(x, mask):
+        return jnp.where(mask, x, 0.0).sum()
+
+    def predict(cat, num, mask):
+        # Host predict around a jitted core: np work here is FINE — only
+        # `monitors` above is traced, and scope-aware collection must not
+        # confuse the two even though closures share names module-wide.
+        valid = np.asarray(mask)
+        return float(monitors(num, valid))
+
+    return predict
+
+
+def make_window(model, optimizer, config):
+    def run_window(state, cat, num, lab):
+        n = cat.shape[0]  # static metadata under trace
+
+        def one_step(state, _):
+            if config.ema_decay:  # closure config: static at trace time
+                pass
+            idx = jax.random.randint(state[1], (4,), 0, n)
+            return state, idx.sum()
+
+        return jax.lax.scan(one_step, state, None, length=8)
+
+    return jax.jit(run_window, donate_argnums=0)
+
+
+def host_pipeline(path, rows=None):
+    # Untraced host code: syncs, clocks, branches all fine.
+    import time
+
+    start = time.time()
+    data = np.asarray(range(10))
+    if data.sum() > 3:
+        data = data * 2
+    return data, time.time() - start

@@ -1,0 +1,23 @@
+"""TPU104 fixture: jit over config-like arguments without static_argnames."""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def forward(config, x):  # PLANT: TPU104
+    return x * config["scale"]
+
+
+def loss(params, config: dict, batch):
+    return params * config["weight"] * batch
+
+
+bad = jax.jit(loss)  # PLANT: TPU104
+good = jax.jit(loss, static_argnames=("config",))
+
+
+@partial(jax.jit, static_argnames=("settings",))
+def also_good(settings, x):
+    return x + settings.bias

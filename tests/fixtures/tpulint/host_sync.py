@@ -1,0 +1,23 @@
+"""TPU101 fixture: host syncs inside traced scopes. Never imported —
+tests/test_analysis.py feeds this file's SOURCE to the analyzer; lines
+carrying a violation are marked with a `PLANT:` comment."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    y = x.sum().item()  # PLANT: TPU101
+    host = np.asarray(x)  # PLANT: TPU101
+    fetched = jax.device_get(x)  # PLANT: TPU101
+    scalar = float(x)  # PLANT: TPU101
+    return y + host.sum() + fetched + scalar
+
+
+def make_step(config):
+    def step(state, batch):
+        listed = state.tolist()  # PLANT: TPU101
+        return state + batch, listed
+
+    return jax.jit(step, donate_argnums=0)

@@ -1,0 +1,24 @@
+"""TPU105 fixture: train-step jits that do not donate their state."""
+
+import jax
+
+
+def train_step(state, batch):
+    return state + batch
+
+
+undonated = jax.jit(train_step)  # PLANT: TPU105
+donated = jax.jit(train_step, donate_argnums=0)
+
+
+@jax.jit
+def update_step(state, grads):  # PLANT: TPU105
+    return state - grads
+
+
+def predict(params, x):
+    # Not a step shape: no state, no step-ish name -> never flags.
+    return params @ x
+
+
+served = jax.jit(predict)

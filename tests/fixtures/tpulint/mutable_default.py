@@ -1,0 +1,18 @@
+"""TPU202 fixture: mutable default arguments."""
+
+
+def accumulate(value, into=[]):  # PLANT: TPU202
+    into.append(value)
+    return into
+
+
+def tag(record, labels={}):  # PLANT: TPU202
+    return {**record, **labels}
+
+
+def build(rows, *, cache=dict()):  # PLANT: TPU202
+    return cache.setdefault("rows", rows)
+
+
+def fine(value, into=None, count=0, name="x"):
+    return [value] if into is None else [*into, value]

@@ -1,0 +1,20 @@
+"""TPU102 fixture: Python RNG/clock calls under trace."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy(x):
+    jitter = random.random()  # PLANT: TPU102
+    noise = np.random.normal(size=3)  # PLANT: TPU102
+    stamp = time.time()  # PLANT: TPU102
+    return x + jitter + noise.sum() + stamp
+
+
+def outside(x):
+    # NOT traced: host-side randomness is fine here.
+    return x + random.random()

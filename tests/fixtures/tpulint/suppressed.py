@@ -1,0 +1,24 @@
+"""Suppression fixture: every violation here carries a disable comment,
+so the analyzer must report NOTHING for this file."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def justified(x):
+    host = np.asarray(x)  # tpulint: disable=TPU101
+    # tpulint: disable=TPU101
+    also = float(x)
+    return host.sum() + also
+
+
+def tolerant(fn):
+    try:
+        return fn()
+    except Exception:  # tpulint: disable
+        return None
+
+
+def stateful(value, into=[]):  # tpulint: disable=TPU202,TPU101
+    return [*into, value]

@@ -1,0 +1,30 @@
+"""TPU103 fixture: data-dependent Python branches on traced values."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchy(x, threshold):
+    if x.sum() > threshold:  # PLANT: TPU103
+        return x * 2
+    while threshold > 0:  # PLANT: TPU103
+        threshold = threshold - 1
+    return x
+
+
+@jax.jit
+def shape_branch_is_fine(x):
+    # Static metadata branches never flag: shapes/dtypes are trace-time
+    # constants.
+    if x.shape[0] > 4:
+        return x[:4]
+    if x.ndim == 2 and len(x) > 1:
+        return x.sum(axis=0)
+    return x
+
+
+def py_branch_is_fine(x, flag):
+    if flag:
+        return x
+    return None

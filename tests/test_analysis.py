@@ -1,0 +1,257 @@
+"""tpulint: planted-violation fixtures, suppression, trace checks, CLI gate.
+
+The fixture modules under tests/fixtures/tpulint/ are ANALYZED, never
+imported: each violation line carries a ``# PLANT: <RULE>`` marker, and the
+contract is exact — every planted rule fires at its marked line, and no
+rule fires anywhere else.
+"""
+
+import re
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mlops_tpu.analysis import analyze_paths, analyze_source
+from mlops_tpu.analysis.astrules import RULES
+
+FIXTURES = Path(__file__).parent / "fixtures" / "tpulint"
+_PLANT = re.compile(r"#\s*PLANT:\s*(TPU\d+)")
+
+
+def _planted(path: Path) -> set[tuple[int, str]]:
+    out = set()
+    for lineno, line in enumerate(path.read_text().splitlines(), start=1):
+        m = _PLANT.search(line)
+        if m:
+            out.add((lineno, m.group(1)))
+    return out
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "host_sync",
+        "rng_clock",
+        "tracer_branch",
+        "config_arg",
+        "missing_donate",
+        "broad_except",
+        "mutable_default",
+    ],
+)
+def test_each_planted_violation_fires_at_its_line(name):
+    path = FIXTURES / f"{name}.py"
+    planted = _planted(path)
+    assert planted, f"fixture {name} has no PLANT markers"
+    found = {
+        (f.line, f.rule)
+        for f in analyze_source(path.read_text(), path)
+    }
+    assert planted <= found, f"missed: {planted - found}"
+    # No findings beyond the planted lines — the false-positive contract.
+    extra = {(ln, r) for ln, r in found if (ln, r) not in planted}
+    assert not extra, f"unexpected findings: {extra}"
+
+
+def test_every_shipped_rule_is_exercised_by_a_fixture():
+    """A rule without a fixture is a rule that can silently stop firing."""
+    planted_rules = set()
+    for path in FIXTURES.glob("*.py"):
+        planted_rules |= {rule for _, rule in _planted(path)}
+    assert planted_rules == set(RULES), (
+        f"fixture-less rules: {set(RULES) - planted_rules}; "
+        f"unknown planted: {planted_rules - set(RULES)}"
+    )
+
+
+def test_suppression_comments_silence_findings():
+    path = FIXTURES / "suppressed.py"
+    findings = analyze_source(path.read_text(), path)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_clean_fixture_has_no_findings():
+    path = FIXTURES / "clean.py"
+    findings = analyze_source(path.read_text(), path)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_suppression_is_rule_specific():
+    source = (
+        "def f(x=[]):  # tpulint: disable=TPU101\n"
+        "    return x\n"
+    )
+    findings = analyze_source(source, "inline.py")
+    assert [f.rule for f in findings] == ["TPU202"]
+
+
+def test_skip_file_pragma():
+    source = "# tpulint: skip-file\ndef f(x=[]):\n    return x\n"
+    assert analyze_source(source, "skipped.py") == []
+
+
+def test_trailing_suppression_does_not_leak_to_next_line():
+    """A disable comment trailing code on line N silences only line N; a
+    STANDALONE comment line above silences the line below."""
+    leaking = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = x.tolist()  # tpulint: disable=TPU101\n"
+        "    b = x.tolist()\n"
+        "    return a, b\n"
+    )
+    findings = analyze_source(leaking, "leak.py")
+    assert [(f.rule, f.line) for f in findings] == [("TPU101", 5)]
+    standalone = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # tpulint: disable=TPU101\n"
+        "    return x.tolist()\n"
+    )
+    assert analyze_source(standalone, "standalone.py") == []
+
+
+def test_cli_exit_2_on_missing_path(capsys):
+    from mlops_tpu.cli import main
+
+    assert main(["analyze", "--no-trace", "definitely/not/a/path.py"]) == 2
+    assert "no such path" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ Layer 2
+def test_trace_layer_clean_on_registered_entry_points():
+    """The acceptance gate: every registered entry point traces abstractly
+    (no device execution) and raises no findings on the real framework."""
+    from mlops_tpu.analysis.traces import run_trace_checks
+
+    findings, notes = run_trace_checks()
+    assert findings == [], [f.format() for f in findings]
+    traced = [n for n in notes if n.startswith("traced ")]
+    # conftest forces an 8-device mesh, so nothing may be skipped.
+    assert len(traced) == 4, notes
+    assert all("no device code executed" in n for n in traced)
+
+
+def test_float64_leak_detected():
+    from mlops_tpu.analysis.traces import check_dtypes
+
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        jaxpr = jax.make_jaxpr(lambda x: jnp.sin(x) * 2.0)(
+            jax.ShapeDtypeStruct((4,), jnp.float64)
+        )
+    findings = check_dtypes("fixture", 4, jaxpr)
+    assert any(f.rule == "TPU301" for f in findings)
+
+
+def test_convert_round_trip_detected():
+    from mlops_tpu.analysis.traces import check_dtypes
+
+    def roundtrip(x):
+        return x.astype(jnp.bfloat16).astype(jnp.float32) + 1.0
+
+    jaxpr = jax.make_jaxpr(roundtrip)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    findings = check_dtypes("fixture", 4, jaxpr)
+    assert any(f.rule == "TPU303" for f in findings)
+
+
+def test_weak_type_output_detected():
+    from mlops_tpu.analysis.traces import check_weak_types
+
+    def weak_out(x):
+        return x.sum(), jnp.asarray(1.0) * 2.0  # second output weak f32
+
+    jaxpr = jax.make_jaxpr(weak_out)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    findings = check_weak_types("fixture", 4, jaxpr)
+    assert any(f.rule == "TPU302" for f in findings), [
+        (a, getattr(a, "weak_type", None)) for a in jaxpr.out_avals
+    ]
+
+
+def test_bucket_polymorphism_detected_and_families_respected():
+    from mlops_tpu.analysis.traces import check_bucket_stability
+
+    def polymorphic(x):
+        # Different program per size: the shape branch changes the ops.
+        if x.shape[0] <= 4:
+            return jnp.sort(x)
+        return x * 2.0
+
+    jaxprs = {
+        n: jax.make_jaxpr(polymorphic)(jax.ShapeDtypeStruct((n,), jnp.float32))
+        for n in (2, 8)
+    }
+    assert any(
+        f.rule == "TPU304" for f in check_bucket_stability("fixture", jaxprs)
+    )
+    # The same divergence DECLARED as two families passes.
+    assert (
+        check_bucket_stability("fixture", jaxprs, families=((2,), (8,))) == []
+    )
+
+
+def test_sharding_link_mismatch_detected():
+    from jax.sharding import PartitionSpec as P
+
+    from mlops_tpu.analysis.traces import (
+        EntryPoint,
+        ShardingLink,
+        check_sharding_links,
+    )
+
+    entries = {
+        "producer": EntryPoint(
+            name="producer",
+            build=lambda: None,
+            params_out_spec={"w": P("model", None)},
+        ),
+        "consumer": EntryPoint(
+            name="consumer",
+            build=lambda: None,
+            params_in_spec={"w": P()},
+        ),
+    }
+    links = [ShardingLink("producer", "consumer")]
+    findings = check_sharding_links(entries, links)
+    assert [f.rule for f in findings] == ["TPU305"]
+    # Matching specs pass.
+    entries["consumer"].params_in_spec = {"w": P("model", None)}
+    assert check_sharding_links(entries, links) == []
+
+
+# ------------------------------------------------------------ CLI gate
+def test_cli_analyze_nonzero_on_fixtures_and_zero_on_package(capsys):
+    from mlops_tpu.cli import main
+
+    assert main(["analyze", "--no-trace", str(FIXTURES)]) == 1
+    out = capsys.readouterr().out
+    assert "TPU101" in out and "gating" in out
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    assert main(["analyze", "--no-trace", "--strict", str(package)]) == 0
+
+
+@pytest.mark.slow
+def test_cli_analyze_full_two_layer_gate(capsys):
+    """`mlops-tpu analyze --strict mlops_tpu/` — the exact CI invocation —
+    exits 0 with every entry point traced."""
+    from mlops_tpu.cli import main
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    assert main(["analyze", "--strict", str(package)]) == 0
+    out = capsys.readouterr().out
+    assert out.count("traced ") == 4
+
+
+def test_rule_catalog_documented():
+    """Every rule ID (both layers) appears in docs/static-analysis.md."""
+    from mlops_tpu.analysis.traces import TRACE_RULES
+
+    doc = (Path(__file__).parents[1] / "docs" / "static-analysis.md").read_text()
+    for rule in [*RULES, *TRACE_RULES]:
+        assert rule in doc, f"{rule} missing from docs/static-analysis.md"

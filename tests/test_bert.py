@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mlops_tpu.config import ModelConfig, TrainConfig
 from mlops_tpu.models import build_model, init_params
@@ -74,6 +75,9 @@ def test_bert_forward_shape_and_determinism():
     np.testing.assert_array_equal(np.asarray(logits), np.asarray(again))
 
 
+# Heaviest end-to-end path (~60s serial on CPU): excluded from the
+# timed tier-1 gate; CI's parallel pytest job still runs it.
+@pytest.mark.slow
 def test_bert_trains_end_to_end(tmp_path):
     """Full pipeline (train -> bundle -> reload) with the bert family."""
     from mlops_tpu.bundle import load_bundle

@@ -134,6 +134,9 @@ def test_manifest_pins_environment(tiny_pipeline):
         assert pins.get(key), f"missing environment pin: {key}"
 
 
+# Heaviest end-to-end path (~60s serial on CPU): excluded from the
+# timed tier-1 gate; CI's parallel pytest job still runs it.
+@pytest.mark.slow
 def test_ensemble_bundle_round_trip_through_engine(tmp_path):
     """Train a small deep ensemble end to end, reload its bundle, and serve
     it — the manifest must carry ensemble_size so load_bundle rebuilds the

@@ -76,7 +76,10 @@ def test_train_job_manifest_contracts():
     assert "data.train_path=gs://bucket/data/curated.csv" in args
     assert "registry.root=gs://bucket/registry" in args
     # The config the args reference must exist with the right sections.
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python < 3.11 (mlops_tpu/config.py parity)
+        import tomli as tomllib
 
     config = tomllib.loads(
         (REPO / "configs" / "train_register_job.toml").read_text()

@@ -143,6 +143,9 @@ def test_score_exact_flag_forces_ensemble(
         assert out["path"] == want
 
 
+# Heaviest end-to-end path (~60s serial on CPU): excluded from the
+# timed tier-1 gate; CI's parallel pytest job still runs it.
+@pytest.mark.slow
 def test_transformer_families_also_distill(tmp_path):
     """The FT-Transformer (best measured AUC) loses CPU bulk to the
     sklearn floor just like ensembles do — the distillation gate covers

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from mlops_tpu.config import ModelConfig
 from mlops_tpu.models import build_model, init_params
@@ -54,6 +55,9 @@ def test_trunk_transfer_into_classifier(encoded_small):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+# Heaviest end-to-end path (~60s serial on CPU): excluded from the
+# timed tier-1 gate; CI's parallel pytest job still runs it.
+@pytest.mark.slow
 def test_pretrain_cli_to_finetune_roundtrip(tmp_path):
     """pretrain CLI output feeds train train.init_params end-to-end."""
     from mlops_tpu.config import Config, TrainConfig
