@@ -11,6 +11,10 @@ warmed engine, then measure:
 - bulk throughput at buckets {256, 4096, 16384} plus a pipelined sweep
   (dispatch all chunks, one batched fetch) on both the exact ensemble and
   the auto-routed bulk path (distilled student on CPU backends),
+- the streaming-executor sweep (data/pipeline_exec.py): a synthetic
+  200k-row CSV scored serial vs pipelined through `score_csv_stream`,
+  with per-stage occupancies and an output bit-identity check
+  (``bulk_stream_*`` keys),
 - roofline evidence: XLA-counted FLOPs ÷ wall ÷ chip peak (``mfu_*`` keys)
   for bulk inference, the fused train step, and the flash-attention
   kernel (utils/flops.py),
@@ -276,6 +280,74 @@ def _bulk_stage(engine, bundle) -> dict:
     fidelity = bundle.bulk_fidelity
     if "roc_auc_delta" in fidelity:
         out["bulk_fidelity_auc_delta"] = round(fidelity["roc_auc_delta"], 4)
+    return out
+
+
+def _stream_stage(bundle) -> dict:
+    """Pipelined streaming-executor sweep (data/pipeline_exec.py): score a
+    synthetic 200k-row CSV through `score_csv_stream` three ways —
+
+    - ``serial``: the pre-executor baseline (depth 1, Python csv parse —
+      exactly the old chunk loop's behavior),
+    - ``native_serial``: depth 1 with the native C++ chunk encode (the
+      kernel-side win in isolation),
+    - ``pipelined``: depth 2 with native encode — the product path, with
+      read / encode / transfer / compute / fetch / write overlapped on
+      bounded queues.
+
+    Reports rows/s for each, the end-to-end speedup (pipelined vs the old
+    serial path), the overlap-only speedup (pipelined vs native serial —
+    bounded by how much real CPU parallelism the host offers), per-stage
+    occupancies from the pipelined run, and an output bit-identity check
+    across all three (the executor preserves chunk order, so any depth
+    must produce the same file)."""
+    import tempfile
+    from pathlib import Path
+
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+    from mlops_tpu.data.stream import score_csv_stream
+
+    n = 200_000
+    depth = 2
+    columns, labels = generate_synthetic(n, seed=5)
+    out: dict = {"bulk_stream_rows": n, "bulk_stream_pipeline_depth": depth}
+    with tempfile.TemporaryDirectory() as td:
+        data_path = Path(td) / "stream.csv"
+        write_csv_columns(data_path, columns, labels)
+        _note("stream sweep: serial (python parse, depth 1)")
+        serial = score_csv_stream(
+            bundle, data_path, Path(td) / "serial.csv",
+            chunk_rows=16_384, pipeline_depth=1, native=False,
+        )
+        _note("stream sweep: native serial (depth 1)")
+        native_serial = score_csv_stream(
+            bundle, data_path, Path(td) / "native.csv",
+            chunk_rows=16_384, pipeline_depth=1,
+        )
+        _note(f"stream sweep: pipelined (native, depth {depth})")
+        pipelined = score_csv_stream(
+            bundle, data_path, Path(td) / "pipelined.csv",
+            chunk_rows=16_384, pipeline_depth=depth,
+        )
+        out["bulk_stream_outputs_identical"] = (
+            (Path(td) / "serial.csv").read_bytes()
+            == (Path(td) / "native.csv").read_bytes()
+            == (Path(td) / "pipelined.csv").read_bytes()
+        )
+    out["bulk_stream_rows_per_s_serial"] = serial["rows_per_s"]
+    out["bulk_stream_rows_per_s_native_serial"] = native_serial["rows_per_s"]
+    out["bulk_stream_rows_per_s_pipelined"] = pipelined["rows_per_s"]
+    out["bulk_stream_speedup"] = round(
+        pipelined["rows_per_s"] / max(serial["rows_per_s"], 1e-9), 3
+    )
+    out["bulk_stream_overlap_speedup"] = round(
+        pipelined["rows_per_s"] / max(native_serial["rows_per_s"], 1e-9), 3
+    )
+    out["bulk_stream_path"] = pipelined["path"]
+    out["bulk_stream_stage_occupancy"] = {
+        name: timing["occupancy"]
+        for name, timing in pipelined["stages"].items()
+    }
     return out
 
 
@@ -751,6 +823,13 @@ def main() -> None:
     batch1 = _batch1_stage(engine, record)
     _note("bulk stage")
     bulk = _bulk_stage(engine, bundle)
+    _note("stream pipeline stage")
+    try:
+        # Guarded like the roofline extras: the streaming sweep is
+        # evidence, never the reason a run loses its headline numbers.
+        bulk.update(_stream_stage(bundle))
+    except Exception as err:
+        bulk["bulk_stream_error"] = f"{type(err).__name__}: {err}"
     _note("roofline stage")
     try:
         # Roofline extras are evidence, not the headline: a cost-analysis

@@ -12,6 +12,9 @@ jaxpr the analyzer inspects is the program production compiles:
   serving hot path), traced at every warmup bucket the engine compiles.
 - ``serve-predict-group``— `ops/predict.py make_grouped_predict_fn` (the
   micro-batcher's vmapped dispatch), traced across slot buckets.
+- ``bulk-score-chunk``   — `parallel/bulk.py make_bulk_fused` (the fused
+  chunk program the pipelined bulk/stream scorers dispatch per chunk),
+  traced at two chunk sizes with the production int8 categorical ids.
 
 Everything is built from ``jax.ShapeDtypeStruct`` pytrees: params come from
 ``jax.eval_shape(model.init, ...)``, batches from the SCHEMA shapes, so the
@@ -221,6 +224,41 @@ def _build_serve_predict_group():
     return entry, {smallest: args(smallest), largest: args(largest)}
 
 
+def _build_bulk_score_chunk():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.models import build_model
+    from mlops_tpu.parallel.bulk import make_bulk_fused
+    from mlops_tpu.schema import SCHEMA
+
+    model = build_model(_tiny_model_config())
+    variables = _abstract_variables(model)
+    monitor = _abstract_monitor()
+
+    def entry(variables, monitor, cat, num, mask):
+        fn = make_bulk_fused(model, monitor, temperature=1.3)
+        return fn(variables, cat, num, mask)
+
+    S = jax.ShapeDtypeStruct
+
+    def args(chunk: int):
+        # int8 categorical ids: the bulk path narrows on the host and
+        # widens in-jit (parallel/bulk.py), so the traced signature must
+        # match what the pipelined chunk scorer actually dispatches.
+        return (
+            variables,
+            monitor,
+            S((chunk, SCHEMA.num_categorical), jnp.int8),
+            S((chunk, SCHEMA.num_numeric), jnp.float32),
+            S((chunk,), jnp.bool_),
+        )
+
+    # Two chunk sizes: the streaming executors compile ONE program per
+    # sweep, so the program must be the same at any chunk shape (TPU304).
+    return entry, {4096: args(4096), 16_384: args(16_384)}
+
+
 def registered_entry_points() -> list[EntryPoint]:
     return [
         EntryPoint(
@@ -253,6 +291,12 @@ def registered_entry_points() -> list[EntryPoint]:
         EntryPoint(
             name="serve-predict-group",
             build=_build_serve_predict_group,
+            params_in_spec=None,
+        ),
+        EntryPoint(
+            name="bulk-score-chunk",
+            build=_build_bulk_score_chunk,
+            # The pipelined bulk scorers load bundle params replicated.
             params_in_spec=None,
         ),
     ]

@@ -387,6 +387,7 @@ def _score_batch(config) -> int:
             chunk_rows=config.score.chunk_rows,
             mesh=mesh,
             exact=True if config.score.exact else None,
+            pipeline_depth=config.score.pipeline_depth,
         )
         print(json.dumps(stats))
         return 0
@@ -415,6 +416,7 @@ def _score_batch(config) -> int:
         drift_sample=config.score.drift_sample,
         seed=config.data.seed,
         exact=True if config.score.exact else None,
+        pipeline_depth=config.score.pipeline_depth,
     )
     if config.score.output_path:
         np.savez(

@@ -227,6 +227,13 @@ class ScoreConfig:
 
     chunk_rows: int = 131_072  # rows per compiled chunk (rounded to mesh axis)
     drift_sample: int = 65_536  # bounded sample for dataset-level drift
+    pipeline_depth: int = 2  # bounded-queue depth of the streaming
+    # executor (data/pipeline_exec.py): read+parse, encode, device
+    # transfer, compute, and result fetch/output each run on their own
+    # stage, overlapped across chunks, with peak memory fixed at a few
+    # chunks. 1 = strict serial (bit-identical outputs, the debugging
+    # baseline); 2 = classic double buffering (the measured sweet spot —
+    # deeper queues oversubscribe small CPU hosts without buying overlap)
     output_path: str = ""  # optional .npz with predictions/outliers
     streaming: bool = False  # out-of-core: stream CSV chunks through the
     # fused predict with one-chunk peak memory (data/stream.py); output

@@ -17,6 +17,7 @@ from mlops_tpu.data.ingest import (
 from mlops_tpu.data.stream import (
     fit_streaming,
     iter_csv_chunks,
+    iter_raw_csv_chunks,
     iter_table_chunks,
     score_csv_stream,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "fit_streaming",
     "generate_synthetic",
     "iter_csv_chunks",
+    "iter_raw_csv_chunks",
     "iter_table_chunks",
     "load_csv_columns",
     "load_table_columns",

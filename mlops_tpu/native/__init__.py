@@ -132,17 +132,38 @@ def encode_csv_native(
     schema: FeatureSchema = SCHEMA,
     require_target: bool = False,
 ) -> EncodedDataset:
-    """Parse + encode a schema CSV in one native pass.
+    """Parse + encode a schema CSV file in one native pass.
 
     Semantics identical to ``load_csv_columns`` + ``Preprocessor.encode``;
     raises ``RuntimeError`` if the native library is unavailable (callers
     use ``encode_csv`` for automatic fallback).
     """
+    return encode_csv_bytes(
+        Path(path).read_bytes(), prep, schema, require_target, source=str(path)
+    )
+
+
+def encode_csv_bytes(
+    data: bytes,
+    prep: Preprocessor,
+    schema: FeatureSchema = SCHEMA,
+    require_target: bool = False,
+    source: str = "<bytes>",
+) -> EncodedDataset:
+    """Parse + encode an in-memory CSV byte buffer (header + rows) with
+    the native kernel.
+
+    This is the streaming hot path: the pipelined executor
+    (`data/stream.py score_csv_stream`) feeds header-prefixed chunk
+    buffers through here on a worker thread, and the ctypes foreign call
+    RELEASES the GIL for the whole parse+encode — so chunk N+1 encodes in
+    C++ while chunk N computes on the device and the GIL-bound
+    reader/writer stages keep running.
+    """
     lib = _lib()
     if lib is None:
         raise RuntimeError("native encoder unavailable")
 
-    data = Path(path).read_bytes()
     # Upper bound on data rows; the kernel returns the true count. max()
     # covers every record-terminator convention (LF, CRLF, bare CR).
     max_rows = max(1, data.count(b"\n"), data.count(b"\r")) + 1
@@ -164,7 +185,7 @@ def encode_csv_native(
     def fptr(a: np.ndarray):
         return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
-    rows = lib.mlops_encode_csv(
+    result_rows = lib.mlops_encode_csv(
         data, len(data), names,
         schema.num_categorical, schema.num_numeric, vocabs,
         fptr(np.ascontiguousarray(prep.numeric_median)),
@@ -174,15 +195,18 @@ def encode_csv_native(
         fptr(num), fptr(lab),
         max_rows, int(require_target), ctypes.byref(has_label),
     )
-    if rows < 0:
+    if result_rows < 0:
         raise ValueError(
-            f"{path}: native encode failed: {_ERRORS.get(rows, rows)}"
+            f"{source}: native encode failed: "
+            f"{_ERRORS.get(result_rows, result_rows)}"
         )
     labels = (
-        lab[:rows].astype(np.int8) if has_label.value else None
+        lab[:result_rows].astype(np.int8) if has_label.value else None
     )
     return EncodedDataset(
-        cat_ids=cat[:rows].copy(), numeric=num[:rows].copy(), labels=labels
+        cat_ids=cat[:result_rows].copy(),
+        numeric=num[:result_rows].copy(),
+        labels=labels,
     )
 
 
@@ -209,6 +233,7 @@ def encode_csv(
 
 __all__ = [
     "encode_csv",
+    "encode_csv_bytes",
     "encode_csv_native",
     "native_available",
 ]

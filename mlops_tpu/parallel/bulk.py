@@ -20,7 +20,6 @@ Mechanics (scaling-book recipe):
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any
 
 import jax
@@ -34,6 +33,12 @@ from mlops_tpu.monitor.state import drift_scores, outlier_flags
 from mlops_tpu.parallel.sharding import batch_sharding, replicated
 from mlops_tpu.schema import SCHEMA
 
+# Chunks a batched fetch stage may drain in one device_get (and how far
+# the compute stage may dispatch ahead of it) — the wave bound that
+# amortizes the per-fetch transport round trip on remote-attached chips
+# while capping in-flight device buffers.
+FETCH_WAVE = 32
+
 
 @dataclasses.dataclass
 class BulkScoreResult:
@@ -43,6 +48,8 @@ class BulkScoreResult:
     rows: int
     elapsed_s: float  # device scoring time (excludes data generation/IO)
     path: str = "exact"  # "exact" | "distilled" — which params scored
+    pipeline: dict[str, Any] | None = None  # per-stage busy/occupancy
+    # timings from the streaming executor (None for the empty dataset)
 
     @property
     def rows_per_s(self) -> float:
@@ -63,6 +70,9 @@ class BulkScoreResult:
             "feature_drift_batch": {
                 k: round(v, 6) for k, v in self.feature_drift.items()
             },
+            **(
+                {"pipeline": self.pipeline} if self.pipeline is not None else {}
+            ),
         }
 
 
@@ -114,13 +124,7 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, exact: bool | None = No
     else:
         model, variables = bundle.model, bundle.variables
 
-    def fused(variables, cat, num, mask):
-        # cat ids travel as int8 (max vocab cardinality is 12; lossless)
-        # and widen on device: host->device bandwidth is the bulk
-        # bottleneck on remote-attached chips (~20 MB/s measured), and
-        # int8 cuts the categorical block's bytes 4x.
-        logits = model.apply(variables, cat.astype(jnp.int32), num, train=False)
-        return jax.nn.sigmoid(logits / temperature), outlier_flags(monitor, num, mask)
+    fused = make_bulk_fused(model, monitor, temperature)
 
     if mesh is None:
         return _bind_vars(jax.jit(fused), variables)
@@ -132,6 +136,50 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, exact: bool | None = No
         out_shardings=(batch_sharding(mesh, ndim=1), batch_sharding(mesh, ndim=1)),
     )
     return _bind_vars(fn, variables)
+
+
+def make_bulk_fused(model, monitor, temperature: float):
+    """The ONE fused bulk program — classifier probabilities + outlier
+    flags in a single dispatch — shared by ``make_chunk_scorer`` and the
+    tpulint Layer-2 registry (`analysis/entrypoints.py bulk-score-chunk`),
+    so the jaxpr the analyzer gates is the program production compiles."""
+
+    def fused(variables, cat, num, mask):
+        # cat ids travel as int8 (max vocab cardinality is 12; lossless)
+        # and widen on device: host->device bandwidth is the bulk
+        # bottleneck on remote-attached chips (~20 MB/s measured), and
+        # int8 cuts the categorical block's bytes 4x.
+        logits = model.apply(variables, cat.astype(jnp.int32), num, train=False)
+        return jax.nn.sigmoid(logits / temperature), outlier_flags(monitor, num, mask)
+
+    return fused
+
+
+def make_chunk_transfer(bundle: Bundle, mesh: Mesh | None):
+    """Stage-3 device placement for the pipelined executors
+    (`data/pipeline_exec.py`): ``jax.device_put`` the NEXT chunk's host
+    arrays — with the mesh's data-parallel shardings when given, so the
+    jitted scorer consumes them zero-copy — while the current chunk
+    computes (double buffering). The sklearn flavor scores on host; its
+    transfer is the identity."""
+    if bundle.flavor == "sklearn":
+        return lambda cat, num, mask: (cat, num, mask)
+    if mesh is None:
+        def place(cat, num, mask):
+            return jax.device_put(cat), jax.device_put(num), jax.device_put(mask)
+
+        return place
+    data_in = batch_sharding(mesh)
+    mask_in = batch_sharding(mesh, ndim=1)
+
+    def place_sharded(cat, num, mask):
+        return (
+            jax.device_put(cat, data_in),
+            jax.device_put(num, data_in),
+            jax.device_put(mask, mask_in),
+        )
+
+    return place_sharded
 
 
 def _bind_vars(fn, variables):
@@ -150,12 +198,24 @@ def score_dataset(
     drift_sample: int = 65_536,
     seed: int = 0,
     exact: bool | None = None,
+    pipeline_depth: int = 2,
 ) -> BulkScoreResult:
     """Stream ``ds`` through the chunk scorer; aggregate monitors.
+
+    The sweep runs on the pipelined streaming executor
+    (`data/pipeline_exec.py`): chunk slicing/padding, host->device
+    transfer, device dispatch, and batched result fetch each occupy their
+    own stage, so chunk N+1 transfers while chunk N computes and chunk
+    N-1's results fetch — with bounded queues keeping in-flight buffers
+    at a few chunks regardless of dataset size. ``pipeline_depth=1``
+    degrades to the strict serial loop (bit-identical results; the
+    executor preserves chunk order at any depth).
 
     ``exact=None`` auto-routes through the distilled bulk student on CPU
     backends when the bundle carries one (``use_distilled_bulk``);
     ``exact=True`` forces the serving-identical ensemble."""
+    from mlops_tpu.data.pipeline_exec import Stage, run_pipeline
+
     path = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
     n = ds.n
     if n == 0:
@@ -171,6 +231,7 @@ def score_dataset(
     axis = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     chunk = max(axis, (chunk_rows // axis) * axis)
     scorer = make_chunk_scorer(bundle, mesh, exact)
+    transfer = make_chunk_transfer(bundle, mesh)
 
     predictions = np.empty(n, np.float32)
     outliers = np.empty(n, np.float32)
@@ -185,47 +246,82 @@ def score_dataset(
         scorer(cat0, num0, np.arange(chunk) < warm_rows)[0]
     )
 
-    # Pipeline the sweep in bounded waves: dispatch up to ``wave`` chunks
-    # (JAX queues the host->device copies and kernels asynchronously),
-    # then fetch the wave's results in one batched device_get. Blocking
-    # per chunk would pay a full transport round trip each (~70 ms on a
-    # tunnel-attached chip); batching fetches amortizes that to one round
-    # trip per wave, while the bound keeps in-flight input buffers from
-    # growing with dataset size (unbounded dispatch of a 10M-row sweep
-    # would hold every chunk's buffers live on the device at once).
-    wave = 32
-    t0 = time.perf_counter()
-    spans: list[tuple[int, int]] = []
-    device_outs = []
-
-    def drain() -> None:
-        for (start, stop), (probs, flags) in zip(
-            spans, jax.device_get(device_outs)
-        ):
-            size = stop - start
-            predictions[start:stop] = probs[:size]
-            outliers[start:stop] = flags[:size]
-        spans.clear()
-        device_outs.clear()
-
     narrow = (
         np.int8 if bundle.flavor != "sklearn" else ds.cat_ids.dtype
     )  # host trees index with the original ids; device path widens in-jit
-    for start in range(0, n, chunk):
-        stop = min(start + chunk, n)
+    base_index = np.arange(chunk)
+    full_mask = np.ones(chunk, bool)
+
+    def slice_chunk(span):
+        start, stop = span
         size = stop - start
         cat = ds.cat_ids[start:stop].astype(narrow)
         num = ds.numeric[start:stop]
         if size < chunk:
             cat = np.pad(cat, ((0, chunk - size), (0, 0)))
             num = np.pad(num, ((0, chunk - size), (0, 0)))
-        mask = np.arange(chunk) < size
-        spans.append((start, stop))
-        device_outs.append(scorer(cat, num, mask))
-        if len(device_outs) >= wave:
-            drain()
-    drain()
-    elapsed = time.perf_counter() - t0
+            mask = base_index < size
+        else:
+            mask = full_mask
+        return start, stop, cat, num, mask
+
+    def transfer_chunk(item):
+        start, stop, cat, num, mask = item
+        return (start, stop, *transfer(cat, num, mask))
+
+    def compute_chunk(item):
+        start, stop, cat, num, mask = item
+        return (start, stop, *scorer(cat, num, mask))
+
+    def fetch_chunks(items):
+        # Batched fetch: one device_get round trip for everything already
+        # dispatched (~70 ms each on a tunnel-attached chip if paid per
+        # chunk). The executor bounds the gather at the queue depth, so
+        # in-flight device buffers stay fixed regardless of dataset size.
+        fetched = jax.device_get(
+            [(probs, flags) for _, _, probs, flags in items]
+        )
+        return [
+            (start, stop, probs, flags)
+            for (start, stop, _, _), (probs, flags) in zip(items, fetched)
+        ]
+
+    def store_chunk(item):
+        start, stop, probs, flags = item
+        size = stop - start
+        predictions[start:stop] = probs[:size]
+        outliers[start:stop] = flags[:size]
+
+    spans = (
+        (start, min(start + chunk, n)) for start in range(0, n, chunk)
+    )
+    pipe = run_pipeline(
+        spans,
+        [
+            Stage("slice", slice_chunk),
+            Stage("transfer", transfer_chunk),
+            Stage("compute", compute_chunk),
+            # The fetch stage keeps the old wave semantics: its deep input
+            # queue lets the compute stage dispatch up to FETCH_WAVE chunks
+            # ahead (JAX queues the copies/kernels asynchronously) and one
+            # batched device_get drains them — one transport round trip
+            # per wave instead of per chunk (~70 ms each on a
+            # tunnel-attached chip), independent of pipeline_depth.
+            # batch_max >= 2 also keeps fetch in list-in/list-out mode at
+            # depth 1 (the gather is still at most one item there).
+            Stage(
+                "fetch",
+                fetch_chunks,
+                batch_max=FETCH_WAVE,
+                queue_depth=FETCH_WAVE,
+            ),
+        ],
+        store_chunk,
+        depth=pipeline_depth,
+        source_name="span",
+        sink_name="store",
+    )
+    elapsed = pipe.wall_s
 
     # Dataset-level drift on a bounded uniform sample (see module docstring).
     take = min(n, drift_sample)
@@ -248,4 +344,5 @@ def score_dataset(
         rows=n,
         elapsed_s=elapsed,
         path=path,
+        pipeline=pipe.as_dict(),
     )

@@ -1,8 +1,11 @@
-"""Wall-clock timing helpers (used by serving metrics and bench)."""
+"""Wall-clock timing helpers (used by serving metrics, the pipelined
+streaming executor, and bench)."""
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 import time
 
 
@@ -16,6 +19,51 @@ class Timer:
     def __exit__(self, *exc) -> None:
         self.seconds = time.perf_counter() - self._start
         self.ms = self.seconds * 1e3
+
+
+class StageClock:
+    """Per-stage busy-time accumulator for pipelined executors
+    (`data/pipeline_exec.py`).
+
+    Each worker wraps its unit of work in ``with clock.stage(name): ...``;
+    ``report(wall_s)`` returns ``{stage: {busy_s, items, occupancy}}``
+    where ``occupancy`` is the fraction of the pipeline's wall clock the
+    stage spent busy. Occupancies are the overlap evidence: in a serial
+    run they sum to ~1.0; in an overlapped run the sum exceeds 1.0 and
+    the largest single occupancy names the bottleneck stage.
+
+    Thread-safe: each stage runs on its own thread, and the executor's
+    serial mode shares one clock across all stages on the caller thread.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._busy: dict[str, float] = {}
+        self._items: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, items: int = 1):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._busy[name] = self._busy.get(name, 0.0) + elapsed
+                self._items[name] = self._items.get(name, 0) + items
+
+    def report(self, wall_s: float) -> dict[str, dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "busy_s": round(busy, 4),
+                    "items": self._items[name],
+                    "occupancy": (
+                        round(busy / wall_s, 4) if wall_s > 0 else 0.0
+                    ),
+                }
+                for name, busy in self._busy.items()
+            }
 
 
 def percentile(sorted_values: list[float], q: float) -> float:
