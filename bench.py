@@ -18,6 +18,10 @@ warmed engine, then measure:
 - roofline evidence: XLA-counted FLOPs ÷ wall ÷ chip peak (``mfu_*`` keys)
   for bulk inference, the fused train step, and the flash-attention
   kernel (utils/flops.py),
+- cold-start evidence (compilecache/): ``engine_cold_start_s`` vs
+  ``engine_warm_start_s`` — two FRESH processes warming against one AOT
+  executable cache dir (first compiles + persists, second deserializes)
+  with cache hit/miss counts,
 - direct engine grouped-dispatch capability (no HTTP layer), and
 - HTTP-level req/s through the real asyncio server + micro-batcher at
   client concurrency {1, 8, 32, 128}.
@@ -570,6 +574,67 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
     return out
 
 
+_COLDSTART_PROBE = r"""
+import json, sys, time
+from mlops_tpu.commands import _honor_jax_platforms_env
+_honor_jax_platforms_env()
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.compilecache import CompileCache
+from mlops_tpu.serve.engine import InferenceEngine
+
+bundle_dir, cache_dir = sys.argv[1], sys.argv[2]
+bundle = load_bundle(bundle_dir)
+engine = InferenceEngine(bundle, compile_cache=CompileCache(cache_dir))
+t0 = time.perf_counter()
+engine.warmup()
+print(json.dumps({
+    "warmup_s": round(time.perf_counter() - t0, 3),
+    "cache": engine.warmup_stats["cache"],
+}))
+"""
+
+
+def _coldstart_stage(bundle_dir) -> dict:
+    """The deploy-path cold-start evidence (compilecache/): warm a FRESH
+    process's engine twice against one AOT executable cache dir — the
+    first process compiles every bucket/group program and persists
+    (``engine_cold_start_s``, all misses), the second deserializes
+    (``engine_warm_start_s``, all hits). The ratio is what every rollout,
+    autoscale event, and restart saves; separate processes are the point
+    (jit caches don't survive a process, the artifact cache does)."""
+    import subprocess
+    import tempfile
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as cache_dir:
+        for phase in ("cold", "warm"):
+            _note(f"engine {phase} start probe (fresh process)")
+            proc = subprocess.run(
+                [sys.executable, "-c", _COLDSTART_PROBE,
+                 str(bundle_dir), cache_dir],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"{phase} start probe failed: {proc.stderr[-500:]}"
+                )
+            probe = json.loads(proc.stdout.strip().splitlines()[-1])
+            cache = probe["cache"] or {}
+            out[f"engine_{phase}_start_s"] = probe["warmup_s"]
+            out[f"engine_{phase}_start_cache_hits"] = cache.get("hits", 0)
+            out[f"engine_{phase}_start_cache_misses"] = cache.get("misses", 0)
+            bypasses = cache.get("bypasses", 0)
+            if bypasses:
+                out[f"engine_{phase}_start_cache_bypasses"] = bypasses
+    out["engine_warm_start_speedup"] = round(
+        out["engine_cold_start_s"] / max(out["engine_warm_start_s"], 1e-9), 2
+    )
+    return out
+
+
 def _engine_stage(engine, record) -> dict:
     """Chip-serving capability without the HTTP layer: concurrent grouped
     dispatches from a small thread pool (what replica processes would
@@ -838,6 +903,14 @@ def main() -> None:
         roofline = _mfu_stage(bundle, bulk, device)
     except Exception as err:
         roofline = {"mfu_error": f"{type(err).__name__}: {err}"}
+    _note("cold/warm start stage")
+    try:
+        # Guarded: deploy-path evidence, never the reason a run loses its
+        # headline numbers. (The ~54 s warmup this stage makes visible was
+        # previously invisible in BENCH_*.json.)
+        coldstart = _coldstart_stage(result.bundle_dir)
+    except Exception as err:
+        coldstart = {"engine_cold_start_error": f"{type(err).__name__}: {err}"}
     _note("engine grouped stage")
     engine_stats = _engine_stage(engine, record)
     _note("http stage")
@@ -858,6 +931,7 @@ def main() -> None:
                 "breakdown_ms": batch1["breakdown_ms"],
                 **bulk,
                 **roofline,
+                **coldstart,
                 **http,
                 "device": str(device),
                 "model": family if ensemble == 1 else f"{family}-ens{ensemble}",

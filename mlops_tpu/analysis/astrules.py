@@ -30,6 +30,12 @@ TPU201   broad-except                   ``except Exception:`` that does not
                                         (XlaRuntimeError, checkify) silently
 TPU202   mutable-default-arg            list/dict/set defaults — shared state
                                         across calls
+TPU203   uncached-hot-path-jit          a ``jax.jit`` site under serve/ or
+                                        parallel/ not routed through the
+                                        compile-cache entry-point registry
+                                        (compilecache/registry.py) — the
+                                        program recompiles on every process
+                                        start instead of deserializing
 ======== ============================== =======================================
 
 Traced-scope detection is heuristic but framework-aware: a function counts
@@ -56,6 +62,15 @@ from mlops_tpu.analysis.findings import (
     file_skipped,
     is_suppressed,
 )
+
+# JAX-free by construction (compilecache/registry.py): the builder names
+# whose jit sites ARE wired through cache.load_or_compile — the TPU203
+# whitelist, shared with the cache so the two can never disagree.
+from mlops_tpu.compilecache.registry import CACHED_JIT_BUILDERS
+
+# Path segments whose jit sites TPU203 polices: the serving + parallel
+# trees are the per-process hot programs the AOT cache exists to warm.
+_HOT_PATH_SEGMENTS = {"serve", "parallel"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +125,12 @@ RULES: dict[str, RuleInfo] = {
             "mutable-default-arg",
             Severity.ERROR,
             "mutable default argument",
+        ),
+        RuleInfo(
+            "TPU203",
+            "uncached-hot-path-jit",
+            Severity.ERROR,
+            "hot-path jit not routed through the compile cache",
         ),
     )
 }
@@ -249,16 +270,21 @@ class _TraceCollector:
     def __init__(self) -> None:
         self.traced_fns: set[int] = set()  # id() of traced def nodes
         self.traced_lambdas: set[int] = set()
-        # (site_node, fn_name, resolved_def_or_None, jit_kwargs)
+        # (site_node, fn_name, resolved_def_or_None, jit_kwargs,
+        #  enclosing_def_names) — the name chain supports TPU203's
+        # cached-builder whitelist.
         self.jit_sites: list[
-            tuple[ast.AST, str, _FnDef | None, set[str]]
+            tuple[ast.AST, str, _FnDef | None, set[str], tuple[str, ...]]
         ] = []
 
     def collect(self, tree: ast.Module) -> None:
-        self._scope(tree.body, [])
+        self._scope(tree.body, [], ())
 
     def _scope(
-        self, body: list[ast.stmt], env: list[dict[str, _FnDef]]
+        self,
+        body: list[ast.stmt],
+        env: list[dict[str, _FnDef]],
+        names: tuple[str, ...],
     ) -> None:
         local: dict[str, _FnDef] = {}
         env = [*env, local]
@@ -269,11 +295,11 @@ class _TraceCollector:
                 nested.append(node)
         for node in _scope_nodes(body):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._decorators(node)
+                self._decorators(node, names)
             elif isinstance(node, ast.Call):
-                self._call(node, env)
+                self._call(node, env, names)
         for fn in nested:
-            self._scope(fn.body, env)
+            self._scope(fn.body, env, (*names, fn.name))
         # Lambda bodies contain no defs/jit calls worth collecting beyond
         # what _call already marked; rule checks happen in the visitor.
 
@@ -284,7 +310,7 @@ class _TraceCollector:
                 return scope[name]
         return None
 
-    def _decorators(self, node: _FnDef) -> None:
+    def _decorators(self, node: _FnDef, names: tuple[str, ...]) -> None:
         for dec in node.decorator_list:
             name = _dotted(dec)
             if name is not None:
@@ -293,7 +319,9 @@ class _TraceCollector:
                     self.traced_fns.add(id(node))
                     if leaf in _JIT_NAMES:
                         # bare @jax.jit: no kwargs possible
-                        self.jit_sites.append((node, node.name, node, set()))
+                        self.jit_sites.append(
+                            (node, node.name, node, set(), names)
+                        )
             elif isinstance(dec, ast.Call):
                 dec_name = _dotted(dec.func) or ""
                 leaf = dec_name.split(".")[-1]
@@ -301,17 +329,26 @@ class _TraceCollector:
                 if leaf in _JIT_NAMES | _TRACING_COMBINATORS:
                     self.traced_fns.add(id(node))
                     if leaf in _JIT_NAMES:
-                        self.jit_sites.append((node, node.name, node, kwargs))
+                        self.jit_sites.append(
+                            (node, node.name, node, kwargs, names)
+                        )
                 elif leaf == "partial" and dec.args:
                     # @partial(jax.jit, static_argnames=...)
                     inner = (_dotted(dec.args[0]) or "").split(".")[-1]
                     if inner in _JIT_NAMES:
                         self.traced_fns.add(id(node))
-                        self.jit_sites.append((node, node.name, node, kwargs))
+                        self.jit_sites.append(
+                            (node, node.name, node, kwargs, names)
+                        )
                     elif inner in _TRACING_COMBINATORS:
                         self.traced_fns.add(id(node))
 
-    def _call(self, node: ast.Call, env: list[dict[str, _FnDef]]) -> None:
+    def _call(
+        self,
+        node: ast.Call,
+        env: list[dict[str, _FnDef]],
+        names: tuple[str, ...],
+    ) -> None:
         name = _dotted(node.func) or ""
         leaf = name.split(".")[-1]
         if leaf in _JIT_NAMES and node.args:
@@ -321,9 +358,15 @@ class _TraceCollector:
                 fn = self._resolve(target.id, env)
                 if fn is not None:
                     self.traced_fns.add(id(fn))
-                self.jit_sites.append((node, target.id, fn, kwargs))
+                self.jit_sites.append((node, target.id, fn, kwargs, names))
             elif isinstance(target, ast.Lambda):
                 self.traced_lambdas.add(id(target))
+                self.jit_sites.append((node, "", None, kwargs, names))
+            else:
+                # jit over an arbitrary expression (`jax.jit(shard_map(...))`)
+                # — nothing resolvable for TPU104/105, but TPU203 still
+                # needs the site.
+                self.jit_sites.append((node, "", None, kwargs, names))
         elif leaf in _TRACING_COMBINATORS:
             for arg in node.args:
                 if isinstance(arg, ast.Name):
@@ -335,8 +378,17 @@ class _TraceCollector:
 
 
 class _RuleVisitor(ast.NodeVisitor):
-    def __init__(self, path: str, collector: _TraceCollector) -> None:
+    def __init__(
+        self,
+        path: str,
+        collector: _TraceCollector,
+        rel_path: str | None = None,
+    ) -> None:
         self.path = path
+        # Path RELATIVE to the analyzed root, used for scope decisions
+        # (TPU203): segments of the directory the user happens to run the
+        # analyzer FROM (e.g. /srv/serve/checkout/...) must not count.
+        self.rel_path = rel_path if rel_path is not None else path
         self.c = collector
         self.findings: list[Finding] = []
         self._traced_depth = 0  # >0 while inside a traced scope
@@ -564,9 +616,30 @@ class _RuleVisitor(ast.NodeVisitor):
                 "ConcretizationTypeError or recompiles per value)",
             )
 
-    # ------------------------------------------------------ TPU104/TPU105
+    # ----------------------------------------------- TPU104/TPU105/TPU203
+    def _on_hot_path(self) -> bool:
+        import re
+
+        # Split on either separator so Windows checkouts match too.
+        return bool(
+            _HOT_PATH_SEGMENTS & set(re.split(r"[\\/]+", self.rel_path))
+        )
+
     def check_jit_sites(self) -> None:
-        for site, fn_name, fn, kwargs in self.c.jit_sites:
+        hot = self._on_hot_path()
+        for site, fn_name, fn, kwargs, enclosing in self.c.jit_sites:
+            if hot and not (set(enclosing) & CACHED_JIT_BUILDERS):
+                self._flag(
+                    "TPU203",
+                    site,
+                    "jax.jit on a serving/parallel hot path outside the "
+                    "compile-cache builders "
+                    f"({', '.join(sorted(CACHED_JIT_BUILDERS))}) — this "
+                    "program recompiles on every process start; route it "
+                    "through compilecache (cache.load_or_compile + a "
+                    "registered entry point) or justify with a disable "
+                    "comment",
+                )
             if fn is not None and not (
                 kwargs & {"static_argnames", "static_argnums"}
             ):
@@ -595,8 +668,12 @@ class _RuleVisitor(ast.NodeVisitor):
                 )
 
 
-def analyze_source(source: str, path: str | Path) -> list[Finding]:
-    """Run every Layer-1 rule over one file's source text."""
+def analyze_source(
+    source: str, path: str | Path, rel_path: str | Path | None = None
+) -> list[Finding]:
+    """Run every Layer-1 rule over one file's source text. ``rel_path``
+    (the path relative to the analyzed root) scopes path-predicated rules
+    like TPU203; it defaults to ``path`` for standalone callers."""
     path = str(path)
     if file_skipped(source):
         return []
@@ -615,7 +692,9 @@ def analyze_source(source: str, path: str | Path) -> list[Finding]:
         ]
     collector = _TraceCollector()
     collector.collect(tree)
-    visitor = _RuleVisitor(path, collector)
+    visitor = _RuleVisitor(
+        path, collector, rel_path=str(rel_path) if rel_path else None
+    )
     visitor.visit(tree)
     visitor.check_jit_sites()
     lines = source.splitlines()
@@ -627,13 +706,23 @@ def analyze_paths(paths: Iterable[str | Path]) -> list[Finding]:
     findings: list[Finding] = []
     for path in paths:
         path = Path(path)
-        files = sorted(path.rglob("*.py")) if path.is_dir() else [path]
-        for file in files:
+        if path.is_dir():
+            # rel: file path under the analyzed root, so directory names
+            # ABOVE the root (a checkout under /srv/serve/, say) never
+            # trip path-scoped rules; the root's own name still counts
+            # (analyzing `mlops_tpu/serve/` directly).
+            files = [(f, Path(path.name) / f.relative_to(path))
+                     for f in sorted(path.rglob("*.py"))]
+        else:
+            files = [(path, path)]
+        for file, rel in files:
             if "__pycache__" in file.parts:
                 continue
             findings.extend(
                 analyze_source(
-                    file.read_text(encoding="utf-8"), file.as_posix()
+                    file.read_text(encoding="utf-8"),
+                    file.as_posix(),
+                    rel_path=rel.as_posix(),
                 )
             )
     return findings

@@ -8,9 +8,10 @@ jaxpr the analyzer inspects is the program production compiles:
 - ``train-step-tp``      — `parallel/steps.py make_sharded_train_step`
   (the DP×TP pjit step); needs a multi-device mesh, skipped (loudly) on
   single-device hosts.
-- ``serve-predict``      — `ops/predict.py make_padded_predict_fn` (the
-  serving hot path), traced at every warmup bucket the engine compiles.
-- ``serve-predict-group``— `ops/predict.py make_grouped_predict_fn` (the
+- ``serve-predict``      — `ops/predict.py make_padded_predict_base` (the
+  serving hot path in its cacheable arguments form), traced at every
+  warmup bucket the engine compiles.
+- ``serve-predict-group``— `ops/predict.py make_grouped_predict_base` (the
   micro-batcher's vmapped dispatch), traced across slot buckets.
 - ``bulk-score-chunk``   — `parallel/bulk.py make_bulk_fused` (the fused
   chunk program the pipelined bulk/stream scorers dispatch per chunk),
@@ -56,40 +57,20 @@ def _tiny_model_config():
 
 
 def _abstract_variables(model) -> Any:
-    """Variable shapes via eval_shape — init never runs."""
-    import jax
-    import jax.numpy as jnp
+    """Variable shapes via eval_shape — one shared definition
+    (`models.abstract_variables`) so the compile cache derives the exact
+    signatures this registry traces."""
+    from mlops_tpu.models import abstract_variables
 
-    from mlops_tpu.schema import SCHEMA
-
-    def init():
-        cat = jnp.zeros((2, SCHEMA.num_categorical), jnp.int32)
-        num = jnp.zeros((2, SCHEMA.num_numeric), jnp.float32)
-        return model.init({"params": jax.random.PRNGKey(0)}, cat, num, train=False)
-
-    return jax.eval_shape(init)
+    return abstract_variables(model)
 
 
 def _abstract_monitor():
-    import jax
-    import jax.numpy as jnp
+    # Shared with the compile-cache warmup (`compilecache/warmup.py`): the
+    # same abstract monitor produces the same cache keys.
+    from mlops_tpu.monitor.state import abstract_monitor_state
 
-    from mlops_tpu.config import MonitorConfig
-    from mlops_tpu.monitor.state import MonitorState
-    from mlops_tpu.schema import SCHEMA
-
-    S = jax.ShapeDtypeStruct
-    ref = MonitorConfig().drift_ref_size
-    return MonitorState(
-        cat_ref_counts=S(
-            (SCHEMA.num_categorical, max(SCHEMA.cards)), jnp.float32
-        ),
-        num_ref_sorted=S((SCHEMA.num_numeric, ref), jnp.float32),
-        num_ref_cdf=S((SCHEMA.num_numeric, ref), jnp.float32),
-        out_mean=S((SCHEMA.num_numeric,), jnp.float32),
-        out_precision=S((SCHEMA.num_numeric, SCHEMA.num_numeric), jnp.float32),
-        out_threshold=S((), jnp.float32),
-    )
+    return abstract_monitor_state()
 
 
 def _abstract_train_state(model, optimizer):
@@ -170,20 +151,21 @@ def _build_serve_predict():
 
     from mlops_tpu.config import ServeConfig
     from mlops_tpu.models import build_model
-    from mlops_tpu.ops.predict import make_padded_predict_fn
+    from mlops_tpu.ops.predict import make_padded_predict_base
 
     model = build_model(_tiny_model_config())
     variables = _abstract_variables(model)
     monitor = _abstract_monitor()
-
-    def entry(variables, monitor, cat, num, mask):
-        fn = make_padded_predict_fn(model, variables, monitor, temperature=1.3)
-        return fn(cat, num, mask)
+    # The CACHEABLE program form (params/monitor/temperature as arguments
+    # — see ops/predict.py make_padded_predict_base): the jaxpr traced
+    # here is byte-for-byte the program the compile cache persists.
+    entry = make_padded_predict_base(model)
 
     def args(bucket: int):
         cat, num = _schema_batch(bucket)
         mask = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
-        return (variables, monitor, cat, num, mask)
+        temp = jax.ShapeDtypeStruct((), jnp.float32)
+        return (variables, monitor, temp, cat, num, mask)
 
     # Trace at every bucket the engine warms: the padded-bucket serving
     # contract ("zero steady-state recompiles") is exactly TPU304.
@@ -196,17 +178,14 @@ def _build_serve_predict_group():
     import jax.numpy as jnp
 
     from mlops_tpu.models import build_model
-    from mlops_tpu.ops.predict import make_grouped_predict_fn
+    from mlops_tpu.ops.predict import make_grouped_predict_base
     from mlops_tpu.schema import SCHEMA
     from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
 
     model = build_model(_tiny_model_config())
     variables = _abstract_variables(model)
     monitor = _abstract_monitor()
-
-    def entry(variables, monitor, cat, num, mask):
-        fn = make_grouped_predict_fn(model, variables, monitor, temperature=1.3)
-        return fn(cat, num, mask)
+    entry = make_grouped_predict_base(model)
 
     S = jax.ShapeDtypeStruct
 
@@ -215,6 +194,7 @@ def _build_serve_predict_group():
         return (
             variables,
             monitor,
+            S((), jnp.float32),
             S((slots, rows, SCHEMA.num_categorical), jnp.int32),
             S((slots, rows, SCHEMA.num_numeric), jnp.float32),
             S((slots, rows), jnp.bool_),
@@ -235,10 +215,7 @@ def _build_bulk_score_chunk():
     model = build_model(_tiny_model_config())
     variables = _abstract_variables(model)
     monitor = _abstract_monitor()
-
-    def entry(variables, monitor, cat, num, mask):
-        fn = make_bulk_fused(model, monitor, temperature=1.3)
-        return fn(variables, cat, num, mask)
+    entry = make_bulk_fused(model)
 
     S = jax.ShapeDtypeStruct
 
@@ -249,6 +226,7 @@ def _build_bulk_score_chunk():
         return (
             variables,
             monitor,
+            S((), jnp.float32),
             S((chunk, SCHEMA.num_categorical), jnp.int8),
             S((chunk, SCHEMA.num_numeric), jnp.float32),
             S((chunk,), jnp.bool_),

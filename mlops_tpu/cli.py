@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),
         ("score-batch", "bulk-score 1M-scale rows data-parallel over the mesh"),
+        ("warmup", "pre-populate the AOT executable cache (compilecache/) "
+                   "for every registered entry point — bake it into the "
+                   "serving image so restarts deserialize instead of "
+                   "recompiling"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument(
@@ -43,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
             nargs="*",
             help="config overrides, e.g. train.steps=500",
         )
+        if name == "warmup":
+            p.add_argument(
+                "--cache-dir",
+                default=None,
+                help="cache directory (sugar for cache.dir=<dir>)",
+            )
     # `analyze` takes paths + flags, not config overrides: static analysis
     # must run identically with zero configuration (CI, pre-commit).
     analyze = sub.add_parser(

@@ -24,6 +24,10 @@ def run(args: argparse.Namespace) -> int:
 
     distributed_init()
     config = load_config(args.config, overrides=getattr(args, "overrides", []))
+    # `warmup --cache-dir X` is sugar for `warmup cache.dir=X` (the flag
+    # form is the documented container-build invocation).
+    if getattr(args, "cache_dir", None):
+        config.cache.dir = args.cache_dir
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
@@ -377,6 +381,7 @@ def _score_batch(config) -> int:
         # over the mesh like the in-memory path (data/stream.py).
         if not config.data.train_path:
             raise SystemExit("score.streaming requires data.train_path=<csv>")
+        from mlops_tpu.compilecache.cache import from_config
         from mlops_tpu.data.stream import score_csv_stream
 
         mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
@@ -388,6 +393,7 @@ def _score_batch(config) -> int:
             mesh=mesh,
             exact=True if config.score.exact else None,
             pipeline_depth=config.score.pipeline_depth,
+            compile_cache=from_config(config),
         )
         print(json.dumps(stats))
         return 0
@@ -407,6 +413,8 @@ def _score_batch(config) -> int:
         columns, _ = generate_synthetic(config.data.rows, seed=config.data.seed)
         ds = bundle.preprocessor.encode(columns)
 
+    from mlops_tpu.compilecache.cache import from_config
+
     mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
     result = score_dataset(
         bundle,
@@ -417,6 +425,7 @@ def _score_batch(config) -> int:
         seed=config.data.seed,
         exact=True if config.score.exact else None,
         pipeline_depth=config.score.pipeline_depth,
+        compile_cache=from_config(config),
     )
     if config.score.output_path:
         np.savez(
@@ -486,14 +495,68 @@ def _serve(config) -> int:
     config.serve.service_name = os.environ.get(
         "SERVICE_NAME", config.serve.service_name
     )
+    from mlops_tpu.compilecache.cache import from_config
+
     bundle = load_bundle(_resolve_bundle(config, model_dir))
     engine = InferenceEngine(
         bundle,
         buckets=tuple(config.serve.warmup_batch_sizes),
         service_name=config.serve.service_name,
         enable_grouping=config.serve.batch_window_ms > 0,
+        # cache.dir set (or MLOPS_TPU_CACHE_DIR, e.g. baked into the Docker
+        # image by `warmup`): readiness deserializes executables instead of
+        # recompiling them — restarts in seconds, not minutes.
+        compile_cache=from_config(config),
+        warmup_workers=config.cache.warmup_workers,
     )
     serve_forever(engine, config.serve)
+    return 0
+
+
+def _warmup(config) -> int:
+    """Pre-populate the AOT executable cache for every registered entry
+    point (`mlops-tpu warmup --cache-dir <dir>`): run once at container
+    build time and the image ships with its executables baked in — staging
+    warms the artifact, prod inherits it, and process warmup becomes
+    deserialization instead of compilation.
+
+    With a resolvable bundle (serve.model_directory / MODEL_DIRECTORY /
+    registry), the serve + bulk programs warm against that bundle's exact
+    state. Without one, everything derives abstractly from the config —
+    lowering needs only shapes, so no training has to exist yet.
+    """
+    import os
+
+    from mlops_tpu.compilecache.cache import CompileCache
+    from mlops_tpu.compilecache.warmup import warm_entry_points
+
+    if not config.cache.dir:
+        raise SystemExit("pass --cache-dir <dir> (or cache.dir=<dir>)")
+    bundle = None
+    model_dir = os.environ.get("MODEL_DIRECTORY", config.serve.model_directory)
+    try:
+        bundle_dir = _resolve_bundle(config, model_dir)
+    # No bundle anywhere (fresh checkout, image built before training):
+    # config-mode warmup is the documented degradation — announced, so a
+    # Docker bake that EXPECTED bundle keys is debuggable from the log.
+    except Exception as err:  # tpulint: disable=TPU201
+        import sys
+
+        print(
+            f"warmup: no bundle at {model_dir!r} ({err}); warming "
+            "config-derived programs instead",
+            file=sys.stderr,
+        )
+        bundle_dir = None
+    if bundle_dir is not None:
+        # A bundle that RESOLVES but fails to load (corrupt weights, bad
+        # schema fingerprint) must fail the build loudly — a silently
+        # config-keyed cache would make every prod replica miss.
+        from mlops_tpu.bundle import load_bundle
+
+        bundle = load_bundle(bundle_dir)
+    report = warm_entry_points(config, CompileCache(config.cache.dir), bundle)
+    print(json.dumps(report))
     return 0
 
 
@@ -521,4 +584,5 @@ _HANDLERS = {
     "score-batch": _score_batch,
     "bench": _bench,
     "serve": _serve,
+    "warmup": _warmup,
 }

@@ -1,0 +1,327 @@
+"""Persistent AOT executable cache.
+
+The readiness gate of every process — serve warmup, train windows, bulk
+sweeps — pays XLA compilation from scratch today (~54 s measured for the
+serving engine's bucket/group grid on the bench box). Compile time is pure
+goodput loss (*ML Productivity Goodput*, arxiv 2502.06982), and prediction
+serving is exactly the workload where ahead-of-time compiled artifacts pay
+off (*A Tensor Compiler for Unified ML Prediction Serving*, arxiv
+2010.04804). This module makes the compiled program a first-class,
+persistent, integrity-checked artifact:
+
+    lowered  = jitted.lower(*abstract_args)      # trace, no devices touched
+    compiled = lowered.compile()                 # XLA compile (releases GIL)
+    payload  = serialize_executable.serialize(compiled)   # bytes on disk
+
+keyed by `keys.cache_key` (jax/jaxlib versions, backend + device kind, mesh
+shape, donation flags, entry id, abstract signature, config hash). Reads
+verify a sha256 checksum and discard-and-recompile on ANY failure; writes
+are atomic tmp+rename (the same discipline as `data/stream.py` outputs), so
+a crashed process can never leave a half-written artifact that a later one
+trusts.
+
+Capability gates, formalized here instead of scattered at call sites:
+
+- ``serialization_available()`` — jaxlibs without
+  ``jax.experimental.serialize_executable`` fall back to configuring JAX's
+  own persistent compilation cache dir under ``<dir>/xla`` (slower than
+  executable deserialization, still skips XLA re-optimization).
+- ``donation_deserialize_safe()`` — on the jaxlib 0.4.x CPU backend a
+  DONATED executable deserialized from cache segfaults (TP pjit step) or
+  silently corrupts results (dense scan window) — reproduced fresh-vs-warm
+  both ways (see parallel/compat.py donation_argnums, PR 1). Donated
+  programs on that backend bypass the cache entirely (no read, no write)
+  and the bypass is counted with its reason in ``stats()``.
+
+Artifacts are trusted local state (same trust level as JAX's own persistent
+compilation cache): the checksum guards corruption and truncation, not
+adversarial payloads — do not point ``cache.dir`` at an untrusted store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import threading
+import time
+from hashlib import sha256
+from pathlib import Path
+from typing import Any, Callable
+
+from mlops_tpu.compilecache import keys
+from mlops_tpu.utils.timing import StageClock
+
+_HEADER_MAGIC = "mlops-tpu-exe"
+
+
+def _serialize_module():
+    try:
+        from jax.experimental import serialize_executable
+
+        return serialize_executable
+    # Capability probe, not error handling: any import failure (renamed
+    # module on a future jax, missing pjrt support) means "use the
+    # jax-persistent-cache fallback". pragma: depends on installed jaxlib.
+    except Exception:  # tpulint: disable=TPU201
+        return None
+
+
+def serialization_available() -> bool:
+    """True when this jaxlib can serialize/deserialize compiled
+    executables (`jax.experimental.serialize_executable`)."""
+    return _serialize_module() is not None
+
+
+def donation_deserialize_safe() -> bool:
+    """False on the jaxlib 0.4.x CPU backend, where executing a donated
+    executable deserialized from cache segfaults or silently corrupts
+    results (the PR 1 reproduction this gate formalizes)."""
+    import jax
+    import jaxlib
+
+    legacy = jaxlib.__version__.startswith("0.4.")
+    return not (legacy and jax.default_backend() == "cpu")
+
+
+@dataclasses.dataclass
+class CacheJob:
+    """One program to warm: a jitted callable plus the abstract call
+    signature to lower it at, and the key components the signature cannot
+    express. ``execute_args`` (concrete) optionally runs the program once
+    after load — the engine uses it to pay first-dispatch allocation at
+    warmup and to fail loudly on an executable that loads but cannot run."""
+
+    entry_id: str
+    jitted: Callable
+    abstract_args: tuple
+    config_hash: str = ""
+    mesh_shape: tuple[int, ...] | None = None
+    donated: bool = False
+    label: str = ""
+    meta: dict = dataclasses.field(default_factory=dict)
+    execute_args: tuple | None = None
+
+
+class CompileCache:
+    """Directory-backed executable cache; thread-safe (warmup pools call
+    ``load_or_compile`` concurrently — XLA compilation releases the GIL, so
+    misses genuinely overlap)."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._se = _serialize_module()
+        self.mode = "serialize" if self._se is not None else "jax-persistent-cache"
+        if self._se is None:  # pragma: no cover - depends on installed jaxlib
+            self._enable_xla_fallback()
+        self._lock = threading.Lock()
+        self._clock = StageClock()
+        self._counts = {
+            "hits": 0,
+            "misses": 0,
+            "bypasses": 0,
+            "discards": 0,
+            "unserializable": 0,
+        }
+        self._bypass_reasons: dict[str, int] = {}
+        self._programs: dict[str, dict[str, Any]] = {}
+
+    # ----------------------------------------------------------- fallback
+    def _enable_xla_fallback(self) -> None:
+        """No executable serialization on this jaxlib: route XLA's own
+        persistent compilation cache at ``<dir>/xla`` so recompiles still
+        skip optimization. Never clobbers a cache dir the process already
+        configured (tests/CI point JAX at their own)."""
+        import jax
+
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return
+        xla_dir = self.directory / "xla"
+        xla_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(xla_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    # -------------------------------------------------------------- paths
+    def _artifact_path(self, entry_id: str, digest: str) -> Path:
+        return self.directory / entry_id / f"{digest}.jaxexe"
+
+    # ------------------------------------------------------------ loading
+    def load_or_compile(self, job: CacheJob) -> Callable:
+        """Probe -> deserialize hit / compile miss (persisting the result).
+
+        Returns a callable executable for EXACTLY ``job.abstract_args``'s
+        shapes/dtypes; it raises on mismatched inputs rather than
+        recompiling (callers keep their jitted fallback for novel shapes).
+        """
+        label = job.label or job.entry_id
+        components, digest = keys.cache_key(
+            job.entry_id,
+            job.abstract_args,
+            config_hash=job.config_hash,
+            mesh_shape=job.mesh_shape,
+            donated=job.donated,
+        )
+        if job.donated and not donation_deserialize_safe():
+            # The formalized jaxlib-0.4.x-CPU hazard: never deserialize —
+            # and never persist, so no artifact exists for this backend to
+            # read back by accident.
+            fn, seconds = self._compile(job)
+            self._record(
+                label, digest, "bypass-compiled", seconds,
+                bypass_reason="donated-deserialize-unsafe",
+            )
+            return self._maybe_execute(job, fn)
+
+        path = self._artifact_path(job.entry_id, digest)
+        if self._se is not None and path.is_file():
+            fn, seconds = self._try_deserialize(path)
+            if fn is not None:
+                self._record(label, digest, "deserialized", seconds)
+                return self._maybe_execute(job, fn)
+            # Corrupt/truncated/incompatible artifact: already unlinked by
+            # _try_deserialize; fall through to a fresh compile.
+
+        fn, seconds = self._compile(job)
+        if self._se is not None:
+            self._persist(path, components, fn)
+        self._record(label, digest, "compiled", seconds)
+        return self._maybe_execute(job, fn)
+
+    def _maybe_execute(self, job: CacheJob, fn: Callable) -> Callable:
+        if job.execute_args is not None:
+            import jax
+
+            jax.block_until_ready(fn(*job.execute_args))
+        return fn
+
+    def _compile(self, job: CacheJob) -> tuple[Callable, float]:
+        start = time.perf_counter()
+        with self._clock.stage("compile"):
+            compiled = job.jitted.lower(*job.abstract_args).compile()
+        return compiled, time.perf_counter() - start
+
+    def _try_deserialize(self, path: Path) -> tuple[Callable | None, float]:
+        """Checksum-verified read; ANY failure discards the artifact and
+        reports None (the caller recompiles) — corruption can cost a
+        compile, never a crash and never a stale/garbled program."""
+        start = time.perf_counter()
+        try:
+            with self._clock.stage("deserialize"):
+                raw = path.read_bytes()
+                header_line, _, blob = raw.partition(b"\n")
+                import json
+
+                header = json.loads(header_line)
+                if header.get("magic") != _HEADER_MAGIC:
+                    raise ValueError("bad artifact magic")
+                if header.get("format") != keys.CACHE_FORMAT_VERSION:
+                    raise ValueError("artifact format version mismatch")
+                if len(blob) != header.get("payload_bytes"):
+                    raise ValueError("artifact truncated")
+                if sha256(blob).hexdigest() != header.get("sha256"):
+                    raise ValueError("artifact checksum mismatch")
+                payload, in_tree, out_tree = pickle.loads(blob)
+                fn = self._se.deserialize_and_load(payload, in_tree, out_tree)
+            return fn, time.perf_counter() - start
+        # The breadth is the contract: unreadable pickle, jaxlib refusing
+        # the executable, header rot — all become a counted discard plus a
+        # recompile, never an exception on the warmup path.
+        except Exception:  # tpulint: disable=TPU201
+            path.unlink(missing_ok=True)
+            with self._lock:
+                self._counts["discards"] += 1
+            return None, time.perf_counter() - start
+
+    def _persist(self, path: Path, components: dict, compiled: Any) -> None:
+        """Atomic tmp+rename write (stream.py discipline): concurrent
+        writers race benignly (same key -> same bytes; os.replace is
+        atomic), and a crash never leaves a partial artifact in place.
+
+        The payload is VALIDATED (one in-process deserialize) before it
+        touches disk: on jaxlib 0.4.x CPU, an executable whose compile was
+        served from JAX's persistent compilation cache on disk serializes
+        into an artifact that fails at load with "Symbols not found"
+        (reproduced cross-process) — such programs are counted
+        ``unserializable`` and never persisted, so the artifact store only
+        ever holds executables proven to round-trip."""
+        try:
+            serialized = self._se.serialize(compiled)
+            self._se.deserialize_and_load(*serialized)
+        # Some backends compile programs their PjRt runtime cannot
+        # serialize or round-trip (the jaxlib 0.4.x case above; exotic
+        # plugin backends); serving must not die for a cache write.
+        except Exception:  # tpulint: disable=TPU201
+            with self._lock:
+                self._counts["unserializable"] += 1
+            return
+        import json
+
+        blob = pickle.dumps(serialized)
+        header = {
+            "magic": _HEADER_MAGIC,
+            "format": keys.CACHE_FORMAT_VERSION,
+            "sha256": sha256(blob).hexdigest(),
+            "payload_bytes": len(blob),
+            "key": components,
+        }
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(
+            f".{path.name}.tmp-{os.getpid()}-{threading.get_ident()}"
+        )
+        try:
+            tmp.write_bytes(json.dumps(header).encode() + b"\n" + blob)
+            os.replace(tmp, path)
+        except OSError:
+            tmp.unlink(missing_ok=True)
+
+    # -------------------------------------------------------------- stats
+    def _record(
+        self,
+        label: str,
+        digest: str,
+        source: str,
+        seconds: float,
+        bypass_reason: str | None = None,
+    ) -> None:
+        with self._lock:
+            if source == "deserialized":
+                self._counts["hits"] += 1
+            elif source == "compiled":
+                self._counts["misses"] += 1
+            else:
+                self._counts["bypasses"] += 1
+                self._bypass_reasons[bypass_reason] = (
+                    self._bypass_reasons.get(bypass_reason, 0) + 1
+                )
+            self._programs[label] = {
+                "source": source,
+                "seconds": round(seconds, 4),
+                "key": digest[:12],
+            }
+
+    def stats(self) -> dict[str, Any]:
+        """Hit/miss/bypass counts plus per-program compile vs deserialize
+        wall time (`utils/timing.py StageClock` accumulates the busy
+        seconds per stage)."""
+        with self._lock:
+            clock = {
+                name: timing["busy_s"]
+                for name, timing in self._clock.report(1.0).items()
+            }
+            return {
+                "mode": self.mode,
+                "dir": str(self.directory),
+                **dict(self._counts),
+                "bypass_reasons": dict(self._bypass_reasons),
+                "compile_s": round(clock.get("compile", 0.0), 4),
+                "deserialize_s": round(clock.get("deserialize", 0.0), 4),
+                "programs": {k: dict(v) for k, v in self._programs.items()},
+            }
+
+
+def from_config(config: Any) -> CompileCache | None:
+    """The one construction rule every subsystem shares: ``cache.dir``
+    set -> a CompileCache there; empty (the default) -> caching off."""
+    directory = getattr(getattr(config, "cache", None), "dir", "")
+    return CompileCache(directory) if directory else None

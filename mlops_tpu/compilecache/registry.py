@@ -1,0 +1,40 @@
+"""The compile cache's JAX-free registry surface.
+
+This module is imported by tpulint's Layer-1 AST rules (`analysis/astrules.py`
+TPU203), which must run on machines without an accelerator stack — so it can
+never import JAX (or anything that transitively does).
+
+Two invariants live here:
+
+- ``CACHE_ENTRY_IDS`` — the entry points the cache knows how to warm. A test
+  (tests/test_compilecache.py) pins this set equal to the tpulint Layer-2
+  entry-point registry (`analysis/entrypoints.py registered_entry_points`),
+  and ``warmup.warm_entry_points`` raises on any registered entry point
+  without a warmer — the analyzer and the cache can never disagree about
+  what the hot programs are.
+- ``CACHED_JIT_BUILDERS`` — the builder functions under ``serve/`` and
+  ``parallel/`` whose ``jax.jit`` call sites ARE routed through the cache.
+  TPU203 flags any other jit site in those trees: a hot-path program that
+  the cache cannot warm recompiles on every process start.
+"""
+
+from __future__ import annotations
+
+CACHE_ENTRY_IDS: tuple[str, ...] = (
+    "train-step-dense",
+    "train-step-tp",
+    "serve-predict",
+    "serve-predict-group",
+    "bulk-score-chunk",
+)
+
+# Function names (under serve/ and parallel/) whose jit sites are wired to
+# cache.load_or_compile — the TPU203 whitelist. Keep in sync with the job
+# builders in `compilecache/warmup.py`.
+CACHED_JIT_BUILDERS: frozenset[str] = frozenset(
+    {
+        "make_chunk_scorer",  # parallel/bulk.py  -> bulk-score-chunk
+        "make_bulk_jit",  # parallel/bulk.py      -> bulk-score-chunk
+        "make_sharded_train_step",  # parallel/steps.py -> train-step-tp
+    }
+)

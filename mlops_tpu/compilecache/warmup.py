@@ -1,0 +1,527 @@
+"""AOT warmup: job builders per registered entry point + the parallel runner.
+
+Every production call site that compiles a hot program (the serving
+engine's bucketed/grouped warmup, the bulk chunk scorer, the dense train
+window, the TP pjit step) builds its `CacheJob` HERE, and the warmup CLI
+(`mlops-tpu warmup`) enumerates the tpulint Layer-2 entry-point registry
+(`analysis/entrypoints.py registered_entry_points`) through the same
+builders — one definition per entry point, so a cache pre-populated at
+container build time produces byte-for-byte the keys the serving process
+probes. ``warm_entry_points`` raises on a registered entry point without a
+warmer: the analyzer and the cache can never disagree about what the hot
+programs are.
+
+Misses compile IN PARALLEL: XLA compilation releases the GIL, so a small
+thread pool over buckets turns the serial ~54 s cold warmup into
+max-of-compiles instead of sum-of-compiles even with an empty cache.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import time
+from typing import Any, Callable
+
+from mlops_tpu.compilecache.cache import CacheJob, CompileCache
+from mlops_tpu.compilecache.keys import (
+    model_fingerprint,
+    train_fingerprint,
+    tree_avals,
+)
+from mlops_tpu.compilecache.registry import CACHE_ENTRY_IDS
+
+
+def _is_concrete(tree: Any) -> bool:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and not isinstance(leaves[0], jax.ShapeDtypeStruct)
+
+
+def _schema_avals(batch_shape: tuple[int, ...], cat_dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.schema import SCHEMA
+
+    S = jax.ShapeDtypeStruct
+    return (
+        S((*batch_shape, SCHEMA.num_categorical), cat_dtype or jnp.int32),
+        S((*batch_shape, SCHEMA.num_numeric), jnp.float32),
+        S(batch_shape, jnp.bool_),
+    )
+
+
+def _schema_zeros(batch_shape: tuple[int, ...], cat_dtype=None):
+    import numpy as np
+
+    from mlops_tpu.schema import SCHEMA
+
+    return (
+        np.zeros((*batch_shape, SCHEMA.num_categorical), cat_dtype or np.int32),
+        np.zeros((*batch_shape, SCHEMA.num_numeric), np.float32),
+        np.ones(batch_shape, bool),
+    )
+
+
+def _temp_aval():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((), jnp.float32)
+
+
+# ----------------------------------------------------------- serve entries
+def serve_predict_jobs(
+    model,
+    model_config,
+    variables,
+    monitor,
+    buckets: tuple[int, ...],
+    temperature: float = 1.0,
+) -> list[CacheJob]:
+    """One job per warmup bucket of the padded serving predict
+    (entry ``serve-predict``). ``variables``/``monitor`` may be concrete
+    (the engine: jobs also execute once to pay first-dispatch allocation)
+    or ShapeDtypeStruct trees (the warmup CLI: compile+persist only)."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.predict import make_padded_predict_base
+
+    var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
+    concrete = _is_concrete(variables)
+    config_hash = model_fingerprint(model_config)
+    jobs = []
+    for bucket in buckets:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict",
+                # A fresh jit per job: AOT lowering never reuses the jit
+                # dispatch cache, and per-job objects keep the thread pool
+                # free of shared mutable state.
+                jitted=jax.jit(make_padded_predict_base(model)),
+                abstract_args=(
+                    var_avals, mon_avals, _temp_aval(), *_schema_avals((bucket,))
+                ),
+                config_hash=config_hash,
+                label=f"serve-predict/b{bucket}",
+                meta={"bucket": bucket},
+                execute_args=(
+                    (variables, monitor, np.float32(temperature),
+                     *_schema_zeros((bucket,)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
+def serve_group_jobs(
+    model,
+    model_config,
+    variables,
+    monitor,
+    grid: list[tuple[int, int]],
+    temperature: float = 1.0,
+) -> list[CacheJob]:
+    """One job per (slots, rows) shape of the micro-batcher's vmapped
+    dispatch (entry ``serve-predict-group``)."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.predict import make_grouped_predict_base
+
+    var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
+    concrete = _is_concrete(variables)
+    config_hash = model_fingerprint(model_config)
+    jobs = []
+    for slots, rows in grid:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict-group",
+                jitted=jax.jit(make_grouped_predict_base(model)),
+                abstract_args=(
+                    var_avals, mon_avals, _temp_aval(),
+                    *_schema_avals((slots, rows)),
+                ),
+                config_hash=config_hash,
+                label=f"serve-predict-group/g{slots}x{rows}",
+                meta={"slots": slots, "rows": rows},
+                execute_args=(
+                    (variables, monitor, np.float32(temperature),
+                     *_schema_zeros((slots, rows)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
+# ------------------------------------------------------------- bulk entry
+def bulk_chunk_job(
+    model,
+    model_config,
+    variables,
+    monitor,
+    chunk_rows: int,
+    mesh=None,
+    path_label: str = "exact",
+    jitted: Callable | None = None,
+) -> CacheJob:
+    """The fused bulk chunk program (entry ``bulk-score-chunk``) at one
+    chunk shape, with the production int8 categorical ids. ``path_label``
+    keys the exact-ensemble and distilled-student programs apart (their
+    architectures differ even when their signatures happen to match)."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.parallel.bulk import make_bulk_jit
+
+    return CacheJob(
+        entry_id="bulk-score-chunk",
+        jitted=jitted if jitted is not None else make_bulk_jit(model, mesh),
+        abstract_args=(
+            tree_avals(variables),
+            tree_avals(monitor),
+            _temp_aval(),
+            *_schema_avals((chunk_rows,), cat_dtype=jnp.int8),
+        ),
+        config_hash=model_fingerprint((path_label, model_config)),
+        mesh_shape=tuple(mesh.devices.shape) if mesh is not None else None,
+        label=f"bulk-score-chunk/{path_label}-c{chunk_rows}",
+        meta={"chunk_rows": chunk_rows, "path": path_label},
+    )
+
+
+# ------------------------------------------------------------ train entries
+def train_window_job(
+    model,
+    optimizer,
+    train_config,
+    window: int,
+    state,
+    cat,
+    num,
+    lab,
+    jitted: Callable | None = None,
+) -> CacheJob:
+    """The dense scan window (entry ``train-step-dense``) at one (window,
+    dataset-shape) signature. Donation follows `parallel/compat.py
+    donation_argnums`: when the backend donates the train state, the cache
+    layer's capability gate bypasses deserialization on backends where a
+    cached donated executable misbehaves."""
+    import jax
+
+    from mlops_tpu.parallel.compat import donation_argnums
+    from mlops_tpu.train.loop import make_train_window
+
+    if jitted is None:
+        jitted = make_train_window(model, optimizer, train_config, window)
+    args = tuple(tree_avals(a) for a in (state, cat, num, lab))
+    rows = jax.tree_util.tree_leaves(args[1])[0].shape[0]
+    return CacheJob(
+        entry_id="train-step-dense",
+        jitted=jitted,
+        abstract_args=args,
+        config_hash=train_fingerprint(model, train_config, f"window={window}"),
+        donated=bool(donation_argnums(0)),
+        label=f"train-step-dense/w{window}xn{rows}",
+        meta={"window": window, "rows": rows},
+    )
+
+
+def tp_step_job(
+    model,
+    optimizer,
+    train_config,
+    mesh,
+    state,
+    batch_size: int,
+    jitted: Callable,
+) -> CacheJob:
+    """The DP×TP pjit step (entry ``train-step-tp``) at the configured
+    per-step batch. ``jitted`` is the REAL step from
+    `parallel/steps.py make_sharded_train_step` — the cache wraps
+    production programs, never re-implementations."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.parallel.compat import donation_argnums
+
+    S = jax.ShapeDtypeStruct
+    cat_a, num_a, _ = _schema_avals((batch_size,))
+    return CacheJob(
+        entry_id="train-step-tp",
+        jitted=jitted,
+        abstract_args=(
+            tree_avals(state),
+            cat_a,
+            num_a,
+            S((batch_size,), jnp.float32),
+            S((2,), jnp.uint32),
+        ),
+        config_hash=train_fingerprint(model, train_config, "tp"),
+        mesh_shape=tuple(mesh.devices.shape),
+        donated=bool(donation_argnums(0)),
+        label=f"train-step-tp/b{batch_size}",
+        meta={"batch_size": batch_size},
+    )
+
+
+# --------------------------------------------------------------- execution
+def default_workers(n_jobs: int, configured: int = 0) -> int:
+    if configured > 0:
+        return min(configured, n_jobs)
+    return max(1, min(8, os.cpu_count() or 1, n_jobs))
+
+
+def run_jobs(
+    jobs: list[CacheJob],
+    cache: CompileCache | None = None,
+    workers: int = 0,
+) -> list[tuple[CacheJob, Callable]]:
+    """Load/compile every job on a small thread pool (misses overlap; hits
+    deserialize in milliseconds each). Without a cache the jobs still AOT
+    compile in parallel — the cacheless cold start gets max-of-compiles
+    too, it just cannot persist."""
+
+    def one(job: CacheJob) -> Callable:
+        if cache is not None:
+            return cache.load_or_compile(job)
+        fn = job.jitted.lower(*job.abstract_args).compile()
+        if job.execute_args is not None:
+            import jax
+
+            jax.block_until_ready(fn(*job.execute_args))
+        return fn
+
+    if not jobs:
+        return []
+    n = default_workers(len(jobs), workers)
+    if n == 1:
+        return [(job, one(job)) for job in jobs]
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=n, thread_name_prefix="aot-warmup"
+    ) as pool:
+        compiled = list(pool.map(one, jobs))
+    return list(zip(jobs, compiled))
+
+
+# ------------------------------------------------------------- CLI warmers
+def _serve_model_state(config, bundle):
+    """(model, model_config, variables, monitor, temperature) for the serve
+    entries — the bundle's real state when given (exact keys for that
+    deployment), else abstract state derived purely from the config (what a
+    container build can warm before any training ran)."""
+    from mlops_tpu.models import build_model
+
+    if bundle is not None:
+        return (
+            bundle.model,
+            bundle.model_config,
+            bundle.variables,
+            bundle.monitor,
+            bundle.temperature,
+        )
+    from mlops_tpu.models import abstract_variables
+    from mlops_tpu.monitor.state import abstract_monitor_state
+
+    model = build_model(config.model)
+    return (
+        model,
+        config.model,
+        abstract_variables(model),
+        abstract_monitor_state(config.monitor),
+        1.0,
+    )
+
+
+def _warm_serve_predict(config, bundle) -> list[CacheJob]:
+    model, mcfg, variables, monitor, temp = _serve_model_state(config, bundle)
+    return serve_predict_jobs(
+        model, mcfg, variables, monitor,
+        tuple(config.serve.warmup_batch_sizes), temperature=temp,
+    )
+
+
+def _warm_serve_group(config, bundle) -> list[CacheJob]:
+    if config.serve.batch_window_ms <= 0:
+        return []  # grouping disabled: the engine never builds these shapes
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKETS, GROUP_SLOT_BUCKETS
+
+    model, mcfg, variables, monitor, temp = _serve_model_state(config, bundle)
+    grid = [(s, r) for r in GROUP_ROW_BUCKETS for s in GROUP_SLOT_BUCKETS]
+    return serve_group_jobs(
+        model, mcfg, variables, monitor, grid, temperature=temp
+    )
+
+
+def _warm_bulk(config, bundle) -> list[CacheJob]:
+    import jax
+
+    from mlops_tpu.monitor.state import abstract_monitor_state
+    from mlops_tpu.parallel import make_mesh
+    from mlops_tpu.parallel.bulk import mesh_chunk_rows, use_distilled_bulk
+
+    mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
+    # The SAME rounding rule the scoring paths apply — a divergence here
+    # is a guaranteed cache-key miss at run time.
+    chunk = mesh_chunk_rows(config.score.chunk_rows, mesh)
+    jobs = []
+    if bundle is not None:
+        monitor = bundle.monitor
+        variants = [("exact", bundle.model, bundle.model_config, bundle.variables)]
+        if use_distilled_bulk(bundle):
+            variants.append(
+                ("distilled", bundle.bulk_model,
+                 bundle.model_config, bundle.bulk_variables)
+            )
+    else:
+        from mlops_tpu.models import abstract_variables, build_model
+
+        model = build_model(config.model)
+        monitor = abstract_monitor_state(config.monitor)
+        variants = [("exact", model, config.model, abstract_variables(model))]
+    for path_label, model, mcfg, variables in variants:
+        jobs.append(
+            bulk_chunk_job(
+                model, mcfg, variables, monitor, chunk, mesh,
+                path_label=path_label,
+            )
+        )
+    return jobs
+
+
+def _abstract_train_state(config, model, optimizer):
+    """Abstract TrainState matching what ``fit`` will build — including the
+    EMA accumulator when ``train.ema_decay`` is on (its presence changes
+    the pytree structure and therefore the key)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.models import abstract_variables
+    from mlops_tpu.train.loop import TrainState
+
+    variables = abstract_variables(model)
+    params = variables["params"]
+    S = jax.ShapeDtypeStruct
+    return TrainState(
+        params=params,
+        opt_state=jax.eval_shape(optimizer.init, params),
+        step=S((), jnp.int32),
+        rng=S((2,), jnp.uint32),
+        ema=params if config.train.ema_decay else None,
+    )
+
+
+def _warm_train_dense(config, bundle) -> list[CacheJob]:
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.models import build_model
+    from mlops_tpu.train.loop import make_optimizer
+
+    if config.model.family in ("gbm", "rf"):
+        return []  # sklearn families have no jitted train step
+    model = build_model(config.model)
+    optimizer = make_optimizer(config.train)
+    state = _abstract_train_state(config, model, optimizer)
+    # The scan consumes the TRAIN SPLIT arrays — mirror split_dataset's
+    # arithmetic so a later `train` run with this config is an exact hit.
+    n = config.data.rows
+    n_train = n - int(n * config.data.valid_fraction)
+    cat, num, _ = _schema_avals((n_train,))
+    lab = jax.ShapeDtypeStruct((n_train,), jnp.float32)
+    base = max(1, min(config.train.eval_every, config.train.steps))
+    windows = {base}
+    if config.train.steps % base:
+        windows.add(config.train.steps % base)  # the shrunk final window
+    return [
+        train_window_job(model, optimizer, config.train, w, state, cat, num, lab)
+        for w in sorted(windows)
+    ]
+
+
+def _warm_train_tp(config, bundle) -> list[CacheJob]:
+    import dataclasses
+
+    import jax
+
+    if jax.device_count() < 2:
+        return []  # reported as skipped by warm_entry_points
+    if config.model.family in ("gbm", "rf"):
+        return []
+    from mlops_tpu.models import build_model
+    from mlops_tpu.parallel import make_mesh
+    from mlops_tpu.parallel.steps import make_sharded_train_step
+    from mlops_tpu.train.loop import make_optimizer
+
+    k = config.model.tensor_parallel
+    mesh = make_mesh(jax.device_count(), model_parallel=k) if k >= 2 else (
+        make_mesh(jax.device_count())
+    )
+    # TP is a layout, not a different network (train/tensor_parallel.py):
+    # the step compiles against the PLAIN dense family.
+    model = build_model(dataclasses.replace(config.model, tensor_parallel=0))
+    optimizer = make_optimizer(config.train)
+    state = _abstract_train_state(config, model, optimizer)
+    step_fn, _ = make_sharded_train_step(
+        model, optimizer, config.train, mesh, state.params
+    )
+    return [
+        tp_step_job(
+            model, optimizer, config.train, mesh, state,
+            config.train.batch_size, step_fn,
+        )
+    ]
+
+
+_WARMERS: dict[str, Callable] = {
+    "serve-predict": _warm_serve_predict,
+    "serve-predict-group": _warm_serve_group,
+    "bulk-score-chunk": _warm_bulk,
+    "train-step-dense": _warm_train_dense,
+    "train-step-tp": _warm_train_tp,
+}
+
+
+def warm_entry_points(config, cache: CompileCache, bundle=None) -> dict:
+    """Pre-populate ``cache`` with every registered entry point's hot
+    programs (the `mlops-tpu warmup` CLI body). The enumeration IS the
+    tpulint Layer-2 registry; an entry point registered there without a
+    warmer here is a hard error, not a silent gap."""
+    from mlops_tpu.analysis.entrypoints import registered_entry_points
+
+    if set(_WARMERS) != set(CACHE_ENTRY_IDS):  # survives python -O
+        raise RuntimeError(
+            "compilecache warmers out of sync with registry.CACHE_ENTRY_IDS: "
+            f"{sorted(set(_WARMERS) ^ set(CACHE_ENTRY_IDS))}"
+        )
+    t0 = time.perf_counter()
+    jobs: list[CacheJob] = []
+    entries: dict[str, dict] = {}
+    for entry in registered_entry_points():
+        warmer = _WARMERS.get(entry.name)
+        if warmer is None:
+            raise RuntimeError(
+                f"entry point {entry.name!r} has no compile-cache warmer — "
+                "register one in mlops_tpu/compilecache/warmup.py and add it "
+                "to registry.CACHE_ENTRY_IDS"
+            )
+        entry_jobs = warmer(config, bundle)
+        entries[entry.name] = {"programs": len(entry_jobs)}
+        if not entry_jobs:
+            entries[entry.name]["skipped"] = True
+        jobs.extend(entry_jobs)
+    run_jobs(jobs, cache=cache, workers=config.cache.warmup_workers)
+    return {
+        "cache_dir": str(cache.directory),
+        "mode": "bundle" if bundle is not None else "config",
+        "entries": entries,
+        "programs": len(jobs),
+        "warmup_s": round(time.perf_counter() - t0, 3),
+        "cache": cache.stats(),
+    }

@@ -245,6 +245,20 @@ class ScoreConfig:
 
 
 @dataclasses.dataclass
+class CacheConfig:
+    """Persistent AOT executable cache (`mlops_tpu/compilecache/`)."""
+
+    dir: str = ""  # cache directory; empty (default) = caching OFF. Set
+    # (or export MLOPS_TPU_CACHE_DIR) and every hot program — the serve
+    # engine's bucketed/grouped predicts, the dense train window, the TP
+    # pjit step, the bulk chunk scorer — deserializes its compiled
+    # executable from here instead of re-XLA-compiling per process; the
+    # `warmup` CLI pre-populates it (e.g. at container build time)
+    warmup_workers: int = 0  # parallel compile threads for warmup misses
+    # (XLA compilation releases the GIL); 0 = auto: min(8, cpu count)
+
+
+@dataclasses.dataclass
 class MeshConfig:
     data_axis: int = 0  # 0 -> use all devices on the data axis
     model_axis: int = 1
@@ -260,6 +274,7 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     registry: RegistryConfig = dataclasses.field(default_factory=RegistryConfig)
     score: ScoreConfig = dataclasses.field(default_factory=ScoreConfig)
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 
 

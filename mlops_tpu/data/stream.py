@@ -380,6 +380,7 @@ def score_csv_stream(
     exact: bool | None = None,
     pipeline_depth: int = 2,
     native: bool | None = None,
+    compile_cache=None,
 ) -> dict[str, float]:
     """Stream-score a CSV/Parquet of any size through the bundle's fused
     predict.
@@ -408,17 +409,22 @@ def score_csv_stream(
         FETCH_WAVE,
         make_chunk_scorer,
         make_chunk_transfer,
+        mesh_chunk_rows,
         use_distilled_bulk,
     )
 
-    if mesh is not None:
-        axis = mesh.shape["data"]
-        chunk_rows = ((chunk_rows + axis - 1) // axis) * axis
+    chunk_rows = mesh_chunk_rows(chunk_rows, mesh)
     # Same routing contract as score_dataset: ``exact=None`` auto-routes
     # through the distilled bulk student on CPU backends; the returned
     # stats carry ``path`` so the substitution is always visible.
     path_used = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
-    score_chunk = make_chunk_scorer(bundle, mesh=mesh, exact=exact)
+    score_chunk = make_chunk_scorer(
+        bundle,
+        mesh=mesh,
+        exact=exact,
+        compile_cache=compile_cache,
+        chunk_rows=chunk_rows,
+    )
     transfer = make_chunk_transfer(bundle, mesh)
     # cat ids narrow to int8 on the device path (max vocab cardinality is
     # 12; lossless, and host->device bytes are the transfer bottleneck on
@@ -569,4 +575,9 @@ def score_csv_stream(
         "elapsed_s": round(pipe.wall_s, 4),
         "rows_per_s": round(rows / max(pipe.wall_s, 1e-9), 1),
         "stages": pipe.stages,
+        **(
+            {"compile_cache": compile_cache.stats()}
+            if compile_cache is not None
+            else {}
+        ),
     }

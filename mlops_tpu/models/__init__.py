@@ -105,6 +105,20 @@ def init_params(model: nn.Module, rng: jax.Array, batch: int = 2):
     return model.init({"params": rng}, cat, num, train=False)
 
 
+def abstract_variables(model: nn.Module, batch: int = 2):
+    """Variable SHAPES via ``jax.eval_shape`` — init never runs, no
+    parameters materialize. The one definition shared by tpulint's Layer-2
+    entry-point registry (`analysis/entrypoints.py`) and the compile-cache
+    warmup (`compilecache/warmup.py`): both must derive identical abstract
+    signatures or the analyzer and the cache disagree about the programs.
+    """
+
+    def init():
+        return init_params(model, jax.random.PRNGKey(0), batch=batch)
+
+    return jax.eval_shape(init)
+
+
 __all__ = [
     "FAMILIES",
     "BertEncoder",

@@ -92,6 +92,27 @@ def _ref_cdf(ref_sorted: np.ndarray) -> np.ndarray:
     return out
 
 
+def abstract_monitor_state(config: MonitorConfig | None = None) -> MonitorState:
+    """Shape-only MonitorState (ShapeDtypeStruct leaves) for abstract
+    tracing and AOT cache keys: the monitor's array shapes are fully
+    determined by the schema and ``drift_ref_size``, so the tpulint
+    Layer-2 registry (`analysis/entrypoints.py`) and the compile-cache
+    warmup CLI (`compilecache/warmup.py`) can lower the serving programs
+    without a fitted monitor — and produce the exact keys a fitted one
+    would."""
+    config = config or MonitorConfig()
+    S = jax.ShapeDtypeStruct
+    ref = config.drift_ref_size
+    return MonitorState(
+        cat_ref_counts=S((SCHEMA.num_categorical, max(SCHEMA.cards)), jnp.float32),
+        num_ref_sorted=S((SCHEMA.num_numeric, ref), jnp.float32),
+        num_ref_cdf=S((SCHEMA.num_numeric, ref), jnp.float32),
+        out_mean=S((SCHEMA.num_numeric,), jnp.float32),
+        out_precision=S((SCHEMA.num_numeric, SCHEMA.num_numeric), jnp.float32),
+        out_threshold=S((), jnp.float32),
+    )
+
+
 def fit_monitor(
     ds: EncodedDataset, config: MonitorConfig | None = None, seed: int = 0
 ) -> MonitorState:

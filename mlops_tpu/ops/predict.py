@@ -15,6 +15,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mlops_tpu.monitor.state import MonitorState, drift_scores, outlier_flags
 from mlops_tpu.train.calibrate import apply_temperature
@@ -49,16 +50,24 @@ def make_predict_fn(
     return predict
 
 
-def make_padded_predict_fn(
-    model, variables: Any, monitor: MonitorState, temperature: float = 1.0
-) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, jnp.ndarray]]:
-    """Fused predict for serving: takes a row-validity mask so batches padded
-    to fixed bucket sizes produce statistics identical to the unpadded batch
-    (one compiled program per bucket size, zero recompiles in steady state).
+def make_padded_predict_base(model) -> Callable:
+    """The serving hot-path program in its CACHEABLE form: everything the
+    executable depends on beyond the model architecture — params, monitor
+    state, calibration temperature — is an ARGUMENT, never a closure. A
+    closed-over array would be baked into the serialized executable as a
+    constant, and a persistent compile cache (`compilecache/`) keyed on
+    shapes alone would then silently serve a stale model; with args, the
+    abstract signature carries the shapes and the values flow per call.
     """
 
-    @jax.jit
-    def predict(cat_ids: jnp.ndarray, numeric: jnp.ndarray, mask: jnp.ndarray):
+    def predict(
+        variables: Any,
+        monitor: MonitorState,
+        temperature: jnp.ndarray,
+        cat_ids: jnp.ndarray,
+        numeric: jnp.ndarray,
+        mask: jnp.ndarray,
+    ):
         logits = model.apply(variables, cat_ids, numeric, train=False)
         return {
             "predictions": jax.nn.sigmoid(logits / temperature),
@@ -67,6 +76,67 @@ def make_padded_predict_fn(
         }
 
     return predict
+
+
+def make_grouped_predict_base(model) -> Callable:
+    """Cacheable form of the micro-batcher's vmapped program (same
+    argument discipline as ``make_padded_predict_base``): params/monitor/
+    temperature broadcast across the request axis, per-request drift stays
+    computed over each request's OWN rows."""
+
+    def single(variables, monitor, temperature, cat_ids, numeric, mask):
+        logits = model.apply(variables, cat_ids, numeric, train=False)
+        return {
+            "predictions": jax.nn.sigmoid(logits / temperature),
+            "outliers": outlier_flags(monitor, numeric, mask),
+            "feature_drift_batch": drift_scores(monitor, cat_ids, numeric, mask),
+        }
+
+    def grouped(variables, monitor, temperature, cat_ids, numeric, mask):
+        return jax.vmap(single, in_axes=(None, None, None, 0, 0, 0))(
+            variables, monitor, temperature, cat_ids, numeric, mask
+        )
+
+    return grouped
+
+
+def _bind_serving_args(base: Callable, variables, monitor, temperature):
+    """Close a base program over one bundle's state, jitted, preserving the
+    old ``(cat_ids, numeric, mask)`` call surface. ``__wrapped__`` exposes
+    the unjitted bound function (checkify audits re-wrap it).
+
+    The bound state is ``device_put`` ONCE here: params/monitor are now
+    per-call ARGUMENTS (the cacheable form), and host numpy arrays would
+    re-pay the full host->device param transfer on EVERY request —
+    committed device arrays transfer once and are passed by reference.
+    (No-op when the caller already placed them, e.g. the engine.)"""
+    jitted = jax.jit(base)
+    variables = jax.device_put(variables)
+    monitor = jax.device_put(monitor)
+    t = jax.device_put(np.float32(temperature))
+
+    def predict(cat_ids, numeric, mask):
+        return jitted(variables, monitor, t, cat_ids, numeric, mask)
+
+    def raw(cat_ids, numeric, mask):
+        return base(variables, monitor, t, cat_ids, numeric, mask)
+
+    predict.__wrapped__ = raw
+    return predict
+
+
+def make_padded_predict_fn(
+    model, variables: Any, monitor: MonitorState, temperature: float = 1.0
+) -> Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], dict[str, jnp.ndarray]]:
+    """Fused predict for serving: takes a row-validity mask so batches padded
+    to fixed bucket sizes produce statistics identical to the unpadded batch
+    (one compiled program per bucket size, zero recompiles in steady state).
+    Built on ``make_padded_predict_base`` so the engine's AOT compile-cache
+    path and this bound convenience form share ONE program definition.
+    """
+    return _bind_serving_args(
+        make_padded_predict_base(model), variables, monitor, temperature
+    )
 
 
 def make_grouped_predict_fn(
@@ -79,20 +149,9 @@ def make_grouped_predict_fn(
     instead of R. (The reference serves strictly one request per model
     call, `app/main.py:72`.)
     """
-
-    def single(cat_ids, numeric, mask):
-        logits = model.apply(variables, cat_ids, numeric, train=False)
-        return {
-            "predictions": jax.nn.sigmoid(logits / temperature),
-            "outliers": outlier_flags(monitor, numeric, mask),
-            "feature_drift_batch": drift_scores(monitor, cat_ids, numeric, mask),
-        }
-
-    @jax.jit
-    def predict(cat_ids: jnp.ndarray, numeric: jnp.ndarray, mask: jnp.ndarray):
-        return jax.vmap(single)(cat_ids, numeric, mask)
-
-    return predict
+    return _bind_serving_args(
+        make_grouped_predict_base(model), variables, monitor, temperature
+    )
 
 
 def make_hybrid_predict_fn(

@@ -40,6 +40,19 @@ from mlops_tpu.schema import SCHEMA
 FETCH_WAVE = 32
 
 
+def mesh_chunk_rows(chunk_rows: int, mesh: Mesh | None) -> int:
+    """THE one chunk-size rounding rule over a data mesh (round UP to the
+    'data' axis, floor one row per shard). score_dataset, the streaming
+    scorer (data/stream.py), and the compile-cache warmer
+    (compilecache/warmup.py) must all agree, or a pre-warmed
+    ``bulk-score-chunk`` artifact's signature never matches the shape the
+    run actually dispatches (silent cache miss, full recompile)."""
+    if mesh is None:
+        return max(1, chunk_rows)
+    axis = int(mesh.shape["data"])
+    return max(axis, ((chunk_rows + axis - 1) // axis) * axis)
+
+
 @dataclasses.dataclass
 class BulkScoreResult:
     predictions: np.ndarray  # float32 [N]
@@ -50,6 +63,9 @@ class BulkScoreResult:
     path: str = "exact"  # "exact" | "distilled" — which params scored
     pipeline: dict[str, Any] | None = None  # per-stage busy/occupancy
     # timings from the streaming executor (None for the empty dataset)
+    compile_cache: dict[str, Any] | None = None  # hit/miss/bypass counts +
+    # per-program compile vs deserialize wall time (compilecache/cache.py)
+    # when the sweep ran against a persistent executable cache
 
     @property
     def rows_per_s(self) -> float:
@@ -73,6 +89,11 @@ class BulkScoreResult:
             **(
                 {"pipeline": self.pipeline} if self.pipeline is not None else {}
             ),
+            **(
+                {"compile_cache": self.compile_cache}
+                if self.compile_cache is not None
+                else {}
+            ),
         }
 
 
@@ -90,12 +111,25 @@ def use_distilled_bulk(bundle: Bundle, exact: bool | None = None) -> bool:
     return jax.default_backend() == "cpu"
 
 
-def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, exact: bool | None = None):
+def make_chunk_scorer(
+    bundle: Bundle,
+    mesh: Mesh | None,
+    exact: bool | None = None,
+    compile_cache=None,
+    chunk_rows: int | None = None,
+):
     """One compiled program: (cat[chunk,C], num[chunk,M], mask[chunk]) ->
     (probs, outlier_flags), fixed-shape per call site (the caller feeds
     equal-sized chunks so a single compile serves the whole sweep).
     Sharded over 'data' when a mesh is given. ``exact`` controls
-    distilled-student routing (see ``use_distilled_bulk``)."""
+    distilled-student routing (see ``use_distilled_bulk``).
+
+    With ``compile_cache`` + ``chunk_rows``, the chunk program is AOT
+    loaded through the persistent executable cache (`compilecache/` entry
+    ``bulk-score-chunk``: deserialize on hit, compile+persist on miss);
+    chunks at any OTHER shape fall back to the jitted program, so the
+    cached executable can never be fed a signature it was not built for.
+    """
     monitor = bundle.monitor
     temperature = bundle.temperature  # calibration (train/calibrate.py):
     # bulk scores must match what the serving engine would return; the
@@ -119,32 +153,74 @@ def make_chunk_scorer(bundle: Bundle, mesh: Mesh | None, exact: bool | None = No
 
         return score_chunk
 
-    if use_distilled_bulk(bundle, exact):
+    path = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
+    if path == "distilled":
         model, variables = bundle.bulk_model, bundle.bulk_variables
     else:
         model, variables = bundle.model, bundle.variables
 
-    fused = make_bulk_fused(model, monitor, temperature)
+    fn = make_bulk_jit(model, mesh)
+    # device_put the per-call program state ONCE (replicated over the mesh
+    # when sharded): params/monitor travel as arguments now, and host
+    # arrays would re-pay the transfer every chunk.
+    rep = replicated(mesh) if mesh is not None else None
+    place = (lambda x: jax.device_put(x, rep)) if rep else jax.device_put
+    variables = place(variables)
+    monitor = place(monitor)
+    t = place(np.float32(temperature))
+    aot = None
+    if compile_cache is not None and chunk_rows:
+        from mlops_tpu.compilecache.warmup import bulk_chunk_job
 
+        aot = compile_cache.load_or_compile(
+            bulk_chunk_job(
+                model,
+                bundle.model_config,
+                variables,
+                monitor,
+                chunk_rows,
+                mesh,
+                path_label=path,
+                jitted=fn,
+            )
+        )
+
+    def score_chunk(cat, num, mask):
+        run = aot if (aot is not None and cat.shape[0] == chunk_rows) else fn
+        probs, flags = run(variables, monitor, t, cat, num, mask)
+        return probs, flags
+
+    return score_chunk
+
+
+def make_bulk_jit(model, mesh: Mesh | None):
+    """The jitted (and, with a mesh, data-sharded) bulk chunk program —
+    the ONE jit site the compile cache warms (`compilecache/warmup.py
+    bulk_chunk_job`) and ``make_chunk_scorer`` dispatches."""
+    fused = make_bulk_fused(model)
     if mesh is None:
-        return _bind_vars(jax.jit(fused), variables)
+        return jax.jit(fused)
     data_in = batch_sharding(mesh)
     mask_in = batch_sharding(mesh, ndim=1)
-    fn = jax.jit(
+    rep = replicated(mesh)
+    return jax.jit(
         fused,
-        in_shardings=(replicated(mesh), data_in, data_in, mask_in),
+        in_shardings=(rep, rep, rep, data_in, data_in, mask_in),
         out_shardings=(batch_sharding(mesh, ndim=1), batch_sharding(mesh, ndim=1)),
     )
-    return _bind_vars(fn, variables)
 
 
-def make_bulk_fused(model, monitor, temperature: float):
+def make_bulk_fused(model):
     """The ONE fused bulk program — classifier probabilities + outlier
-    flags in a single dispatch — shared by ``make_chunk_scorer`` and the
-    tpulint Layer-2 registry (`analysis/entrypoints.py bulk-score-chunk`),
-    so the jaxpr the analyzer gates is the program production compiles."""
+    flags in a single dispatch — shared by ``make_chunk_scorer``, the
+    compile cache, and the tpulint Layer-2 registry
+    (`analysis/entrypoints.py bulk-score-chunk`), so the jaxpr the
+    analyzer gates is the program production compiles. Params, monitor
+    state, and temperature are ARGUMENTS (cacheable form: a closed-over
+    array would be baked into the serialized executable — see
+    `ops/predict.py make_padded_predict_base`)."""
 
-    def fused(variables, cat, num, mask):
+    def fused(variables, monitor, temperature, cat, num, mask):
         # cat ids travel as int8 (max vocab cardinality is 12; lossless)
         # and widen on device: host->device bandwidth is the bulk
         # bottleneck on remote-attached chips (~20 MB/s measured), and
@@ -182,14 +258,6 @@ def make_chunk_transfer(bundle: Bundle, mesh: Mesh | None):
     return place_sharded
 
 
-def _bind_vars(fn, variables):
-    def score_chunk(cat, num, mask):
-        probs, flags = fn(variables, cat, num, mask)
-        return probs, flags
-
-    return score_chunk
-
-
 def score_dataset(
     bundle: Bundle,
     ds: EncodedDataset,
@@ -199,6 +267,7 @@ def score_dataset(
     seed: int = 0,
     exact: bool | None = None,
     pipeline_depth: int = 2,
+    compile_cache=None,
 ) -> BulkScoreResult:
     """Stream ``ds`` through the chunk scorer; aggregate monitors.
 
@@ -228,9 +297,10 @@ def score_dataset(
             rows=0,
             elapsed_s=0.0,
         )
-    axis = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
-    chunk = max(axis, (chunk_rows // axis) * axis)
-    scorer = make_chunk_scorer(bundle, mesh, exact)
+    chunk = mesh_chunk_rows(chunk_rows, mesh)
+    scorer = make_chunk_scorer(
+        bundle, mesh, exact, compile_cache=compile_cache, chunk_rows=chunk
+    )
     transfer = make_chunk_transfer(bundle, mesh)
 
     predictions = np.empty(n, np.float32)
@@ -345,4 +415,7 @@ def score_dataset(
         elapsed_s=elapsed,
         path=path,
         pipeline=pipe.as_dict(),
+        compile_cache=(
+            compile_cache.stats() if compile_cache is not None else None
+        ),
     )

@@ -125,7 +125,11 @@ def make_pipeline(
         extra_varying=(batch_axis,) if batch_axis else (),
     )
     x_spec = P(None, batch_axis) if batch_axis else P()
-    return jax.jit(
+    # Not compile-cached: this is a GPipe TRAINING-layout building block
+    # (one compile per training run, amortized over thousands of steps),
+    # not a per-process serving entry point; the cached train entries are
+    # train-step-dense and train-step-tp (compilecache/registry.py).
+    return jax.jit(  # tpulint: disable=TPU203
         shard_map(
             body,
             mesh=mesh,

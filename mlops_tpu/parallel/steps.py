@@ -111,7 +111,10 @@ def make_sharded_batch_scorer(model, mesh: Mesh) -> Callable:
         logits = model.apply(variables, cat, num, train=False)
         return jax.nn.sigmoid(logits)
 
-    return jax.jit(
+    # Not compile-cached: the production bulk path is make_bulk_jit
+    # (parallel/bulk.py, entry ``bulk-score-chunk``); this probabilities-only
+    # scorer is the library/test surface and compiles once per process use.
+    return jax.jit(  # tpulint: disable=TPU203
         score,
         in_shardings=(replicated(mesh), data_in, data_in),
         out_shardings=batch_sharding(mesh, ndim=1),

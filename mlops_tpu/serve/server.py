@@ -30,6 +30,50 @@ from mlops_tpu.serve.metrics import ServingMetrics
 
 logger = logging.getLogger("mlops_tpu.serve")
 
+# Compact separators: the default ", "/": " pads every response body (and
+# both structured log events) with bytes pure of whitespace — on the c128
+# throughput path serialization is measurable hot-path CPU.
+def _dumps(payload) -> str:
+    return json.dumps(payload, separators=(",", ":"))
+
+
+class _LazyJson:
+    """Defer json.dumps of a log payload to %s-formatting time: the dumps
+    runs only when a handler actually emits the record, so a deployment
+    that filters (not just disables) INFO never pays per-request
+    serialization of full request/response bodies."""
+
+    __slots__ = ("_payload",)
+
+    def __init__(self, payload):
+        self._payload = payload
+
+    def __str__(self) -> str:
+        return _dumps(self._payload)
+
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            409: "Conflict", 413: "Payload Too Large",
+            422: "Unprocessable Entity", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+# (status, content_type) -> precomputed immutable head prefix. Statuses and
+# content types form a tiny closed set, so the f-string formatting + encode
+# of the static head runs once per pair instead of once per response.
+_HEAD_PREFIXES: dict[tuple[int, str], bytes] = {}
+_KEEP_ALIVE_TAIL = b"connection: keep-alive\r\n\r\n"
+_CLOSE_TAIL = b"connection: close\r\n\r\n"
+
+
+def _head_prefix(status: int, content_type: str) -> bytes:
+    prefix = _HEAD_PREFIXES.get((status, content_type))
+    if prefix is None:
+        reason = _REASONS.get(status, "OK")
+        prefix = _HEAD_PREFIXES[(status, content_type)] = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: {content_type}\r\n"
+        ).encode()
+    return prefix
+
 _DOCS_HTML = """<!doctype html>
 <html><head><title>{title}</title></head>
 <body style="font-family: sans-serif; max-width: 42rem; margin: 2rem auto">
@@ -162,13 +206,12 @@ class HttpServer:
                 try:
                     start = time.perf_counter()
                     request_id = self._request_id(headers)
+                    route_path = path.split("?", 1)[0]
                     status, payload, content_type = await self._route(
-                        method, path.split("?")[0], body, request_id
+                        method, route_path, body, request_id
                     )
                     latency_ms = (time.perf_counter() - start) * 1e3
-                    self.metrics.observe_request(
-                        path.split("?")[0], status, latency_ms
-                    )
+                    self.metrics.observe_request(route_path, status, latency_ms)
                     keep_alive = keep_alive and not self.draining
                     await self._write_response(
                         writer, status, payload, content_type, keep_alive,
@@ -214,24 +257,23 @@ class HttpServer:
         keep_alive: bool = True,
         request_id: str | None = None,
     ) -> None:
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  409: "Conflict", 413: "Payload Too Large",
-                  422: "Unprocessable Entity", 500: "Internal Server Error",
-                  503: "Service Unavailable"}.get(status, "OK")
         if isinstance(payload, (dict, list)):
-            body = json.dumps(payload).encode()
+            body = _dumps(payload).encode()
         elif isinstance(payload, str):
             body = payload.encode()
         else:
             body = payload
-        rid = f"x-request-id: {request_id}\r\n" if request_id else ""
-        head = (
-            f"HTTP/1.1 {status} {reason}\r\n"
-            f"content-type: {content_type}\r\n"
-            f"content-length: {len(body)}\r\n{rid}"
-            f"connection: {'keep-alive' if keep_alive else 'close'}\r\n\r\n"
-        )
-        writer.write(head.encode() + body)
+        # Static head parts are precomputed bytes (_head_prefix); only the
+        # per-response fields (length, request id) format here.
+        head = [
+            _head_prefix(status, content_type),
+            b"content-length: %d\r\n" % len(body),
+        ]
+        if request_id:
+            head.append(b"x-request-id: " + request_id.encode() + b"\r\n")
+        head.append(_KEEP_ALIVE_TAIL if keep_alive else _CLOSE_TAIL)
+        head.append(body)
+        writer.write(b"".join(head))
         await writer.drain()
 
     # -------------------------------------------------------------- routing
@@ -323,19 +365,21 @@ class HttpServer:
 
         request_id = request_id or uuid.uuid4().hex
         record_dicts = [r.model_dump() for r in records]
-        # isEnabledFor guards: the two-event monitoring contract serializes
-        # full payloads per request — skip the dumps work entirely when the
-        # deployment silences INFO (it is the request hot path).
+        # Two layers keep log formatting off the hot path: isEnabledFor
+        # skips everything when the deployment silences INFO, and _LazyJson
+        # defers the dumps of the full payload to record-emit time (a
+        # filtered/sampled handler never serializes at all).
         if logger.isEnabledFor(logging.INFO):
             logger.info(
-                json.dumps(
+                "%s",
+                _LazyJson(
                     {
                         "service_name": self.config.service_name,
                         "type": "InferenceData",
                         "request_id": request_id,
                         "data": record_dicts,
                     }
-                )
+                ),
             )
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
@@ -376,14 +420,15 @@ class HttpServer:
         self.metrics.observe_prediction(response)
         if logger.isEnabledFor(logging.INFO):
             logger.info(
-                json.dumps(
+                "%s",
+                _LazyJson(
                     {
                         "service_name": self.config.service_name,
                         "type": "ModelOutput",
                         "request_id": request_id,
                         "data": response,
                     }
-                )
+                ),
             )
         return 200, response, "application/json"
 
@@ -410,7 +455,13 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
     async def _warm() -> None:
         try:
             await loop.run_in_executor(None, engine.warmup)
-            logger.info("warmup complete; ready")
+            # warmup_stats carries the AOT compile-cache evidence: wall
+            # time, program count, and hit/miss/bypass counts with
+            # per-program compile vs deserialize seconds (engine.py).
+            logger.info(
+                "warmup complete; ready %s",
+                _LazyJson(getattr(engine, "warmup_stats", {})),
+            )
         # Compile failure/OOM: die loudly so the orchestrator restarts the
         # pod instead of a forever-503 zombie. Not swallowed — the error is
         # stored and re-raised by _serve after the server closes.

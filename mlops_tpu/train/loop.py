@@ -266,6 +266,7 @@ def fit(
     init_variables: Any | None = None,
     metrics_path: str | Path | None = None,
     checkpoint_dir: str | Path | None = None,
+    compile_cache=None,
 ) -> TrainResult:
     """Train ``model`` on an encoded dataset; resume from checkpoints if any."""
     from mlops_tpu.models import init_params
@@ -330,6 +331,22 @@ def fit(
             run_window = window_fns.get(window)
             if run_window is None:
                 run_window = make_train_window(model, optimizer, config, window)
+                if compile_cache is not None:
+                    # AOT-load the window scan through the persistent
+                    # executable cache (entry ``train-step-dense``): repeat
+                    # runs of a config deserialize instead of re-tracing +
+                    # re-XLA-compiling per process. On backends where the
+                    # state is donated and a cached donated executable
+                    # misbehaves, the cache layer's capability gate
+                    # bypass-compiles (compilecache/cache.py).
+                    from mlops_tpu.compilecache.warmup import train_window_job
+
+                    run_window = compile_cache.load_or_compile(
+                        train_window_job(
+                            model, optimizer, config, window,
+                            state, cat, num, lab, jitted=run_window,
+                        )
+                    )
                 window_fns[window] = run_window
             state, mean_loss = run_window(state, cat, num, lab)
             step = int(state.step)

@@ -235,6 +235,8 @@ def run_training(
         # Fine-tune from masked-feature pretraining (`pretrain` CLI):
         # trunk comes from the MLM run, heads stay freshly initialized.
         init_variables = _load_init_variables(config, model) or init_variables
+        from mlops_tpu.compilecache.cache import from_config
+
         result = fit(
             model,
             train_ds,
@@ -243,6 +245,9 @@ def run_training(
             init_variables=init_variables,
             metrics_path=run_dir / "metrics.jsonl",
             checkpoint_dir=run_dir / "checkpoints",
+            # cache.dir set -> the window scan deserializes from the
+            # persistent executable cache instead of recompiling per run.
+            compile_cache=from_config(config),
         )
         calibration_model = model
 

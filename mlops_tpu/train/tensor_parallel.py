@@ -63,6 +63,7 @@ def make_tp_trainer(
     config: Config,
     mesh=None,
     init_variables: Any | None = None,
+    compile_cache: Any | None = None,
 ) -> TPTrainer:
     """Build the DP×TP trainer for a ``model.tensor_parallel=K`` config.
 
@@ -133,6 +134,31 @@ def make_tp_trainer(
             else None
         ),
     )
+    if compile_cache is None:
+        from mlops_tpu.compilecache.cache import from_config
+
+        compile_cache = from_config(config)
+    if compile_cache is not None:
+        # AOT-load the pjit step through the persistent executable cache
+        # (entry ``train-step-tp``), keyed by mesh shape + state/batch
+        # signature; any OTHER batch shape falls back to the jitted step
+        # so the cached executable is never fed a novel signature. On
+        # backends where the donated state makes a deserialized executable
+        # unsafe, the cache layer bypass-compiles (compilecache/cache.py).
+        from mlops_tpu.compilecache.warmup import tp_step_job
+
+        batch = config.train.batch_size
+        aot_step = compile_cache.load_or_compile(
+            tp_step_job(
+                model, optimizer, config.train, mesh, state, batch, step_fn
+            )
+        )
+        jit_step = step_fn
+
+        def step_fn(state, cat, num, lab, rng):  # noqa: F811 - guarded swap
+            run = aot_step if cat.shape[0] == batch else jit_step
+            return run(state, cat, num, lab, rng)
+
     return TPTrainer(
         model=model, step_fn=step_fn, state=state, shardings=shardings,
         mesh=mesh,

@@ -1,0 +1,35 @@
+"""TPU203 fixture: jit sites under a serve/ (or parallel/) path that are
+not routed through the compile-cache entry-point registry. Never imported —
+analyzed only (tests/test_analysis.py). The directory name is the point:
+TPU203 keys on the serve/ path segment."""
+
+import jax
+
+
+@jax.jit
+def predict_probs(x):  # PLANT: TPU203
+    return x * 2.0
+
+
+def build_scorer(scale):
+    def score(x):
+        return x * scale
+
+    return jax.jit(score)  # PLANT: TPU203
+
+
+def make_chunk_scorer(scale):
+    # Whitelisted builder name (compilecache/registry.py
+    # CACHED_JIT_BUILDERS): its jit sites are wired through
+    # cache.load_or_compile, so no finding here.
+    def score(x):
+        return x + scale
+
+    return jax.jit(score)
+
+
+def build_suppressed(scale):
+    def score(x):
+        return x - scale
+
+    return jax.jit(score)  # tpulint: disable=TPU203
