@@ -57,13 +57,11 @@ def _run_analyze(args: argparse.Namespace) -> int:
         findings.extend(trace_findings)
 
     if getattr(args, "numeric", False):
-        from jax.experimental import checkify
-
-        from mlops_tpu.analysis.entrypoints import numeric_audit
+        from mlops_tpu.analysis.entrypoints import NumericAuditError, numeric_audit
 
         try:
             notes.extend(numeric_audit())
-        except checkify.JaxRuntimeError as err:
+        except NumericAuditError as err:
             from mlops_tpu.analysis.findings import Severity
 
             findings.append(
@@ -71,9 +69,9 @@ def _run_analyze(args: argparse.Namespace) -> int:
                     rule="TPU307",
                     name="numeric-audit-failure",
                     severity=Severity.ERROR,
-                    path="<numeric:serve-predict>",
+                    path=f"<numeric:{err.entry}>",
                     line=0,
-                    message=f"checkify float checks tripped: {err}",
+                    message=str(err),
                 )
             )
 

@@ -8,11 +8,13 @@ jaxpr the analyzer inspects is the program production compiles:
 - ``train-step-tp``      — `parallel/steps.py make_sharded_train_step`
   (the DP×TP pjit step); needs a multi-device mesh, skipped (loudly) on
   single-device hosts.
-- ``serve-predict``      — `ops/predict.py make_padded_predict_base` (the
-  serving hot path in its cacheable arguments form), traced at every
+- ``serve-predict-packed`` — `ops/predict.py make_packed_predict_base`
+  (the serving hot path in its packed single-buffer cacheable form: one
+  flat f32 output + the device monitor accumulator), traced at every
   warmup bucket the engine compiles.
-- ``serve-predict-group``— `ops/predict.py make_grouped_predict_base` (the
-  micro-batcher's vmapped dispatch), traced across slot buckets.
+- ``serve-predict-group-packed`` — `ops/predict.py
+  make_packed_grouped_base` (the micro-batcher's packed vmapped
+  dispatch), traced across slot buckets.
 - ``bulk-score-chunk``   — `parallel/bulk.py make_bulk_fused` (the fused
   chunk program the pipelined bulk/stream scorers dispatch per chunk),
   traced at two chunk sizes with the production int8 categorical ids.
@@ -145,27 +147,36 @@ def _build_train_step_tp():
     return step_fn, {64: args(64), 128: args(128)}
 
 
+def _abstract_accumulator():
+    # Shared with the compile-cache warmup: the same abstract accumulator
+    # produces the same cache keys (monitor/state.py).
+    from mlops_tpu.monitor.state import abstract_accumulator
+
+    return abstract_accumulator()
+
+
 def _build_serve_predict():
     import jax
     import jax.numpy as jnp
 
     from mlops_tpu.config import ServeConfig
     from mlops_tpu.models import build_model
-    from mlops_tpu.ops.predict import make_padded_predict_base
+    from mlops_tpu.ops.predict import make_packed_predict_base
 
     model = build_model(_tiny_model_config())
     variables = _abstract_variables(model)
     monitor = _abstract_monitor()
-    # The CACHEABLE program form (params/monitor/temperature as arguments
-    # — see ops/predict.py make_padded_predict_base): the jaxpr traced
-    # here is byte-for-byte the program the compile cache persists.
-    entry = make_padded_predict_base(model)
+    # The CACHEABLE packed program form (params/monitor/accumulator/
+    # temperature as arguments — see ops/predict.py
+    # make_packed_predict_base): the jaxpr traced here is byte-for-byte
+    # the program the compile cache persists.
+    entry = make_packed_predict_base(model)
 
     def args(bucket: int):
         cat, num = _schema_batch(bucket)
         mask = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
         temp = jax.ShapeDtypeStruct((), jnp.float32)
-        return (variables, monitor, temp, cat, num, mask)
+        return (variables, monitor, _abstract_accumulator(), temp, cat, num, mask)
 
     # Trace at every bucket the engine warms: the padded-bucket serving
     # contract ("zero steady-state recompiles") is exactly TPU304.
@@ -178,14 +189,14 @@ def _build_serve_predict_group():
     import jax.numpy as jnp
 
     from mlops_tpu.models import build_model
-    from mlops_tpu.ops.predict import make_grouped_predict_base
+    from mlops_tpu.ops.predict import make_packed_grouped_base
     from mlops_tpu.schema import SCHEMA
     from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
 
     model = build_model(_tiny_model_config())
     variables = _abstract_variables(model)
     monitor = _abstract_monitor()
-    entry = make_grouped_predict_base(model)
+    entry = make_packed_grouped_base(model)
 
     S = jax.ShapeDtypeStruct
 
@@ -194,6 +205,7 @@ def _build_serve_predict_group():
         return (
             variables,
             monitor,
+            _abstract_accumulator(),
             S((), jnp.float32),
             S((slots, rows, SCHEMA.num_categorical), jnp.int32),
             S((slots, rows, SCHEMA.num_numeric), jnp.float32),
@@ -255,7 +267,7 @@ def registered_entry_points() -> list[EntryPoint]:
             params_out_spec=None,
         ),
         EntryPoint(
-            name="serve-predict",
+            name="serve-predict-packed",
             build=_build_serve_predict,
             # The engine loads bundle params replicated on the serving chip.
             params_in_spec=None,
@@ -267,7 +279,7 @@ def registered_entry_points() -> list[EntryPoint]:
             bucket_families=((1, 8, 64), (256,)),
         ),
         EntryPoint(
-            name="serve-predict-group",
+            name="serve-predict-group-packed",
             build=_build_serve_predict_group,
             params_in_spec=None,
         ),
@@ -282,27 +294,56 @@ def registered_entry_points() -> list[EntryPoint]:
 
 # Packaged-params handoffs the sharding check guards (TPU305).
 LINKS = [
-    ShardingLink("train-step-dense", "serve-predict"),
-    ShardingLink("train-step-tp", "serve-predict", transport="merge-to-dense"),
+    ShardingLink("train-step-dense", "serve-predict-packed"),
+    ShardingLink(
+        "train-step-tp", "serve-predict-packed", transport="merge-to-dense"
+    ),
 ]
 
 
-def numeric_audit() -> list[str]:
-    """Opt-in one-shot numeric audit (``analyze --numeric``): run the serve
-    predict through `utils/debug.py checked()` — checkify float checks — on
-    a tiny CONCRETE synthetic batch. This executes on the current backend
-    (CPU under JAX_PLATFORMS=cpu), so it is not part of the abstract gate.
+class NumericAuditError(Exception):
+    """A numeric-audit failure tagged with the entry point that tripped.
+    `analysis/cli.py` turns this into the TPU307 finding; a raw
+    ``checkify.JaxRuntimeError`` (or AssertionError) escaping instead
+    would crash the analyzer with exit 2 rather than gate with exit 1."""
 
-    Returns human-readable result lines; raises
-    ``checkify.JaxRuntimeError`` if a NaN/Inf escapes the fused predict.
+    def __init__(self, entry: str, detail: str):
+        self.entry = entry
+        super().__init__(detail)
+
+
+def numeric_audit() -> list[str]:
+    """Opt-in one-shot numeric audit (``analyze --numeric``): run the
+    PACKED serve programs — the production hot path, accumulator fold
+    included — through `utils/debug.py checked()` (checkify float checks)
+    on tiny CONCRETE synthetic batches. This executes on the current
+    backend (CPU under JAX_PLATFORMS=cpu), so it is not part of the
+    abstract gate.
+
+    The solo form runs with PADDING rows (the serving reality: requests
+    pad up to their bucket); the grouped form runs full slots — a padding
+    SLOT computes drift over zero rows, where the chi-squared path yields
+    NaN by construction before the fold selects it away
+    (`monitor/state.py fold_accumulator_grouped`), and checkify flags NaN
+    at the op that produces it regardless of later masking, so that case
+    is pinned by value in `tests/test_packed_parity.py` instead.
+
+    Returns human-readable result lines; raises ``NumericAuditError``
+    (naming the entry that tripped) if a NaN/Inf escapes the fused
+    predict or the accumulator leaves the audit non-finite.
     """
     import jax
     import numpy as np
+    from jax.experimental import checkify
 
     from mlops_tpu.data import Preprocessor, generate_synthetic
     from mlops_tpu.models import build_model, init_params
-    from mlops_tpu.monitor.state import fit_monitor
-    from mlops_tpu.ops.predict import make_padded_predict_fn
+    from mlops_tpu.monitor.state import fit_monitor, init_accumulator
+    from mlops_tpu.ops.predict import (
+        make_packed_grouped_base,
+        make_packed_predict_base,
+        packed_layout,
+    )
     from mlops_tpu.utils.debug import checked
 
     columns, labels = generate_synthetic(512, seed=0)
@@ -311,16 +352,58 @@ def numeric_audit() -> list[str]:
     model = build_model(_tiny_model_config())
     variables = init_params(model, jax.random.PRNGKey(0))
     monitor = fit_monitor(ds)
-    predict = make_padded_predict_fn(model, variables, monitor)
-    audited = checked(predict, jit=True)
-    batch = 8
-    out = audited(
-        ds.cat_ids[:batch],
-        ds.numeric[:batch].astype(np.float32),
-        np.ones((batch,), bool),
-    )
-    preds = np.asarray(out["predictions"])
+    temp = np.float32(1.0)
+
+    bucket, valid = 8, 5  # padding rows exercise the masked drift path
+    solo = checked(make_packed_predict_base(model), jit=True)
+    try:
+        packed, acc = solo(
+            variables,
+            monitor,
+            init_accumulator(),
+            temp,
+            ds.cat_ids[:bucket],
+            ds.numeric[:bucket].astype(np.float32),
+            np.arange(bucket) < valid,
+        )
+    except checkify.JaxRuntimeError as err:
+        raise NumericAuditError(
+            "serve-predict-packed", f"checkify float checks tripped: {err}"
+        ) from err
+    p, _, _ = packed_layout(bucket)
+    preds = np.asarray(packed)[p][:valid]
+
+    slots, rows = 2, 1  # full slots: every slot folds real drift
+    grouped = checked(make_packed_grouped_base(model), jit=True)
+    try:
+        _, acc = grouped(
+            variables,
+            monitor,
+            acc,
+            temp,
+            ds.cat_ids[: slots * rows].reshape(slots, rows, -1),
+            ds.numeric[: slots * rows]
+            .astype(np.float32)
+            .reshape(slots, rows, -1),
+            np.ones((slots, rows), bool),
+        )
+    except checkify.JaxRuntimeError as err:
+        raise NumericAuditError(
+            "serve-predict-group-packed",
+            f"checkify float checks tripped: {err}",
+        ) from err
+    if not all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree_util.tree_leaves(acc)
+    ):
+        raise NumericAuditError(
+            "serve-predict-group-packed",
+            "monitor accumulator left the numeric audit non-finite",
+        )
     return [
-        f"numeric audit: serve-predict x{batch} rows under checkify "
-        f"float_checks — clean (p50 prediction {float(np.median(preds)):.4f})"
+        f"numeric audit: serve-predict-packed {valid}/{bucket} padded rows "
+        f"under checkify float_checks — clean "
+        f"(p50 prediction {float(np.median(preds)):.4f})",
+        f"numeric audit: serve-predict-group-packed {slots}x{rows} slots + "
+        "accumulator fold — clean (aggregate finite)",
     ]

@@ -23,8 +23,15 @@ from __future__ import annotations
 CACHE_ENTRY_IDS: tuple[str, ...] = (
     "train-step-dense",
     "train-step-tp",
-    "serve-predict",
-    "serve-predict-group",
+    # PR 4 replaced the dict-output serve programs ("serve-predict" /
+    # "serve-predict-group") with the packed single-buffer forms
+    # (`ops/predict.py make_packed_predict_base` / `make_packed_grouped_base`):
+    # one contiguous f32 D2H buffer per request plus the device-resident
+    # monitor accumulator. New entry ids, so stale dict-form artifacts can
+    # never be probed, and the warmers/registry/tpulint lockstep moves as
+    # one.
+    "serve-predict-packed",
+    "serve-predict-group-packed",
     "bulk-score-chunk",
 )
 

@@ -73,6 +73,20 @@ def _temp_aval():
 
 
 # ----------------------------------------------------------- serve entries
+def _acc_aval():
+    from mlops_tpu.monitor.state import abstract_accumulator
+
+    return tree_avals(abstract_accumulator())
+
+
+def _acc_zeros():
+    import jax
+
+    from mlops_tpu.monitor.state import init_accumulator
+
+    return jax.device_get(init_accumulator())
+
+
 def serve_predict_jobs(
     model,
     model_config,
@@ -81,36 +95,44 @@ def serve_predict_jobs(
     buckets: tuple[int, ...],
     temperature: float = 1.0,
 ) -> list[CacheJob]:
-    """One job per warmup bucket of the padded serving predict
-    (entry ``serve-predict``). ``variables``/``monitor`` may be concrete
-    (the engine: jobs also execute once to pay first-dispatch allocation)
-    or ShapeDtypeStruct trees (the warmup CLI: compile+persist only)."""
+    """One job per warmup bucket of the PACKED serving predict (entry
+    ``serve-predict-packed``: one flat f32 output buffer + the device
+    monitor accumulator threaded as the gated-donation argument —
+    `ops/predict.py make_packed_predict_base`). ``variables``/``monitor``
+    may be concrete (the engine: jobs also execute once to pay
+    first-dispatch allocation) or ShapeDtypeStruct trees (the warmup CLI:
+    compile+persist only)."""
     import jax
     import numpy as np
 
-    from mlops_tpu.ops.predict import make_padded_predict_base
+    from mlops_tpu.ops.predict import _acc_donation, make_packed_predict_base
 
     var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
     concrete = _is_concrete(variables)
     config_hash = model_fingerprint(model_config)
+    donate = _acc_donation()
     jobs = []
     for bucket in buckets:
         jobs.append(
             CacheJob(
-                entry_id="serve-predict",
+                entry_id="serve-predict-packed",
                 # A fresh jit per job: AOT lowering never reuses the jit
                 # dispatch cache, and per-job objects keep the thread pool
                 # free of shared mutable state.
-                jitted=jax.jit(make_padded_predict_base(model)),
+                jitted=jax.jit(
+                    make_packed_predict_base(model), donate_argnums=donate
+                ),
                 abstract_args=(
-                    var_avals, mon_avals, _temp_aval(), *_schema_avals((bucket,))
+                    var_avals, mon_avals, _acc_aval(), _temp_aval(),
+                    *_schema_avals((bucket,)),
                 ),
                 config_hash=config_hash,
-                label=f"serve-predict/b{bucket}",
+                donated=bool(donate),
+                label=f"serve-predict-packed/b{bucket}",
                 meta={"bucket": bucket},
                 execute_args=(
-                    (variables, monitor, np.float32(temperature),
-                     *_schema_zeros((bucket,)))
+                    (variables, monitor, _acc_zeros(),
+                     np.float32(temperature), *_schema_zeros((bucket,)))
                     if concrete
                     else None
                 ),
@@ -127,32 +149,36 @@ def serve_group_jobs(
     grid: list[tuple[int, int]],
     temperature: float = 1.0,
 ) -> list[CacheJob]:
-    """One job per (slots, rows) shape of the micro-batcher's vmapped
-    dispatch (entry ``serve-predict-group``)."""
+    """One job per (slots, rows) shape of the micro-batcher's PACKED
+    vmapped dispatch (entry ``serve-predict-group-packed``)."""
     import jax
     import numpy as np
 
-    from mlops_tpu.ops.predict import make_grouped_predict_base
+    from mlops_tpu.ops.predict import _acc_donation, make_packed_grouped_base
 
     var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
     concrete = _is_concrete(variables)
     config_hash = model_fingerprint(model_config)
+    donate = _acc_donation()
     jobs = []
     for slots, rows in grid:
         jobs.append(
             CacheJob(
-                entry_id="serve-predict-group",
-                jitted=jax.jit(make_grouped_predict_base(model)),
+                entry_id="serve-predict-group-packed",
+                jitted=jax.jit(
+                    make_packed_grouped_base(model), donate_argnums=donate
+                ),
                 abstract_args=(
-                    var_avals, mon_avals, _temp_aval(),
+                    var_avals, mon_avals, _acc_aval(), _temp_aval(),
                     *_schema_avals((slots, rows)),
                 ),
                 config_hash=config_hash,
-                label=f"serve-predict-group/g{slots}x{rows}",
+                donated=bool(donate),
+                label=f"serve-predict-group-packed/g{slots}x{rows}",
                 meta={"slots": slots, "rows": rows},
                 execute_args=(
-                    (variables, monitor, np.float32(temperature),
-                     *_schema_zeros((slots, rows)))
+                    (variables, monitor, _acc_zeros(),
+                     np.float32(temperature), *_schema_zeros((slots, rows)))
                     if concrete
                     else None
                 ),
@@ -480,8 +506,8 @@ def _warm_train_tp(config, bundle) -> list[CacheJob]:
 
 
 _WARMERS: dict[str, Callable] = {
-    "serve-predict": _warm_serve_predict,
-    "serve-predict-group": _warm_serve_group,
+    "serve-predict-packed": _warm_serve_predict,
+    "serve-predict-group-packed": _warm_serve_group,
     "bulk-score-chunk": _warm_bulk,
     "train-step-dense": _warm_train_dense,
     "train-step-tp": _warm_train_tp,

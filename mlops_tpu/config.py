@@ -191,6 +191,16 @@ class ServeConfig:
     max_workers: int = 8  # predict thread pool size; >= max_inflight so
     # every overlapped dispatch gets a thread, with headroom for the
     # batcher's solo fast-path and bulk scoring
+    monitor_fetch_every_s: float = 2.0  # telemetry cadence for the
+    # device-resident monitor aggregate (serve/engine.py
+    # monitor_snapshot): the request path never fetches it; a background
+    # task reads it at most this often when traffic is flowing. 0
+    # disables the timer (the K-request trigger and /metrics scrapes
+    # still fetch). Staleness bound: gauges lag live traffic by at most
+    # max(monitor_fetch_every_s, monitor_fetch_every_requests requests)
+    # — /metrics scrapes always read fresh (docs/operations.md)
+    monitor_fetch_every_requests: int = 512  # also fetch after this many
+    # predict requests since the last fetch; 0 disables the K-trigger
     request_timeout_s: float = 30.0  # per-request deadline on the predict
     # path: a stalled device (observed live: a remote-attached chip's
     # tunnel hanging dispatches for 40+ min) 503s requests fast instead

@@ -10,10 +10,25 @@ classifier, and the response contract is identical: per-feature drift scores
 """
 
 from mlops_tpu.monitor.state import (
+    MonitorAccumulator,
     MonitorState,
     drift_scores,
     fit_monitor,
+    fold_accumulator,
+    fold_accumulator_grouped,
+    init_accumulator,
+    merge_accumulators,
     outlier_flags,
 )
 
-__all__ = ["MonitorState", "drift_scores", "fit_monitor", "outlier_flags"]
+__all__ = [
+    "MonitorAccumulator",
+    "MonitorState",
+    "drift_scores",
+    "fit_monitor",
+    "fold_accumulator",
+    "fold_accumulator_grouped",
+    "init_accumulator",
+    "merge_accumulators",
+    "outlier_flags",
+]

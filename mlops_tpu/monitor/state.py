@@ -148,6 +148,141 @@ def fit_monitor(
     )
 
 
+class MonitorAccumulator(struct.PyTreeNode):
+    """Device-resident running aggregate of the serving monitors.
+
+    The seed path derived /metrics totals on the HOST from every response
+    (sum the outlier flags, copy the drift dict — per request, on the hot
+    path). Here the aggregate lives on the device and is folded INSIDE the
+    fused predict program (`ops/predict.py make_packed_*`): the request
+    path never fetches it, a telemetry task reads it every K requests /
+    T seconds (`serve/server.py`). All leaves are f32 so the whole state
+    rides one tiny D2H transfer — and each read RESETS the device window
+    (`serve/engine.py monitor_snapshot` folds it into exact host-side f64
+    totals), so the f32 counters never approach 2^24, where integer
+    increments would silently stop.
+
+    - ``rows``      f32 []:  valid (non-padding) rows scored
+    - ``outliers``  f32 []:  outlier flags raised
+    - ``batches``   f32 []:  dispatches folded (grouped slots count one
+      per non-empty request slot)
+    - ``drift_sum`` f32 [D]: per-feature sum of batch drift scores (mean
+      drift = drift_sum / batches)
+    - ``drift_last``f32 [D]: drift of the most recently folded dispatch
+      (grouped dispatches fold the mean over their non-empty slots)
+    """
+
+    rows: jnp.ndarray
+    outliers: jnp.ndarray
+    batches: jnp.ndarray
+    drift_sum: jnp.ndarray
+    drift_last: jnp.ndarray
+
+
+def init_accumulator() -> MonitorAccumulator:
+    # DISTINCT arrays per leaf (never alias one zeros scalar): the engine
+    # threads the accumulator as a donated argument where the backend
+    # allows, and donating one buffer under two leaves is an XLA error
+    # ("attempt to donate the same buffer twice").
+    d = SCHEMA.num_categorical + SCHEMA.num_numeric
+    return MonitorAccumulator(
+        rows=jnp.zeros((), jnp.float32),
+        outliers=jnp.zeros((), jnp.float32),
+        batches=jnp.zeros((), jnp.float32),
+        drift_sum=jnp.zeros((d,), jnp.float32),
+        drift_last=jnp.zeros((d,), jnp.float32),
+    )
+
+
+def abstract_accumulator() -> MonitorAccumulator:
+    """Shape-only accumulator (ShapeDtypeStruct leaves) — the tracing /
+    AOT-cache-key twin of ``init_accumulator`` (same role as
+    ``abstract_monitor_state``): shapes depend only on the schema."""
+    d = SCHEMA.num_categorical + SCHEMA.num_numeric
+    S = jax.ShapeDtypeStruct
+    return MonitorAccumulator(
+        rows=S((), jnp.float32),
+        outliers=S((), jnp.float32),
+        batches=S((), jnp.float32),
+        drift_sum=S((d,), jnp.float32),
+        drift_last=S((d,), jnp.float32),
+    )
+
+
+def fold_accumulator(
+    acc: MonitorAccumulator,
+    flags: jnp.ndarray,
+    drift: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> MonitorAccumulator:
+    """Fold one padded batch into the running aggregate (jittable; called
+    inside the fused predict). ``flags`` are already mask-zeroed
+    (`outlier_flags`); an all-padding batch contributes nothing — not even
+    to ``drift_last`` (an empty batch has no drift signal, the same
+    invariant the engine's empty-request path keeps)."""
+    n_valid = mask.astype(jnp.float32).sum()
+    nonempty = (n_valid > 0).astype(jnp.float32)
+    # Select, don't multiply: drift over ZERO valid rows can be NaN (the
+    # chi-squared path divides by the row count) and NaN * 0 is still
+    # NaN — a multiplicative mask would poison the running sum forever.
+    safe_drift = jnp.where(nonempty > 0, drift, jnp.zeros_like(drift))
+    return MonitorAccumulator(
+        rows=acc.rows + n_valid,
+        outliers=acc.outliers + flags.sum(),
+        batches=acc.batches + nonempty,
+        drift_sum=acc.drift_sum + safe_drift,
+        drift_last=jnp.where(nonempty > 0, drift, acc.drift_last),
+    )
+
+
+def fold_accumulator_grouped(
+    acc: MonitorAccumulator,
+    flags: jnp.ndarray,
+    drift: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> MonitorAccumulator:
+    """Grouped-dispatch fold: ``flags``/``mask`` are [S, R], ``drift`` is
+    [S, D]. Padding SLOTS (mask all-false) are excluded everywhere; each
+    non-empty slot counts as one batch and ``drift_last`` takes the mean
+    drift over this dispatch's non-empty slots."""
+    slot_rows = mask.astype(jnp.float32).sum(axis=1)  # [S]
+    slot_valid = (slot_rows > 0).astype(jnp.float32)
+    n_slots = slot_valid.sum()
+    # Select, don't multiply: PADDING slots compute drift over zero rows,
+    # where the chi-squared path divides by zero and yields NaN — and
+    # NaN * 0 is still NaN, so a multiplicative mask would poison
+    # drift_sum (and mean_drift) forever.
+    safe_drift = jnp.where(slot_valid[:, None] > 0, drift, 0.0)
+    drift_total = safe_drift.sum(axis=0)
+    mean_drift = drift_total / jnp.maximum(n_slots, 1.0)
+    return MonitorAccumulator(
+        rows=acc.rows + slot_rows.sum(),
+        outliers=acc.outliers + flags.sum(),
+        batches=acc.batches + n_slots,
+        drift_sum=acc.drift_sum + drift_total,
+        drift_last=jnp.where(n_slots > 0, mean_drift, acc.drift_last),
+    )
+
+
+def merge_accumulators(
+    older: MonitorAccumulator, newer: MonitorAccumulator
+) -> MonitorAccumulator:
+    """Combine two accumulator windows: counters and sums add;
+    ``drift_last`` takes the newer window's unless it folded no batches.
+    Used by `serve/engine.py monitor_snapshot` to fold an un-fetched
+    window back into the live accumulator when a telemetry fetch fails —
+    a transient device error must DELAY the counts, not drop them."""
+    return MonitorAccumulator(
+        rows=older.rows + newer.rows,
+        outliers=older.outliers + newer.outliers,
+        batches=older.batches + newer.batches,
+        drift_sum=older.drift_sum + newer.drift_sum,
+        drift_last=jnp.where(
+            newer.batches > 0, newer.drift_last, older.drift_last
+        ),
+    )
+
+
 def drift_scores(
     state: MonitorState,
     cat_ids: jnp.ndarray,

@@ -17,7 +17,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mlops_tpu.monitor.state import MonitorState, drift_scores, outlier_flags
+from mlops_tpu.monitor.state import (
+    MonitorAccumulator,
+    MonitorState,
+    drift_scores,
+    fold_accumulator,
+    fold_accumulator_grouped,
+    outlier_flags,
+)
 from mlops_tpu.train.calibrate import apply_temperature
 
 
@@ -98,6 +105,106 @@ def make_grouped_predict_base(model) -> Callable:
         )
 
     return grouped
+
+
+def make_packed_predict_base(model) -> Callable:
+    """The serving hot path's ZERO-WASTE form: one contiguous f32 output
+    buffer plus the device-resident monitor aggregate.
+
+    The dict form (`make_padded_predict_base`) returns a 3-leaf pytree, so
+    every request pays THREE device->host transfers (on a remote-attached
+    chip each is a full ~70-90 ms tunnel round trip — `serve/engine.py`).
+    Here the program emits a single ``f32[2*B + D]`` vector laid out as
+
+        [0 : B]        predictions  (P(default) per padded row)
+        [B : 2B]       outlier flags (0/1, mask-zeroed)
+        [2B : 2B + D]  per-batch drift scores in schema order
+
+    sliced host-side by `packed_layout`, so the whole response is ONE D2H
+    buffer — and the running monitor aggregate (`MonitorAccumulator`) is
+    folded in the same fused program and STAYS on the device (the second
+    output; the engine threads it through as a donated argument where the
+    backend's donation gate allows). Same cacheable argument discipline as
+    the dict form: everything beyond the architecture is an ARGUMENT.
+
+    Numerics are bit-identical to the dict form: the three sub-programs
+    are unchanged, the concatenation is layout only (pinned by the packed
+    parity test)."""
+
+    def predict(
+        variables: Any,
+        monitor: MonitorState,
+        acc: MonitorAccumulator,
+        temperature: jnp.ndarray,
+        cat_ids: jnp.ndarray,
+        numeric: jnp.ndarray,
+        mask: jnp.ndarray,
+    ):
+        logits = model.apply(variables, cat_ids, numeric, train=False)
+        flags = outlier_flags(monitor, numeric, mask)
+        drift = drift_scores(monitor, cat_ids, numeric, mask)
+        packed = jnp.concatenate(
+            [jax.nn.sigmoid(logits / temperature), flags, drift]
+        )
+        return packed, fold_accumulator(acc, flags, drift, mask)
+
+    return predict
+
+
+def make_packed_grouped_base(model) -> Callable:
+    """Packed form of the micro-batcher's vmapped program: ``f32[S, 2R+D]``
+    (each slot's predictions ‖ outliers ‖ drift), monitor aggregate folded
+    across the group's non-empty slots outside the vmap. Per-request drift
+    stays computed over each request's OWN rows, exactly as the dict form."""
+
+    def single(variables, monitor, temperature, cat_ids, numeric, mask):
+        logits = model.apply(variables, cat_ids, numeric, train=False)
+        return (
+            jax.nn.sigmoid(logits / temperature),
+            outlier_flags(monitor, numeric, mask),
+            drift_scores(monitor, cat_ids, numeric, mask),
+        )
+
+    def grouped(
+        variables: Any,
+        monitor: MonitorState,
+        acc: MonitorAccumulator,
+        temperature: jnp.ndarray,
+        cat_ids: jnp.ndarray,
+        numeric: jnp.ndarray,
+        mask: jnp.ndarray,
+    ):
+        preds, flags, drift = jax.vmap(
+            single, in_axes=(None, None, None, 0, 0, 0)
+        )(variables, monitor, temperature, cat_ids, numeric, mask)
+        packed = jnp.concatenate([preds, flags, drift], axis=1)
+        return packed, fold_accumulator_grouped(acc, flags, drift, mask)
+
+    return grouped
+
+
+def packed_layout(rows: int) -> tuple[slice, slice, slice]:
+    """(predictions, outliers, drift) slices of a packed row vector of
+    ``rows`` padded rows — the ONE definition of the buffer layout shared
+    by the engine's host-side unpack and the tests."""
+    from mlops_tpu.schema import SCHEMA
+
+    d = SCHEMA.num_categorical + SCHEMA.num_numeric
+    return (
+        slice(0, rows),
+        slice(rows, 2 * rows),
+        slice(2 * rows, 2 * rows + d),
+    )
+
+
+def _acc_donation():
+    """Donation argnums for the packed programs' accumulator argument
+    (position 2), gated by the backend capability check in
+    `parallel/compat.py` (jaxlib 0.4.x CPU executes donated cached
+    executables incorrectly — PR 1/PR 3)."""
+    from mlops_tpu.parallel.compat import donation_argnums
+
+    return donation_argnums(2)
 
 
 def _bind_serving_args(base: Callable, variables, monitor, temperature):

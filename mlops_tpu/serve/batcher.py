@@ -36,7 +36,13 @@ class MicroBatcher:
     measured), and round trips from separate threads overlap, so serial
     dispatches would cap throughput at one group per round trip.
     ``max_inflight`` bounds the overlap (it must not exceed the engine
-    thread pool, or dispatches would queue inside the executor anyway)."""
+    thread pool, or dispatches would queue inside the executor anyway).
+
+    With the packed two-phase engine API (dispatch_group / fetch_group)
+    each dispatch task additionally splits into a dispatch phase (encode +
+    device enqueue + async D2H copy start, under the inflight bound) and a
+    fetch phase (the blocking host-copy wait, under the fetch ring) — the
+    drain loop dispatches group N+1 while group N's bytes land."""
 
     def __init__(
         self,
@@ -45,6 +51,7 @@ class MicroBatcher:
         window_ms: float = 1.0,
         max_group: int = GROUP_SLOT_BUCKETS[-1],
         max_inflight: int = 4,
+        fetch_inflight: int | None = None,
     ):
         self.engine = engine
         self._executor = executor
@@ -56,6 +63,19 @@ class MicroBatcher:
         self._drain_task: asyncio.Task | None = None
         self._full = asyncio.Event()  # set when a full group is waiting
         self._inflight = asyncio.Semaphore(max_inflight)
+        # Fetch ring: engines exposing the two-phase dispatch_group /
+        # fetch_group API (serve/engine.py) release their DISPATCH slot as
+        # soon as the device work + async D2H copy are in flight, then
+        # complete the blocking fetch under this SECOND bound — so the
+        # drain loop claims and dispatches the next group while the
+        # previous group's host copy lands. The two bounds together can
+        # occupy dispatch + fetch executor threads at once; callers that
+        # share the executor with other work (the server's solo fast path,
+        # /metrics monitor fetches) size ``fetch_inflight`` so the sum
+        # leaves headroom (serve/server.py) — default: max_inflight.
+        self._fetch_ring = asyncio.Semaphore(
+            max_inflight if fetch_inflight is None else max(1, fetch_inflight)
+        )
         self._dispatch_tasks: set[asyncio.Task] = set()  # strong refs
         self._last_enqueue = float("-inf")  # loop-clock time of the most
         # recent coalescable arrival (idle fast-path bookkeeping)
@@ -168,10 +188,38 @@ class MicroBatcher:
     ) -> None:
         loop = asyncio.get_running_loop()
         requests = [records for records, _ in batch]
+        # Two-phase path when the engine supports it: dispatch (encode +
+        # device enqueue + async D2H start) holds the inflight slot, the
+        # blocking fetch rides the fetch ring — overlapping the next
+        # group's dispatch with this group's host copy. The handle is
+        # local to this task, so responses can never cross-wire between
+        # overlapped groups (each task owns exactly its batch's futures).
+        dispatch = getattr(self.engine, "dispatch_group", None)
+        fetch = getattr(self.engine, "fetch_group", None)
+        released = False
         try:
-            responses = await loop.run_in_executor(
-                self._executor, self.engine.predict_group, requests
-            )
+            if dispatch is None or fetch is None:
+                responses = await loop.run_in_executor(
+                    self._executor, self.engine.predict_group, requests
+                )
+            else:
+                handle = await loop.run_in_executor(
+                    self._executor, dispatch, requests
+                )
+                # Claim the fetch ring BEFORE releasing the dispatch slot:
+                # released first, a lagging fetch path would let the drain
+                # loop keep dispatching while handles (each pinning live
+                # device buffers) pile up un-purgeably at the ring — this
+                # order hard-bounds dispatched-but-unfetched groups at
+                # max_inflight + fetch_inflight. No deadlock: ring permits
+                # free on fetch completion, which never needs a dispatch
+                # slot.
+                async with self._fetch_ring:
+                    self._inflight.release()
+                    released = True
+                    responses = await loop.run_in_executor(
+                        self._executor, fetch, handle
+                    )
         # Not swallowed: whatever the dispatch raised (device error,
         # encode bug) is re-routed onto every waiter's future, where the
         # request handler surfaces it as a 500.
@@ -184,4 +232,5 @@ class MicroBatcher:
                 if not future.done():
                     future.set_result(response)
         finally:
-            self._inflight.release()
+            if not released:
+                self._inflight.release()

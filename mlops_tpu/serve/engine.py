@@ -10,11 +10,18 @@ TPU serving mechanics (SURVEY.md SS7 "hard parts" — batch-1 latency):
 - host work is minimal: string->id lookups and one float array build per
   request; everything else (classifier + monitors) is a single device
   dispatch.
+- the device->host surface is ONE packed f32 buffer per request
+  (predictions ‖ outlier flags ‖ drift — `ops/predict.py
+  make_packed_predict_base`), its host copy started asynchronously at
+  dispatch time, and the running monitor aggregate stays ON DEVICE
+  (`monitor/state.py MonitorAccumulator`), read off the request path by
+  `monitor_snapshot`.
 """
 
 from __future__ import annotations
 
 import bisect
+import threading
 from typing import Any
 
 import jax
@@ -22,11 +29,61 @@ import numpy as np
 
 from mlops_tpu.bundle.bundle import Bundle
 from mlops_tpu.ops.predict import (
-    make_grouped_predict_fn,
+    _acc_donation,
     make_hybrid_predict_fn,
-    make_padded_predict_fn,
+    make_packed_grouped_base,
+    make_packed_predict_base,
+    packed_layout,
 )
 from mlops_tpu.schema import SCHEMA, records_to_columns
+
+
+def _start_copy(tree: Any) -> None:
+    """Begin the device->host copy of every array in ``tree`` WITHOUT
+    blocking (``copy_to_host_async`` where the backend provides it): by
+    the time the response path blocks in ``np.asarray`` the bytes are
+    already moving — on a remote-attached chip this overlaps the transfer
+    round trip with the host-side Python between dispatch and fetch."""
+
+    def one(x):
+        try:
+            x.copy_to_host_async()
+        except AttributeError:
+            pass
+
+    jax.tree_util.tree_map(one, tree)
+
+
+class _ArraysHandle:
+    """In-flight padded dispatch: the device output plus everything the
+    fetch side needs to slice the packed buffer back into the response."""
+
+    __slots__ = ("out", "n", "rows", "packed")
+
+    def __init__(self, out: Any, n: int, rows: int, packed: bool):
+        self.out = out
+        self.n = n
+        self.rows = rows  # padded row count (bucket, or n at exact shape)
+        self.packed = packed
+
+    def start_copy(self) -> None:
+        _start_copy(self.out)
+
+
+class _GroupHandle:
+    """In-flight grouped dispatch (or the degenerate solo-path result)."""
+
+    __slots__ = ("out", "sizes", "rows", "responses")
+
+    def __init__(self, out=None, sizes=None, rows=0, responses=None):
+        self.out = out
+        self.sizes = sizes
+        self.rows = rows
+        self.responses = responses  # set = degenerate path, already done
+
+    def start_copy(self) -> None:
+        if self.out is not None:
+            _start_copy(self.out)
 
 # Micro-batching shape grid: concurrent requests coalesce into [R, B, ...]
 # stacks — R request-slots (padded up to a slot bucket), each padded to B
@@ -81,11 +138,14 @@ class InferenceEngine:
         if bundle.flavor == "sklearn":
             # CPU tree-ensemble floor: host classifier + device monitors.
             # No grouped path — trees run on host threads anyway (and no
-            # AOT table: the classifier is not an XLA program).
+            # AOT table: the classifier is not an XLA program). No device
+            # accumulator either: the server keeps the seed's host-side
+            # metric fold for this flavor.
             self._predict = make_hybrid_predict_fn(
                 bundle.estimator, bundle.monitor, temperature
             )
             self._predict_group = None
+            self._accumulate = False
         else:
             # device_put ONCE: params/monitor/temperature are per-call
             # ARGUMENTS of the cached programs — host numpy trees would
@@ -94,21 +154,63 @@ class InferenceEngine:
             self._variables = jax.device_put(bundle.variables)
             self._monitor = jax.device_put(bundle.monitor)
             self._temperature = jax.device_put(np.float32(temperature))
-            self._predict = make_padded_predict_fn(
-                bundle.model, self._variables, self._monitor, temperature
+            # Base-form packed programs, jitted with the same 7-arg
+            # convention as the AOT table entries — `_dispatch_fused`
+            # AOT-lowers these for any shape warmup missed.
+            donate = _acc_donation()
+            # Warmed shapes never touch these jits (warmup fills the AOT
+            # table through compilecache); they exist only so
+            # `_compile_novel` can AOT-lower a shape warmup missed.
+            self._predict = jax.jit(  # tpulint: disable=TPU203
+                make_packed_predict_base(bundle.model), donate_argnums=donate
             )
             self._predict_group = (
-                make_grouped_predict_fn(
-                    bundle.model, self._variables, self._monitor, temperature
+                jax.jit(  # tpulint: disable=TPU203
+                    make_packed_grouped_base(bundle.model),
+                    donate_argnums=donate,
                 )
                 if enable_grouping
                 else None
             )
+            # Device-resident monitor aggregate, threaded through every
+            # fused dispatch (monitor/state.py MonitorAccumulator). The
+            # lock serializes only the dispatch-order/ref-swap — the
+            # executions chain on device through the data dependency, the
+            # host never blocks here.
+            from mlops_tpu.monitor.state import init_accumulator
+
+            self._accumulate = True
+            self._acc = jax.device_put(init_accumulator())
+            self._acc_lock = threading.Lock()
+            # Novel-shape compiles serialize here, never on _acc_lock: a
+            # synchronous XLA compile under the accumulator lock would
+            # stall every in-flight request, not just the novel one.
+            self._compile_lock = threading.Lock()
+            # Exact host-side running totals, folded from each fetched
+            # window by `monitor_snapshot` (fetch-and-reset): left to grow
+            # on device, the f32 counters would silently saturate at 2^24
+            # rows (~2 h at the benched request rate) where the seed's
+            # Python-int /metrics totals could not.
+            d = SCHEMA.num_categorical + SCHEMA.num_numeric
+            self._totals: dict[str, Any] = {
+                "rows": 0.0,
+                "outliers": 0.0,
+                "batches": 0.0,
+                "drift_sum": np.zeros(d, np.float64),
+                "drift_last": np.zeros(d, np.float64),
+            }
+            self._totals_lock = threading.Lock()
         self.ready = False
 
     @property
     def supports_grouping(self) -> bool:
         return self._predict_group is not None
+
+    @property
+    def monitor_accumulating(self) -> bool:
+        """True when the fused programs fold the monitor aggregate on
+        device (`monitor_snapshot` is then the telemetry read path)."""
+        return self._accumulate
 
     # ------------------------------------------------------------- warmup
     def warmup(self) -> None:
@@ -189,16 +291,115 @@ class InferenceEngine:
             ),
         }
 
-    def _run_exec(self, key: tuple, cat_ids, numeric, mask, fallback):
-        """Dispatch through the AOT table when the shape was warmed; the
-        bound jitted program otherwise (novel shapes compile on demand)."""
+    def _dispatch_fused(self, key: tuple, jitted, *batch):
+        """Dispatch one fused packed call and thread the monitor
+        accumulator through it — the ONE critical section shared by the
+        solo and grouped paths.
+
+        Warmed shapes dispatch through the AOT table; a novel shape
+        (oversized request, unwarmed group geometry) is AOT-compiled into
+        the table FIRST, outside the accumulator lock, so warmed traffic
+        keeps flowing while it compiles.
+
+        The lock covers only the (read acc ref -> dispatch -> swap new
+        ref) window, which is an ASYNC enqueue — concurrent request
+        threads serialize the accumulator chain's ORDER here while the
+        executions overlap on device exactly as before (the chain is a
+        data dependency, not a host wait). Returns the packed output
+        array; the new accumulator stays device-resident."""
         fn = self._exec.get(key)
         if fn is None:
-            return fallback(cat_ids, numeric, mask)
-        return fn(
-            self._variables, self._monitor, self._temperature,
-            cat_ids, numeric, mask,
-        )
+            fn = self._compile_novel(key, jitted, batch)
+        with self._acc_lock:
+            acc = self._acc
+            out, new_acc = fn(
+                self._variables, self._monitor, acc, self._temperature,
+                *batch,
+            )
+            self._acc = new_acc
+        return out
+
+    def _compile_novel(self, key: tuple, jitted, batch):
+        """AOT-compile a shape warmup missed and cache it in the dispatch
+        table. Double-checked under ONE shared lock: concurrent first
+        requests for the same shape compile once, and warmed traffic
+        never waits here — but concurrent DIFFERENT novel shapes do
+        serialize on this lock (novel shapes are rare offline/oversized
+        traffic; per-key locks aren't worth the bookkeeping)."""
+        from mlops_tpu.monitor.state import abstract_accumulator
+
+        with self._compile_lock:
+            fn = self._exec.get(key)
+            if fn is None:
+                fn = jitted.lower(
+                    self._variables,
+                    self._monitor,
+                    abstract_accumulator(),
+                    self._temperature,
+                    *batch,
+                ).compile()
+                self._exec[key] = fn
+        return fn
+
+    def monitor_snapshot(self) -> dict[str, Any]:
+        """ONE device->host fetch of the monitor aggregate — the telemetry
+        read path (`serve/server.py` calls it every K requests / T
+        seconds, and on /metrics scrapes), OFF the request path.
+
+        Fetch-and-RESET: a fresh zero accumulator is swapped in under the
+        lock and the fetched window is folded into exact host-side f64
+        totals. Left to grow on device, the f32 counters would silently
+        stop incrementing at 2^24 rows; windows stay orders of magnitude
+        below that (the server fetches every <=512 requests / 2 s) and the
+        f64 totals are exact to 2^53. The swap also makes the fetched
+        buffers donation-safe — once replaced, no later dispatch can
+        donate them — so no defensive on-device copy is needed."""
+        if not self._accumulate:
+            return {}
+        from mlops_tpu.monitor.state import init_accumulator, merge_accumulators
+
+        with self._acc_lock:
+            window = self._acc
+            self._acc = jax.device_put(init_accumulator())
+        try:
+            host = jax.device_get(window)  # blocks OUTSIDE the dispatch lock
+        except Exception:
+            # Transient fetch failure (remote-chip tunnel error): the window
+            # was already swapped out, so fold it BACK into the live
+            # accumulator — the counts must be delayed, never dropped.
+            # (merge is an eager device enqueue; reads window + the current
+            # acc under the lock, so no dispatch can donate either mid-merge.)
+            with self._acc_lock:
+                self._acc = merge_accumulators(window, self._acc)
+            raise
+        with self._totals_lock:
+            t = self._totals
+            t["rows"] += float(host.rows)
+            t["outliers"] += float(host.outliers)
+            window_batches = float(host.batches)
+            t["batches"] += window_batches
+            t["drift_sum"] = t["drift_sum"] + np.asarray(
+                host.drift_sum, dtype=np.float64
+            )
+            if window_batches:
+                t["drift_last"] = np.asarray(
+                    host.drift_last, dtype=np.float64
+                )
+            drift_mean = t["drift_sum"] / max(t["batches"], 1.0)
+            return {
+                "rows": t["rows"],
+                "outliers": t["outliers"],
+                "batches": t["batches"],
+                "drift_last": dict(
+                    zip(
+                        SCHEMA.feature_names,
+                        t["drift_last"].round(6).tolist(),
+                    )
+                ),
+                "drift_mean": dict(
+                    zip(SCHEMA.feature_names, drift_mean.round(6).tolist())
+                ),
+            }
 
     # -------------------------------------------------------------- predict
     def predict_records(self, records: list[dict[str, Any]]) -> dict[str, Any]:
@@ -210,8 +411,8 @@ class InferenceEngine:
     def predict_arrays(
         self, cat_ids: np.ndarray, numeric: np.ndarray
     ) -> dict[str, Any]:
-        n = cat_ids.shape[0]
-        if n == 0:
+        handle = self.dispatch_arrays(cat_ids, numeric)
+        if handle is None:
             # Empty request: nothing to score, no drift signal (an empty
             # batch must not poison the drift gauges with statistic=1).
             return {
@@ -219,6 +420,19 @@ class InferenceEngine:
                 "outliers": [],
                 "feature_drift_batch": dict.fromkeys(SCHEMA.feature_names, 0.0),
             }
+        handle.start_copy()
+        return self.fetch_arrays(handle)
+
+    def dispatch_arrays(
+        self, cat_ids: np.ndarray, numeric: np.ndarray
+    ) -> _ArraysHandle | None:
+        """Pad to the bucket and fire the device dispatch WITHOUT waiting
+        for (or fetching) the result: returns a handle whose ``start_copy``
+        begins the async D2H and whose ``fetch_arrays`` blocks. None for
+        the empty request (no device work at all)."""
+        n = cat_ids.shape[0]
+        if n == 0:
+            return None
         bucket = self._bucket_for(n)
         if bucket is not None:
             pad = bucket - n
@@ -230,20 +444,38 @@ class InferenceEngine:
             # Oversized request: run at exact shape (compiles once per novel
             # size — rare; offline batch scoring uses this path).
             mask = np.ones((n,), bool)
-        # ONE device_get of the whole tree: separate np.asarray calls per
-        # field each pay a full device->host round trip (~70 ms through the
-        # remote-chip tunnel — measured; 3 fetches were the entire 210 ms
-        # batch-1 latency wall), while a tree fetch batches into one.
-        out = jax.device_get(
-            self._run_exec(
-                ("bucket", bucket), cat_ids, numeric, mask, self._predict
-            )
-            if bucket is not None
-            else self._predict(cat_ids, numeric, mask)
+        rows = bucket if bucket is not None else n
+        if not self._accumulate:
+            # sklearn hybrid: host classifier + device monitors, the seed's
+            # dict output (no packed program exists for a non-XLA model).
+            out = self._predict(cat_ids, numeric, mask)
+            return _ArraysHandle(out, n, rows, packed=False)
+        # Keyed by padded row count: equal to the bucket for bucketed
+        # requests, and the exact size for oversized ones — so a repeated
+        # oversized shape reuses its table entry instead of recompiling.
+        out = self._dispatch_fused(
+            ("bucket", rows), self._predict, cat_ids, numeric, mask
         )
-        predictions = np.asarray(out["predictions"])[:n]
-        outliers = np.asarray(out["outliers"])[:n]
-        drift = np.asarray(out["feature_drift_batch"])
+        return _ArraysHandle(out, n, rows, packed=True)
+
+    def fetch_arrays(self, handle: _ArraysHandle) -> dict[str, Any]:
+        """Block on the host copy and slice the packed buffer into the
+        reference response. ONE contiguous f32 buffer per request: the
+        seed's 3-leaf tree fetch paid a device->host transfer per leaf
+        (~70-90 ms each through the remote-chip tunnel — measured), the
+        packed buffer pays exactly one."""
+        n, rows = handle.n, handle.rows
+        if handle.packed:
+            arr = np.asarray(handle.out)
+            p, o, d = packed_layout(rows)
+            predictions = arr[p][:n]
+            outliers = arr[o][:n]
+            drift = arr[d]
+        else:
+            out = jax.device_get(handle.out)
+            predictions = np.asarray(out["predictions"])[:n]
+            outliers = np.asarray(out["outliers"])[:n]
+            drift = np.asarray(out["feature_drift_batch"])
         return {
             "predictions": predictions.astype(float).tolist(),
             "outliers": outliers.astype(float).tolist(),
@@ -262,12 +494,23 @@ class InferenceEngine:
         enforces this); responses are exactly what each request would get
         from ``predict_records`` alone — per-request drift included.
         """
+        return self.fetch_group(self.dispatch_group(requests))
+
+    def dispatch_group(
+        self, requests: list[list[dict[str, Any]]]
+    ) -> _GroupHandle:
+        """Encode + fire the grouped device dispatch and start the packed
+        output's async host copy, WITHOUT blocking on the result — the
+        micro-batcher claims and dispatches the next group while this one's
+        fetch completes (`serve/batcher.py`'s fetch ring)."""
         if (
             self._predict_group is None
             or len(requests) == 1
             or len(requests) > GROUP_SLOT_BUCKETS[-1]
         ):
-            return [self.predict_records(r) for r in requests]
+            return _GroupHandle(
+                responses=[self.predict_records(r) for r in requests]
+            )
         sizes = [len(r) for r in requests]
         if not all(1 <= n <= GROUP_ROW_BUCKET for n in sizes):
             raise ValueError(
@@ -299,18 +542,27 @@ class InferenceEngine:
             mask[i, :n] = True
             offset += n
 
-        # Single tree fetch (see predict_arrays): one transport round trip.
-        out = jax.device_get(
-            self._run_exec(
-                ("group", slots, rows), cat, num, mask, self._predict_group
-            )
+        out = self._dispatch_fused(
+            ("group", slots, rows), self._predict_group, cat, num, mask
         )
+        handle = _GroupHandle(out=out, sizes=sizes, rows=rows)
+        handle.start_copy()
+        return handle
+
+    def fetch_group(self, handle: _GroupHandle) -> list[dict[str, Any]]:
+        """Block on the packed group buffer (ONE D2H transfer for the whole
+        group) and slice it back into per-request responses."""
+        if handle.responses is not None:
+            return handle.responses
+        sizes, rows = handle.sizes, handle.rows
+        arr = np.asarray(handle.out)  # [slots, 2*rows + D]
         # Response assembly is serial host Python on the grouped hot path:
         # do the dtype casts/rounding ONCE over the stacked arrays, then
         # slice per slot (per-slot .astype/.round cost ~3x more).
-        preds = np.asarray(out["predictions"]).astype(float)
-        outs = np.asarray(out["outliers"]).astype(float)
-        drifts = np.asarray(out["feature_drift_batch"]).astype(float).round(6)
+        p, o, d = packed_layout(rows)
+        preds = arr[:, p].astype(float)
+        outs = arr[:, o].astype(float)
+        drifts = arr[:, d].astype(float).round(6)
         names = SCHEMA.feature_names
         responses = []
         for i, n in enumerate(sizes):

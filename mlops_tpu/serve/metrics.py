@@ -9,6 +9,7 @@ counts, and the last per-feature drift scores.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 
 
@@ -25,6 +26,10 @@ class ServingMetrics:
         self.rows_total = 0
         self.outliers_total = 0
         self.last_drift: dict[str, float] = {}
+        self.mean_drift: dict[str, float] = {}
+        self.monitor_batches = 0
+        self.monitor_fetches = 0
+        self.monitor_fetched_at: float | None = None  # time.monotonic()
 
     # Known routes only: arbitrary request paths must not become unbounded
     # (and injectable) Prometheus label values.
@@ -49,10 +54,28 @@ class ServingMetrics:
                     break
 
     def observe_prediction(self, response: dict) -> None:
+        """Host-side per-response fold — the seed path, kept for engines
+        without a device monitor accumulator (sklearn flavor, stubs)."""
         with self._lock:
             self.rows_total += len(response["predictions"])
             self.outliers_total += int(sum(response["outliers"]))
             self.last_drift = dict(response["feature_drift_batch"])
+
+    def set_monitor_aggregate(self, snapshot: dict) -> None:
+        """Install a device-accumulator snapshot
+        (`serve/engine.py monitor_snapshot`): the device totals are
+        absolute counters, so this REPLACES the monitor gauges rather than
+        adding — per-request host folding never runs on this path."""
+        if not snapshot:
+            return
+        with self._lock:
+            self.rows_total = int(snapshot["rows"])
+            self.outliers_total = int(snapshot["outliers"])
+            self.monitor_batches = int(snapshot["batches"])
+            self.last_drift = dict(snapshot["drift_last"])
+            self.mean_drift = dict(snapshot["drift_mean"])
+            self.monitor_fetches += 1
+            self.monitor_fetched_at = time.monotonic()
 
     def render(self) -> str:
         """Prometheus text format."""
@@ -82,5 +105,28 @@ class ServingMetrics:
             for feature, score in self.last_drift.items():
                 lines.append(
                     f'mlops_tpu_feature_drift_score{{feature="{feature}"}} {score}'
+                )
+            if self.mean_drift:
+                lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
+                for feature, score in self.mean_drift.items():
+                    lines.append(
+                        f'mlops_tpu_feature_drift_mean{{feature="{feature}"}} {score}'
+                    )
+            if self.monitor_fetches:
+                lines.append("# TYPE mlops_tpu_monitor_fetches_total counter")
+                lines.append(
+                    f"mlops_tpu_monitor_fetches_total {self.monitor_fetches}"
+                )
+                lines.append("# TYPE mlops_tpu_monitor_batches_total counter")
+                lines.append(
+                    f"mlops_tpu_monitor_batches_total {self.monitor_batches}"
+                )
+                # The staleness bound docs/operations.md advertises, made
+                # observable: seconds since the exported monitor gauges
+                # were last refreshed from the device.
+                age = time.monotonic() - self.monitor_fetched_at
+                lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
+                lines.append(
+                    f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
                 )
             return "\n".join(lines) + "\n"

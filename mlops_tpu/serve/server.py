@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
 import json
 import logging
 import time
@@ -99,33 +100,53 @@ class HttpServer:
     def __init__(self, engine: InferenceEngine, config: ServeConfig):
         self.engine = engine
         self.config = config
+        # Clamps land in LOCALS, never back into the caller's ServeConfig:
+        # a config object reused to build a second server (tests, multi-
+        # port deployments) must see its original values (ADVICE r5).
         # Invariant: the request cap can never exceed the largest warmed
         # bucket, or steady-state traffic would hit exact-shape recompiles.
+        self.max_batch = config.max_batch
         if config.max_batch > engine.max_bucket:
             logger.warning(
                 "serve.max_batch=%d exceeds largest warmup bucket %d; clamping",
                 config.max_batch,
                 engine.max_bucket,
             )
-            config.max_batch = engine.max_bucket
+            self.max_batch = engine.max_bucket
         self.metrics = ServingMetrics()
-        config.max_workers = max(1, config.max_workers)
-        if not 1 <= config.max_inflight <= config.max_workers:
+        max_workers = max(1, config.max_workers)
+        # Dispatch bound + fetch ring (>= 1) + one thread of headroom (solo
+        # fast path, monitor fetch) must fit the pool, so the dispatch
+        # bound caps at max_workers - 2 — floor 1 keeps tiny pools
+        # (max_workers <= 2) functional even though they cannot honor the
+        # headroom invariant.
+        inflight_cap = max(1, max_workers - 2)
+        max_inflight = config.max_inflight
+        if not 1 <= config.max_inflight <= inflight_cap:
             logger.warning(
-                "serve.max_inflight=%d outside [1, max_workers=%d]; clamping "
-                "(beyond the pool dispatches just queue; 0 would wedge them)",
+                "serve.max_inflight=%d outside [1, max_workers-2=%d]; "
+                "clamping (dispatch + fetch ring + headroom must fit the "
+                "predict pool; 0 would wedge dispatches)",
                 config.max_inflight,
-                config.max_workers,
+                inflight_cap,
             )
-            config.max_inflight = min(
-                max(1, config.max_inflight), config.max_workers
-            )
+            max_inflight = min(max(1, config.max_inflight), inflight_cap)
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=config.max_workers, thread_name_prefix="predict"
+            max_workers=max_workers, thread_name_prefix="predict"
         )
         self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
         self._openapi: dict | None = None  # built lazily, served cached
+        # Device-resident monitor aggregate telemetry (serve/engine.py
+        # monitor_snapshot): the request path only counts requests; the
+        # aggregate is fetched OFF the hot path — after K requests, on the
+        # T-second timer (started by start()), and on /metrics scrapes.
+        self._monitor_accumulating = bool(
+            getattr(engine, "monitor_accumulating", False)
+        )
+        self._monitor_requests = 0  # predicts since the last fetch
+        self._monitor_task: asyncio.Task | None = None
+        self._monitor_timer_task: asyncio.Task | None = None
         # Drain bookkeeping: open client transports and the subset with an
         # exchange currently in flight (between request read and response
         # write). SIGTERM closes idle transports immediately and lets busy
@@ -138,7 +159,15 @@ class HttpServer:
             self._executor,
             window_ms=config.batch_window_ms,
             max_group=config.max_group,
-            max_inflight=config.max_inflight,
+            max_inflight=max_inflight,
+            # Dispatch bound + fetch ring occupy separate executor threads;
+            # size the ring so their sum stays inside the pool WITH one
+            # thread of headroom for the solo fast path and the monitor
+            # fetch — max_inflight dispatches + max_inflight fetches could
+            # otherwise saturate a max_workers == 2*max_inflight pool.
+            fetch_inflight=min(
+                max_inflight, max(1, max_workers - max_inflight - 1)
+            ),
         )
 
     # ----------------------------------------------------------- HTTP layer
@@ -310,6 +339,38 @@ class HttpServer:
                     return 200, {"status": "ready"}, "application/json"
                 return 503, {"status": "warming"}, "application/json"
             if path == "/metrics":
+                # Idle replicas scrape free: once a fetch has drained the
+                # device window and no predicts arrived since, the window
+                # is provably all-zero — skip the device round trip
+                # (~70-90 ms on a remote-attached chip) per scrape.
+                if self._monitor_accumulating and (
+                    self._monitor_requests > 0
+                    or self.metrics.monitor_fetches == 0
+                ):
+                    # Scrapes read FRESH: at most one aggregate fetch per
+                    # scrape (Prometheus cadence, ~15 s) — the per-request
+                    # path stays fetch-free. Awaits the single-flight slot
+                    # (joining any fetch already in flight) so a scrape
+                    # racing the K-trigger/timer can never apply an older
+                    # snapshot after a newer one. BOUNDED + best-effort: a
+                    # stalled device read (tunnel hang) or a failing one
+                    # must never wedge or 500 the scrape — on timeout or
+                    # error the gauges keep their last values (the task's
+                    # done-callback logs the failure) and Prometheus still
+                    # gets a page. shield(): the timeout abandons the wait,
+                    # never cancels the shared fetch task. Flat 1 s,
+                    # INDEPENDENT of the cadence knob in both directions: a
+                    # raised monitor_fetch_every_s must not let a stalled
+                    # fetch hold scrapes toward Prometheus's 10 s
+                    # scrape_timeout, and a sub-second cadence must not
+                    # shrink the wait below what a healthy remote-chip
+                    # fetch needs.
+                    timeout = 1.0
+                    with contextlib.suppress(Exception):
+                        await asyncio.wait_for(
+                            asyncio.shield(self._spawn_monitor_fetch()),
+                            timeout=timeout,
+                        )
                 return 200, self.metrics.render(), "text/plain; version=0.0.4"
         return 404, {"detail": "not found"}, "application/json"
 
@@ -350,7 +411,7 @@ class HttpServer:
             records = self._applicant_list.validate_json(body)
         except pydantic.ValidationError as err:
             return 422, {"detail": json.loads(err.json())}, "application/json"
-        if len(records) > self.config.max_batch:
+        if len(records) > self.max_batch:
             # Cap guards the compile cache: anything beyond the largest
             # warmed bucket would trigger an exact-shape compile per novel
             # size. Offline scoring of big files goes through predict-file.
@@ -358,7 +419,7 @@ class HttpServer:
                 413,
                 {
                     "detail": f"batch of {len(records)} exceeds "
-                    f"max_batch={self.config.max_batch}"
+                    f"max_batch={self.max_batch}"
                 },
                 "application/json",
             )
@@ -417,7 +478,15 @@ class HttpServer:
         except Exception:  # tpulint: disable=TPU201
             logger.exception("prediction failed request_id=%s", request_id)
             return 500, {"detail": "prediction failed"}, "application/json"
-        self.metrics.observe_prediction(response)
+        if self._monitor_accumulating:
+            # Monitor totals are folded ON DEVICE inside the fused predict
+            # (monitor/state.py MonitorAccumulator) — the hot path only
+            # counts requests toward the K-trigger; no per-response host
+            # fold, no per-request aggregate fetch.
+            self._monitor_requests += 1
+            self._maybe_fetch_monitor()
+        else:
+            self.metrics.observe_prediction(response)
         if logger.isEnabledFor(logging.INFO):
             logger.info(
                 "%s",
@@ -432,11 +501,82 @@ class HttpServer:
             )
         return 200, response, "application/json"
 
+    # ------------------------------------------------- monitor telemetry
+    def _spawn_monitor_fetch(self) -> asyncio.Task:
+        """SINGLE-FLIGHT aggregate fetch: every trigger (K requests, the
+        T-second timer, a /metrics scrape) funnels through one task slot.
+        Two concurrent fetches could apply an OLDER cumulative snapshot
+        after a newer one, making the exported counters go backwards for
+        one scrape — which Prometheus reads as a counter reset."""
+        task = self._monitor_task
+        if task is None or task.done():
+            task = asyncio.get_running_loop().create_task(
+                self._fetch_monitor()
+            )
+            task.add_done_callback(self._observe_monitor_fetch)
+            self._monitor_task = task
+        return task
+
+    @staticmethod
+    def _observe_monitor_fetch(task: asyncio.Task) -> None:
+        # Retrieve + log: an unobserved failure (device stall mid-read)
+        # would otherwise die silently and only surface as a GC-time
+        # "Task exception was never retrieved" warning while the gauges
+        # froze at stale values.
+        if not task.cancelled() and task.exception() is not None:
+            logger.error(
+                "monitor aggregate fetch failed; gauges keep their last "
+                "values until the next trigger succeeds",
+                exc_info=task.exception(),
+            )
+
+    def _maybe_fetch_monitor(self) -> None:
+        """Kick an async aggregate fetch when K requests accumulated since
+        the last one. Never blocks the request path; at most one fetch is
+        in flight (a running task absorbs the trigger)."""
+        k = self.config.monitor_fetch_every_requests
+        if not k or self._monitor_requests < k:
+            return
+        self._spawn_monitor_fetch()
+
+    async def _fetch_monitor(self) -> None:
+        """One aggregate read: device -> host -> metrics gauges."""
+        loop = asyncio.get_running_loop()
+        self._monitor_requests = 0
+        snapshot = await loop.run_in_executor(
+            self._executor, self.engine.monitor_snapshot
+        )
+        self.metrics.set_monitor_aggregate(snapshot)
+
+    async def _monitor_timer(self) -> None:
+        """T-second cadence floor for the aggregate gauges: bounds their
+        staleness even under a trickle of traffic that never reaches the
+        K-request trigger (docs/operations.md documents the bound)."""
+        period = self.config.monitor_fetch_every_s
+        while True:
+            await asyncio.sleep(period)
+            if self._monitor_requests > 0:
+                self._spawn_monitor_fetch()
+
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> asyncio.AbstractServer:
+        if self._monitor_accumulating and self.config.monitor_fetch_every_s > 0:
+            # Strong ref: a bare create_task could be garbage-collected.
+            self._monitor_timer_task = asyncio.get_running_loop().create_task(
+                self._monitor_timer()
+            )
         return await asyncio.start_server(
             self.handle_connection, self.config.host, self.config.port
         )
+
+    def stop_telemetry(self) -> None:
+        """Cancel the monitor timer (an infinite loop) and any in-flight
+        fetch on shutdown: left pending, asyncio logs 'Task was destroyed
+        but it is pending!' on every clean rollout and the leaked task
+        keeps the engine alive in start/stop test harnesses."""
+        for task in (self._monitor_timer_task, self._monitor_task):
+            if task is not None and not task.done():
+                task.cancel()
 
 
 async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
@@ -476,7 +616,6 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
     # Service, close IDLE keep-alive connections immediately (they would
     # otherwise hold ``wait_closed`` open forever), let busy exchanges
     # finish their current response, then exit 0.
-    import contextlib
     import signal
 
     draining = asyncio.Event()
@@ -506,6 +645,7 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
         pass
     finally:
         srv.close()
+        server.stop_telemetry()
         await warm_task
         if draining.is_set():
             # Warmup may have finished AFTER the drain flip and

@@ -20,6 +20,11 @@ from flax import serialization
 
 from mlops_tpu.utils.io import atomic_write
 
+# The checkpoint filename pattern is owned HERE (save_checkpoint writes
+# it, load_checkpoint and the existence probes glob it) — callers import
+# this instead of re-spelling the literal.
+CKPT_GLOB = "ckpt_*.msgpack"
+
 
 def tree_bytes(tree: Any) -> bytes:
     return serialization.to_bytes(tree)
@@ -96,7 +101,7 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
     pointed = {path for path, _ in candidates}
     candidates.extend(
         (p, None)
-        for p in sorted(directory.glob("ckpt_*.msgpack"), reverse=True)
+        for p in sorted(directory.glob(CKPT_GLOB), reverse=True)
         if p not in pointed  # don't retry (and double-count) the pointer's file
     )
     failures = []

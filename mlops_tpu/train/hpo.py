@@ -290,6 +290,18 @@ def run_sha(
     total step budget never exceeds random search's — it just
     concentrates on candidates that earn it. Trials eliminated at rung r
     are recorded with the metrics they died with.
+
+    Mesh-path budget caveat (ADVICE r5): on a multi-device mesh each
+    rung's survivor set is padded up to a multiple of the 'data' axis
+    with CYCLED DUPLICATE trials (``pad_to_axis``) so the vmapped rung
+    shards evenly — the duplicates train full rungs but are dropped at
+    selection. The advertised "total step budget <= trials*steps" (and
+    `scripts/sha_vs_random.py`'s sum over trials) counts LOGICAL trials
+    only, so real device-step spend on a mesh exceeds the reported
+    budget by up to ``(axis - 1) / axis`` per rung of the padded slots —
+    e.g. 2 survivors padded to an 8-way data axis run 4x the logical
+    steps that rung. Single-device runs (axis=1) pad nothing and report
+    exactly.
     """
     model = build_model(model_config)
     n0 = hpo_config.trials

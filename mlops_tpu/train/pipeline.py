@@ -9,6 +9,7 @@ read, typed artifacts instead of ``dbutils.jobs.taskValues`` handoffs.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from pathlib import Path
 from typing import Any
@@ -28,6 +29,8 @@ from mlops_tpu.models import build_model
 from mlops_tpu.models.gbm import SKLEARN_FAMILIES, SklearnBaseline
 from mlops_tpu.monitor import fit_monitor
 from mlops_tpu.train.loop import TrainResult, fit
+
+logger = logging.getLogger("mlops_tpu.train")
 
 
 @dataclasses.dataclass
@@ -477,11 +480,11 @@ def _restore_layout_state(ckpt_dir, params, opt_state, ema=None):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
-    from mlops_tpu.train.checkpoint import load_checkpoint
+    from mlops_tpu.train.checkpoint import CKPT_GLOB, load_checkpoint
 
     ckpt_dir = Path(ckpt_dir)
     if not (ckpt_dir / "latest.json").exists() and not any(
-        ckpt_dir.glob("ckpt_*.msgpack")
+        ckpt_dir.glob(CKPT_GLOB)
     ):
         # Fresh start (the common case): skip building the host template —
         # it would device_get params + the 2x-sized adam state for nothing.
@@ -491,6 +494,11 @@ def _restore_layout_state(ckpt_dir, params, opt_state, ema=None):
         template["ema"] = ema
     loaded = load_checkpoint(ckpt_dir, jax.device_get(template))
     if loaded is None:
+        # Checkpoints EXIST (the early return above covers the fresh-start
+        # case) but none matched the current template — load_checkpoint
+        # warned loudly with the per-file errors and the likely cause
+        # (toggling train.ema_decay changes the pytree structure, ADVICE
+        # r5) instead of silently discarding the run's progress.
         return params, opt_state, ema, 0
     host_state, step = loaded
 

@@ -43,26 +43,68 @@ def test_grouped_matches_solo(engine, sample_request):
             ), k
 
 
-def test_batcher_coalesces_concurrent_requests(engine, sample_request):
-    calls = {"group": 0, "solo": 0}
-    real_group = engine.predict_group
+def test_fetch_ring_sizing_bounds_executor_footprint(engine):
+    """The dispatch bound and fetch ring occupy SEPARATE executor threads;
+    the server sizes the ring so dispatch + fetch stays inside the pool
+    with headroom for the solo fast path (2*max_inflight threads would
+    saturate a max_workers == 2*max_inflight pool)."""
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+    b = MicroBatcher(engine, executor, fetch_inflight=2)
+    assert b._fetch_ring._value == 2
+    b = MicroBatcher(engine, executor, max_inflight=3)  # default: mirror
+    assert b._fetch_ring._value == 3
+    b = MicroBatcher(engine, executor, fetch_inflight=0)  # floor: 1
+    assert b._fetch_ring._value == 1
+    executor.shutdown(wait=False)
 
-    def counting_group(reqs):
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.serve.server import HttpServer
+
+    # Server wiring: defaults (workers=8, inflight=4) leave one thread of
+    # headroom — 4 dispatch + 3 fetch < 8.
+    server = HttpServer(engine, ServeConfig())
+    workers = server._executor._max_workers
+    dispatch = server.batcher._inflight._value
+    fetch = server.batcher._fetch_ring._value
+    assert dispatch + fetch < workers
+    server._executor.shutdown(wait=False)
+
+    # The clamp preserves the invariant for ANY config, not just the
+    # defaults: max_inflight == max_workers used to pass validation and
+    # leave zero headroom (dispatch + fetch > pool).
+    cfg = ServeConfig()
+    cfg.max_workers = 4
+    cfg.max_inflight = 4
+    server = HttpServer(engine, cfg)
+    dispatch = server.batcher._inflight._value
+    fetch = server.batcher._fetch_ring._value
+    assert dispatch + fetch < 4
+    assert (cfg.max_workers, cfg.max_inflight) == (4, 4)  # never mutated
+    server._executor.shutdown(wait=False)
+
+
+def test_batcher_coalesces_concurrent_requests(engine, sample_request):
+    # The batcher rides the two-phase grouped API (dispatch_group /
+    # fetch_group) — count coalescing at the dispatch phase.
+    calls = {"group": 0, "solo": 0}
+    real_dispatch = engine.dispatch_group
+
+    def counting_dispatch(reqs):
         calls["group"] += 1
         calls["last_size"] = len(reqs)
-        return real_group(reqs)
+        return real_dispatch(reqs)
 
     engine_proxy = engine
     executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
 
     async def drive():
         batcher = MicroBatcher(engine_proxy, executor, window_ms=20.0)
-        batcher.engine.predict_group = counting_group
+        batcher.engine.dispatch_group = counting_dispatch
         try:
             reqs = _requests(sample_request, 6)
             return await asyncio.gather(*(batcher.predict(r) for r in reqs))
         finally:
-            batcher.engine.predict_group = real_group
+            del batcher.engine.dispatch_group
 
     responses = asyncio.run(drive())
     assert len(responses) == 6

@@ -105,6 +105,44 @@ def test_step_budget_exact_when_not_window_aligned(tmp_path):
     assert result.steps == 250
 
 
+def test_ema_toggle_restore_mismatch_warns_loudly(tmp_path):
+    """Toggling train.ema_decay between runs changes the TrainState pytree
+    (the ema field appears/disappears), so existing checkpoints stop
+    restoring — that must produce ONE warning NAMING the cause and the
+    directory, never a silent restart from step 0 (ADVICE r5)."""
+    import warnings as warnings_mod
+
+    columns, labels = generate_synthetic(1500, seed=5)
+    prep = Preprocessor.fit(columns)
+    ds = prep.encode(columns, labels)
+    train_ds, valid_ds = ds.slice(np.arange(1200)), ds.slice(np.arange(1200, ds.n))
+    model = build_model(ModelConfig(family="mlp", hidden_dims=(16,), embed_dim=4))
+
+    def cfg(ema):
+        return TrainConfig(
+            batch_size=128, steps=40, eval_every=20, checkpoint_every=20,
+            ema_decay=ema,
+        )
+
+    fit(model, train_ds, valid_ds, cfg(0.0), checkpoint_dir=tmp_path / "c")
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter("always")
+        result = fit(
+            model, train_ds, valid_ds, cfg(0.99), checkpoint_dir=tmp_path / "c"
+        )
+    # Restarted from 0 (the mismatch is real)...
+    assert result.history[0]["step"] <= 20
+    # ...and said so ONCE, naming the ema toggle and the directory
+    # (`train/checkpoint.py load_checkpoint` owns the message — a second
+    # differently-worded warning for the same event would double-page).
+    relevant = [
+        str(w.message) for w in caught if "failed to restore" in str(w.message)
+    ]
+    assert len(relevant) == 1
+    assert "ema_decay" in relevant[0]
+    assert str(tmp_path / "c") in relevant[0]
+
+
 def test_checkpoint_survives_corrupt_pointer(tmp_path):
     _train_tiny(steps=200, checkpoint_dir=tmp_path / "c")
     (tmp_path / "c" / "latest.json").write_text("{torn")
