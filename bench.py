@@ -232,9 +232,24 @@ def _batch1_stage(engine, record) -> dict:
     # medians would drift from it whenever copy and sync are correlated
     # across reps, making round-over-round deltas an artifact.
     fetch = sorted(c + s for c, s in zip(copy, sync))[mid]
+
+    # Lock-contention satellite: total blocked time across the engine's
+    # locks (_acc_lock, the jit-compile lock, ...) over a dedicated
+    # instrumented rep loop — SEPARATE from the latency loops above so the
+    # wrapper's per-acquire bookkeeping never taints p50/p99
+    # comparability with earlier rounds. Near-zero when uncontended; a
+    # regression that makes a request hold a lock across blocking work
+    # (the PR 4 _compile_novel class, tpulint TPU403) shows here as soon
+    # as anything else wants the lock.
+    from mlops_tpu.analysis.lockcheck import instrument_locks
+
+    with instrument_locks(engine) as sanitizer:
+        for _ in range(50):
+            engine.predict_records([record])
     return {
         "p50_ms": _percentile(lat, 50),
         "p99_ms": _percentile(lat, 99),
+        "lock_wait_ms": round(sanitizer.total_wait_ms, 3),
         "breakdown_ms": {
             "encode": round(sorted(enc)[mid], 3),
             "dispatch": round(sorted(disp)[mid], 3),
@@ -959,6 +974,7 @@ def main() -> None:
                 "vs_baseline": round(5.0 / p50, 3),
                 "p99_ms": round(batch1["p99_ms"], 4),
                 "batch1_req_per_s": round(1e3 / p50, 1),
+                "lock_wait_ms": batch1["lock_wait_ms"],
                 "breakdown_ms": batch1["breakdown_ms"],
                 **monitor_stats,
                 **bulk,

@@ -18,21 +18,43 @@ package keeps the codebase honest on every PR:
   numerics hazards (float64 leaks, weak-type outputs, convert_element_type
   round-trips, per-bucket shape polymorphism, producer/consumer sharding
   mismatches).
+- **Layer 3** (`concurrency`): lock-discipline rules over the hand-rolled
+  threading layer (serving engine, micro-batcher, pipeline executor,
+  compile cache) — lock-order graph vs the declared ``TPULINT_LOCK_ORDER``
+  manifest, guard inference for shared attributes, blocking calls under a
+  held mutex, semaphore acquire/release pairing. Pure ``ast``, opt-in via
+  ``analyze --concurrency`` (CI runs it). The RUNTIME half (`lockcheck`)
+  swaps real locks for instrumented wrappers in tests: per-thread
+  acquisition stacks asserted against the same declared order, lock-wait
+  accounting (bench's ``lock_wait_ms``), and seeded schedule perturbation.
 
-CLI: ``mlops-tpu analyze [--strict] [paths ...]`` (`analysis/cli.py`);
-CI runs it as a gate before pytest. Suppress a finding inline with
-``# tpulint: disable=TPU101`` (see `docs/static-analysis.md`).
+The suppression ledger stays honest via ``analyze --list-suppressions``
+(every ``# tpulint: disable`` with live/stale status) and ``--fail-stale``
+(stale ones gate as TPU400).
+
+CLI: ``mlops-tpu analyze [--strict] [--concurrency] [paths ...]``
+(`analysis/cli.py`); CI runs it as a gate before pytest. Suppress a
+finding inline with ``# tpulint: disable=TPU101`` (see
+`docs/static-analysis.md`).
 """
 
 from __future__ import annotations
 
 from mlops_tpu.analysis.findings import Finding, Severity, format_findings
 from mlops_tpu.analysis.astrules import RULES, analyze_paths, analyze_source
+from mlops_tpu.analysis.concurrency import (
+    CONCURRENCY_RULES,
+    analyze_concurrency_paths,
+    analyze_concurrency_source,
+)
 
 __all__ = [
+    "CONCURRENCY_RULES",
     "Finding",
     "RULES",
     "Severity",
+    "analyze_concurrency_paths",
+    "analyze_concurrency_source",
     "analyze_paths",
     "analyze_source",
     "format_findings",

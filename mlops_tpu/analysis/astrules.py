@@ -669,11 +669,16 @@ class _RuleVisitor(ast.NodeVisitor):
 
 
 def analyze_source(
-    source: str, path: str | Path, rel_path: str | Path | None = None
+    source: str,
+    path: str | Path,
+    rel_path: str | Path | None = None,
+    keep_suppressed: bool = False,
 ) -> list[Finding]:
     """Run every Layer-1 rule over one file's source text. ``rel_path``
     (the path relative to the analyzed root) scopes path-predicated rules
-    like TPU203; it defaults to ``path`` for standalone callers."""
+    like TPU203; it defaults to ``path`` for standalone callers.
+    ``keep_suppressed`` returns findings that inline disables would hide —
+    the suppression auditor uses it to tell live disables from stale."""
     path = str(path)
     if file_skipped(source):
         return []
@@ -697,20 +702,23 @@ def analyze_source(
     )
     visitor.visit(tree)
     visitor.check_jit_sites()
+    if keep_suppressed:
+        return visitor.findings
     lines = source.splitlines()
     return [f for f in visitor.findings if not is_suppressed(f, lines)]
 
 
-def analyze_paths(paths: Iterable[str | Path]) -> list[Finding]:
-    """Lint every ``.py`` under ``paths`` (files or directories)."""
-    findings: list[Finding] = []
+def iter_py_files(
+    paths: Iterable[str | Path],
+) -> Iterable[tuple[Path, Path]]:
+    """(file, rel) for every ``.py`` under ``paths`` — the one directory
+    walk shared by all analyzer layers and the suppression auditor. ``rel``
+    is the path under the analyzed root, so directory names ABOVE the root
+    (a checkout under /srv/serve/, say) never trip path-scoped rules; the
+    root's own name still counts (analyzing `mlops_tpu/serve/` directly)."""
     for path in paths:
         path = Path(path)
         if path.is_dir():
-            # rel: file path under the analyzed root, so directory names
-            # ABOVE the root (a checkout under /srv/serve/, say) never
-            # trip path-scoped rules; the root's own name still counts
-            # (analyzing `mlops_tpu/serve/` directly).
             files = [(f, Path(path.name) / f.relative_to(path))
                      for f in sorted(path.rglob("*.py"))]
         else:
@@ -718,11 +726,18 @@ def analyze_paths(paths: Iterable[str | Path]) -> list[Finding]:
         for file, rel in files:
             if "__pycache__" in file.parts:
                 continue
-            findings.extend(
-                analyze_source(
-                    file.read_text(encoding="utf-8"),
-                    file.as_posix(),
-                    rel_path=rel.as_posix(),
-                )
+            yield file, rel
+
+
+def analyze_paths(paths: Iterable[str | Path]) -> list[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directories)."""
+    findings: list[Finding] = []
+    for file, rel in iter_py_files(paths):
+        findings.extend(
+            analyze_source(
+                file.read_text(encoding="utf-8"),
+                file.as_posix(),
+                rel_path=rel.as_posix(),
             )
+        )
     return findings

@@ -40,7 +40,32 @@ def _run_analyze(args: argparse.Namespace) -> int:
     if missing:
         print(f"tpulint: error: no such path: {', '.join(missing)}")
         return 2
+
+    if getattr(args, "list_suppressions", False):
+        # Report mode: the suppression ledger instead of the finding gate.
+        from mlops_tpu.analysis.suppressions import (
+            audit_paths,
+            format_suppressions,
+        )
+
+        suppressions = audit_paths(paths)
+        print(format_suppressions(suppressions))
+        stale = [
+            s for s in suppressions if not s.live and not s.skipped_file
+        ]
+        return 1 if (stale and getattr(args, "fail_stale", False)) else 0
+
     findings: list[Finding] = analyze_paths(paths)
+    if getattr(args, "concurrency", False):
+        from mlops_tpu.analysis.concurrency import analyze_concurrency_paths
+
+        findings.extend(analyze_concurrency_paths(paths))
+    if getattr(args, "fail_stale", False):
+        from mlops_tpu.analysis.suppressions import stale_findings
+
+        # TPU400 findings are immune to disable comments by construction
+        # (suppressions.py): a stale disable can't silence its own report.
+        findings.extend(stale_findings(paths))
 
     notes: list[str] = []
     if not getattr(args, "no_trace", False):

@@ -71,6 +71,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the jaxpr trace layer (no JAX import; AST rules only)",
     )
     analyze.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="also run the Layer-3 concurrency rules (lock-order graph, "
+        "guard inference, blocking-under-lock, semaphore pairing — "
+        "TPU401-404; pure AST, no JAX import)",
+    )
+    analyze.add_argument(
+        "--list-suppressions",
+        action="store_true",
+        help="report every `# tpulint: disable` in the tree with file:line,"
+        " rule ids, and live/stale status, then exit (no analysis gate)",
+    )
+    analyze.add_argument(
+        "--fail-stale",
+        action="store_true",
+        help="suppressions that no longer suppress anything become gating "
+        "TPU400 findings (the CI mode keeping old disables honest)",
+    )
+    analyze.add_argument(
         "--numeric",
         action="store_true",
         help="also run the checkify numeric audit on the serve entry "

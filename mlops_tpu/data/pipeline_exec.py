@@ -50,6 +50,16 @@ Per-stage wall/occupancy timing (`utils/timing.py StageClock`) comes back
 in the returned ``PipelineStats`` so overlap wins are measured, not
 asserted: occupancies sum to ~1.0 when serial and exceed it when
 overlapped, and the largest occupancy names the bottleneck stage.
+
+Concurrency discipline (tpulint Layer 3): this executor deliberately owns
+NO explicit locks — all cross-thread state rides the bounded
+``queue.Queue`` links (internally locked) plus one ``threading.Event``
+stop flag, so there is no order to violate and nothing for
+blocking-under-lock to flag. The schedule-dependent invariants (FIFO
+bit-identical outputs, clean failure drain) are exercised under seeded
+schedule perturbation instead (`analysis/lockcheck.py SchedulePerturber`,
+tests/test_pipeline_exec.py) — keep new shared state on the queues, not
+on ad-hoc locks.
 """
 
 from __future__ import annotations

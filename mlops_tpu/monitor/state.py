@@ -271,7 +271,12 @@ def merge_accumulators(
     ``drift_last`` takes the newer window's unless it folded no batches.
     Used by `serve/engine.py monitor_snapshot` to fold an un-fetched
     window back into the live accumulator when a telemetry fetch fails —
-    a transient device error must DELAY the counts, not drop them."""
+    a transient device error must DELAY the counts, not drop them.
+
+    Lock discipline: callers invoke this UNDER the engine's ``_acc_lock``
+    (see TPULINT_LOCK_ORDER in serve/engine.py) so no dispatch can donate
+    either operand mid-merge — which is safe under tpulint TPU403 because
+    the merge is an eager device ENQUEUE, never a host-blocking fetch."""
     return MonitorAccumulator(
         rows=older.rows + newer.rows,
         outliers=older.outliers + newer.outliers,

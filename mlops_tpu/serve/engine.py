@@ -37,6 +37,20 @@ from mlops_tpu.ops.predict import (
 )
 from mlops_tpu.schema import SCHEMA, records_to_columns
 
+# Declared lock order, OUTERMOST FIRST — the single source of truth for
+# both halves of tpulint Layer 3: the static analyzer
+# (analysis/concurrency.py TPU401) checks every lexically nested
+# acquisition against it, and the runtime sanitizer
+# (analysis/lockcheck.py) asserts it on live thread schedules in the
+# stress tests. ``_compile_lock`` may be held while the others are taken,
+# never the reverse; ``_acc_lock`` and ``_totals_lock`` are leaf locks
+# today (no nesting anywhere) — keep them that way: a blocking XLA compile
+# or device fetch nested under the accumulator lock is exactly the PR 4
+# stall this manifest exists to prevent.
+TPULINT_LOCK_ORDER = {
+    "InferenceEngine": ("_compile_lock", "_acc_lock", "_totals_lock")
+}
+
 
 def _start_copy(tree: Any) -> None:
     """Begin the device->host copy of every array in ``tree`` WITHOUT
@@ -276,9 +290,18 @@ class InferenceEngine:
             jobs, cache=self.compile_cache, workers=self.warmup_workers
         ):
             if "bucket" in job.meta:
-                self._exec[("bucket", job.meta["bucket"])] = fn
+                key = ("bucket", job.meta["bucket"])
             else:
-                self._exec[("group", job.meta["slots"], job.meta["rows"])] = fn
+                key = ("group", job.meta["slots"], job.meta["rows"])
+            # Under _compile_lock (tpulint TPU402): the server binds its
+            # socket FIRST and warms concurrently (serve/server.py _serve),
+            # so live requests can race this loop — an unlocked table
+            # write could interleave with `_compile_novel` double-compiling
+            # the same key it is about to install. Taken per write, never
+            # across run_jobs: holding it for the whole warmup would stall
+            # a novel-shape request until every program compiled.
+            with self._compile_lock:
+                self._exec[key] = fn
         self.ready = True
         self.warmup_stats = {
             "warmup_s": round(time.perf_counter() - t0, 3),
@@ -331,7 +354,12 @@ class InferenceEngine:
         with self._compile_lock:
             fn = self._exec.get(key)
             if fn is None:
-                fn = jitted.lower(
+                # The sync XLA compile DOES block this lock — that is the
+                # design: _compile_lock exists precisely to serialize novel
+                # compiles away from _acc_lock (where the same compile once
+                # stalled every in-flight request). Warmed traffic never
+                # touches this lock on its hot path.
+                fn = jitted.lower(  # tpulint: disable=TPU403
                     self._variables,
                     self._monitor,
                     abstract_accumulator(),
@@ -372,34 +400,37 @@ class InferenceEngine:
             with self._acc_lock:
                 self._acc = merge_accumulators(window, self._acc)
             raise
+        # Host numpy work (dtype casts, rounding, dict building) stays
+        # OUTSIDE the totals lock (tpulint TPU403): the critical section
+        # is only the counter updates plus alias grabs. Aliasing out is
+        # safe because the drift arrays are REPLACED under the lock, never
+        # mutated in place — a snapshot read here can't be half-updated by
+        # a concurrent fold.
+        window_batches = float(host.batches)
+        window_drift_sum = np.asarray(host.drift_sum, dtype=np.float64)
+        window_drift_last = np.asarray(host.drift_last, dtype=np.float64)
         with self._totals_lock:
             t = self._totals
             t["rows"] += float(host.rows)
             t["outliers"] += float(host.outliers)
-            window_batches = float(host.batches)
             t["batches"] += window_batches
-            t["drift_sum"] = t["drift_sum"] + np.asarray(
-                host.drift_sum, dtype=np.float64
-            )
+            t["drift_sum"] = t["drift_sum"] + window_drift_sum
             if window_batches:
-                t["drift_last"] = np.asarray(
-                    host.drift_last, dtype=np.float64
-                )
-            drift_mean = t["drift_sum"] / max(t["batches"], 1.0)
-            return {
-                "rows": t["rows"],
-                "outliers": t["outliers"],
-                "batches": t["batches"],
-                "drift_last": dict(
-                    zip(
-                        SCHEMA.feature_names,
-                        t["drift_last"].round(6).tolist(),
-                    )
-                ),
-                "drift_mean": dict(
-                    zip(SCHEMA.feature_names, drift_mean.round(6).tolist())
-                ),
-            }
+                t["drift_last"] = window_drift_last
+            rows, outliers, batches = t["rows"], t["outliers"], t["batches"]
+            drift_sum, drift_last = t["drift_sum"], t["drift_last"]
+        drift_mean = drift_sum / max(batches, 1.0)
+        return {
+            "rows": rows,
+            "outliers": outliers,
+            "batches": batches,
+            "drift_last": dict(
+                zip(SCHEMA.feature_names, drift_last.round(6).tolist())
+            ),
+            "drift_mean": dict(
+                zip(SCHEMA.feature_names, drift_mean.round(6).tolist())
+            ),
+        }
 
     # -------------------------------------------------------------- predict
     def predict_records(self, records: list[dict[str, Any]]) -> dict[str, Any]:

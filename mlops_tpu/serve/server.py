@@ -141,6 +141,13 @@ class HttpServer:
         # monitor_snapshot): the request path only counts requests; the
         # aggregate is fetched OFF the hot path — after K requests, on the
         # T-second timer (started by start()), and on /metrics scrapes.
+        # Concurrency note (tpulint Layer 3): every mutable field below
+        # (_monitor_requests, _monitor_task, _busy, _connections, draining)
+        # is EVENT-LOOP CONFINED — touched only from coroutines on the one
+        # asyncio thread, never from the predict executor — which is why
+        # none of them carries a lock. Work crossing into the executor goes
+        # through run_in_executor and returns via awaited futures; keep it
+        # that way rather than adding locks here.
         self._monitor_accumulating = bool(
             getattr(engine, "monitor_accumulating", False)
         )

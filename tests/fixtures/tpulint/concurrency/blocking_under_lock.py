@@ -1,0 +1,46 @@
+"""TPU403 fixture: blocking calls while a mutex is held — the
+`_compile_novel`-under-`_acc_lock` class of bug (PR 4)."""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Fetcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = None
+        self._exe = None
+
+    def fetch_under_lock(self, handle):
+        with self._lock:
+            self._out = np.asarray(handle.out)  # PLANT: TPU403
+
+    def compile_under_lock(self, jitted, args):
+        with self._lock:
+            self._exe = jitted.lower(*args).compile()  # PLANT: TPU403
+
+    def sync_under_lock(self, result):
+        with self._lock:
+            result.block_until_ready()  # PLANT: TPU403
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # PLANT: TPU403
+
+    def enqueue_under_lock(self, out_queue, item):
+        with self._lock:
+            out_queue.put(item)  # PLANT: TPU403
+
+    def open_in_same_with_header(self, path):
+        # Multi-item with: open() runs with the lock ALREADY held — same
+        # hazard as the nested form, one line instead of two.
+        with self._lock, open(path) as fh:  # PLANT: TPU403
+            return fh.read()
+
+    def fetch_outside_lock(self, handle):
+        # The fix shape: block first, publish under the lock.
+        out = np.asarray(handle.out)
+        with self._lock:
+            self._out = out

@@ -1,0 +1,44 @@
+"""TPU402 fixture: attributes written both under and outside their
+dominant (inferred) lock. ``__init__`` writes never count — construction
+precedes sharing."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._rates = {}
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset_unsafe(self):
+        self._count = 0  # PLANT: TPU402
+
+    def set_rate(self, key, value):
+        with self._lock:
+            self._rates[key] = value
+
+    def clear_unsafe(self):
+        self._rates = {}  # PLANT: TPU402
+
+
+class Consistent:
+    """Every non-init write holds the guard: clean."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = None
+        self._unshared = 0  # never lock-guarded anywhere: untracked
+
+    def swap(self, new):
+        with self._lock:
+            old = self._state
+            self._state = new
+        return old
+
+    def bump(self):
+        self._unshared += 1

@@ -1,0 +1,80 @@
+"""TPU401 fixture: lock-order inversions, cycles, undeclared nesting.
+
+Analyzed, never imported (tests/test_analysis.py). Each violation line
+carries a PLANT marker comment; the contract is exact — every planted
+line fires, nothing else does.
+"""
+
+import threading
+
+TPULINT_LOCK_ORDER = {
+    "Ordered": ("_a", "_b"),
+    "PartiallyDeclared": ("_a",),
+}
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        # Declared order (_a outermost): clean.
+        with self._a:
+            with self._b:
+                pass
+
+    def inverted(self):
+        with self._b:
+            with self._a:  # PLANT: TPU401
+                pass
+
+
+class Cyclic:
+    """No declared order: only genuine cycles are flagged."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def xy(self):
+        with self._x:
+            with self._y:  # PLANT: TPU401
+                pass
+
+    def yx(self):
+        with self._y:
+            with self._x:  # PLANT: TPU401
+                pass
+
+
+class Acyclic:
+    """No declared order, consistent nesting everywhere: clean."""
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def one(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def two(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+
+class PartiallyDeclared:
+    """A declared scope must declare EVERY lock that participates in
+    nesting — a new lock slipped under an old one is flagged."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._c = threading.Lock()
+
+    def nested(self):
+        with self._a:
+            with self._c:  # PLANT: TPU401
+                pass

@@ -1,0 +1,60 @@
+"""TPU404 fixture: semaphore acquire/release pairing across two-phase
+dispatch/fetch paths."""
+
+import threading
+
+TPULINT_CROSS_METHOD_SEMAPHORES = {"DeclaredTwoPhase": ("_ring",)}
+
+
+class LeakyRing:
+    """Acquired, never released anywhere: every dispatch leaks a permit
+    and the ring wedges at capacity."""
+
+    def __init__(self):
+        self._slots = threading.BoundedSemaphore(4)
+
+    def dispatch(self, fn):
+        self._slots.acquire()  # PLANT: TPU404
+        return fn()
+
+
+class UndeclaredTwoPhase:
+    """The release exists — in another method — but nothing declares the
+    cross-method pairing, so nothing would catch the fetch path dropping
+    its release in a refactor."""
+
+    def __init__(self):
+        self._ring = threading.BoundedSemaphore(2)
+
+    def dispatch(self):
+        self._ring.acquire()  # PLANT: TPU404
+
+    def fetch(self):
+        self._ring.release()
+
+
+class DeclaredTwoPhase:
+    """Same shape, declared (TPULINT_CROSS_METHOD_SEMAPHORES): clean."""
+
+    def __init__(self):
+        self._ring = threading.BoundedSemaphore(2)
+
+    def dispatch(self):
+        self._ring.acquire()
+
+    def fetch(self):
+        self._ring.release()
+
+
+class BalancedInline:
+    """Acquire and release on the same function's paths: clean."""
+
+    def __init__(self):
+        self._slots = threading.BoundedSemaphore(4)
+
+    def run(self, fn):
+        self._slots.acquire()
+        try:
+            return fn()
+        finally:
+            self._slots.release()

@@ -58,12 +58,15 @@ def test_each_planted_violation_fires_at_its_line(name):
 
 def test_every_shipped_rule_is_exercised_by_a_fixture():
     """A rule without a fixture is a rule that can silently stop firing."""
+    from mlops_tpu.analysis import CONCURRENCY_RULES
+
+    shipped = set(RULES) | set(CONCURRENCY_RULES)
     planted_rules = set()
     for path in FIXTURES.rglob("*.py"):
         planted_rules |= {rule for _, rule in _planted(path)}
-    assert planted_rules == set(RULES), (
-        f"fixture-less rules: {set(RULES) - planted_rules}; "
-        f"unknown planted: {planted_rules - set(RULES)}"
+    assert planted_rules == shipped, (
+        f"fixture-less rules: {shipped - planted_rules}; "
+        f"unknown planted: {planted_rules - shipped}"
     )
 
 
@@ -121,6 +124,343 @@ def test_cli_exit_2_on_missing_path(capsys):
 
     assert main(["analyze", "--no-trace", "definitely/not/a/path.py"]) == 2
     assert "no such path" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ Layer 3
+CONCURRENCY_FIXTURES = FIXTURES / "concurrency"
+# The planted-count contract per rule, pinned exactly: the fixture suite
+# is the regression net for the analyzer's precision in BOTH directions —
+# a rule firing fewer times silently went blind, firing more went noisy.
+CONCURRENCY_COUNTS = {"TPU401": 4, "TPU402": 2, "TPU403": 6, "TPU404": 2}
+
+
+def _concurrency_findings(path):
+    from mlops_tpu.analysis import analyze_concurrency_source
+
+    src = path.read_text()
+    return analyze_source(src, path) + analyze_concurrency_source(src, path)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["lock_order", "guard_inference", "blocking_under_lock", "ring_pairing"],
+)
+def test_each_planted_concurrency_violation_fires_at_its_line(name):
+    path = CONCURRENCY_FIXTURES / f"{name}.py"
+    planted = _planted(path)
+    assert planted, f"fixture {name} has no PLANT markers"
+    found = {(f.line, f.rule) for f in _concurrency_findings(path)}
+    assert planted <= found, f"missed: {planted - found}"
+    extra = {(ln, r) for ln, r in found if (ln, r) not in planted}
+    assert not extra, f"unexpected findings: {extra}"
+
+
+def test_concurrency_fixture_counts_pinned():
+    """Exact per-rule finding counts over the whole fixture dir — and the
+    CLI detects all of them through `analyze --concurrency`."""
+    from collections import Counter
+
+    from mlops_tpu.cli import main
+
+    counts = Counter()
+    for path in sorted(CONCURRENCY_FIXTURES.glob("*.py")):
+        counts.update(f.rule for f in _concurrency_findings(path))
+    assert dict(counts) == CONCURRENCY_COUNTS
+
+    assert (
+        main(
+            ["analyze", "--no-trace", "--concurrency",
+             str(CONCURRENCY_FIXTURES)]
+        )
+        == 1
+    )
+
+
+def test_concurrency_rules_respect_suppressions():
+    from mlops_tpu.analysis import analyze_concurrency_source
+
+    source = (
+        "import threading\n"
+        "import numpy as np\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self, h):\n"
+        "        with self._lock:\n"
+        "            return np.asarray(h)  # tpulint: disable=TPU403\n"
+    )
+    assert analyze_concurrency_source(source, "inline.py") == []
+    kept = analyze_concurrency_source(
+        source, "inline.py", keep_suppressed=True
+    )
+    assert [f.rule for f in kept] == ["TPU403"]
+
+
+def test_concurrency_layer_requires_flag():
+    """Without --concurrency the fixtures raise no TPU40x findings (the
+    planted files are Layer-1 clean by construction)."""
+    from mlops_tpu.cli import main
+
+    assert (
+        main(["analyze", "--no-trace", str(CONCURRENCY_FIXTURES)]) == 0
+    )
+
+
+def test_lockless_class_methods_see_module_locks():
+    """A class with no lock attributes of its own still gets walked: its
+    methods holding a MODULE-level lock are in scope for TPU403 (regression
+    — lock-less classes were skipped entirely, so shared-module-lock misuse
+    inside them was invisible)."""
+    from mlops_tpu.analysis import analyze_concurrency_source
+
+    source = (
+        "import threading\n"
+        "import numpy as np\n"
+        "_LOCK = threading.Lock()\n"
+        "class NoLocks:\n"
+        "    def f(self, h):\n"
+        "        with _LOCK:\n"
+        "            return np.asarray(h)\n"
+    )
+    findings = analyze_concurrency_source(source, "inline.py")
+    assert [f.rule for f in findings] == ["TPU403"]
+
+
+def test_annotated_manifest_is_read():
+    """`TPULINT_LOCK_ORDER: dict = {...}` (an AnnAssign) must work like the
+    bare assignment — regression: the annotated form was silently dropped,
+    downgrading the scope to cycles-only while the runtime sanitizer still
+    imported the manifest (the exact static/dynamic divergence the shared
+    declaration exists to prevent)."""
+    from mlops_tpu.analysis import analyze_concurrency_source
+
+    source = (
+        "import threading\n"
+        'TPULINT_LOCK_ORDER: dict = {"C": ("_a", "_b")}\n'
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def inverted(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    findings = analyze_concurrency_source(source, "inline.py")
+    assert [f.rule for f in findings] == ["TPU401"]
+
+
+# ------------------------------------------- suppression ledger (TPU400)
+def test_list_suppressions_reports_live_and_stale(tmp_path, capsys):
+    from mlops_tpu.cli import main
+
+    live = tmp_path / "live.py"
+    live.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.tolist()  # tpulint: disable=TPU101\n"
+    )
+    stale = tmp_path / "stale.py"
+    stale.write_text(
+        "def g(x):\n"
+        "    return x  # tpulint: disable=TPU101\n"
+    )
+    assert main(["analyze", "--list-suppressions", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "live.py:4: disable=TPU101 [live]" in out
+    assert "stale.py:2: disable=TPU101 [STALE]" in out
+    assert "2 suppression(s), 1 stale" in out
+    # --fail-stale flips the exit code in list mode...
+    assert (
+        main(["analyze", "--list-suppressions", "--fail-stale",
+              str(tmp_path)])
+        == 1
+    )
+    capsys.readouterr()
+    # ...and in gate mode the stale comment is a TPU400 finding that a
+    # disable comment can NOT silence (it must not hide its own report).
+    assert main(["analyze", "--no-trace", "--fail-stale", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "TPU400" in out and "stale.py:2" in out
+
+
+def test_suppression_examples_in_docstrings_are_not_counted(tmp_path, capsys):
+    """The audit reads real COMMENT tokens (tokenize): the disable syntax
+    quoted inside a docstring is documentation, not a suppression."""
+    from mlops_tpu.cli import main
+
+    doc = tmp_path / "doc.py"
+    doc.write_text(
+        '"""Suppress with ``# tpulint: disable=TPU101`` on the line."""\n'
+        "X = 1\n"
+    )
+    assert main(["analyze", "--list-suppressions", str(tmp_path)]) == 0
+    assert "0 suppression(s), 0 stale" in capsys.readouterr().out
+
+
+def test_untokenizable_file_does_not_crash_the_audit(tmp_path, capsys):
+    """A file tokenize rejects (unterminated triple-quote, bad dedent) must
+    degrade to 'nothing to audit' — Layer 1 owns the syntax-error report.
+    Regression: the except clause once named the nonexistent
+    ``tokenize.TokenizeError``, so any such file killed the whole
+    ``--fail-stale`` gate with an AttributeError (exit 2)."""
+    from mlops_tpu.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = '''unterminated\n")
+    dedent = tmp_path / "dedent.py"
+    dedent.write_text("def f():\n        x = 1\n    return x\n")
+    assert main(["analyze", "--list-suppressions", str(tmp_path)]) == 0
+    assert "0 suppression(s), 0 stale" in capsys.readouterr().out
+    # Gate mode still reports the syntax errors (Layer 1 TPU000), exit 1
+    # not an internal-failure exit 2.
+    assert main(["analyze", "--no-trace", "--fail-stale", str(tmp_path)]) == 1
+    assert "TPU000" in capsys.readouterr().out
+
+
+def test_package_suppressions_all_live():
+    """The PR 1/3/4 disables stay honest: every suppression in the shipped
+    package still suppresses a real finding (the CI --fail-stale gate)."""
+    from mlops_tpu.analysis.suppressions import audit_paths
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    stale = [
+        s.describe()
+        for s in audit_paths([package])
+        if not s.live and not s.skipped_file
+    ]
+    assert stale == []
+
+
+# ------------------------------------------------- runtime lock sanitizer
+def test_lockcheck_detects_declared_order_inversion():
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import LockSanitizer
+
+    san = LockSanitizer(order=("a", "b"))
+    a = san.wrap(threading.Lock(), "a")
+    b = san.wrap(threading.Lock(), "b")
+    with a:
+        with b:
+            pass
+    assert san.violations == []
+    with b:
+        with a:
+            pass
+    assert len(san.violations) == 1
+    v = san.violations[0]
+    assert (v.acquiring, v.holding) == ("a", ("b",))
+    assert "inverts the declared order" in str(v)
+
+
+def test_lockcheck_flags_undeclared_lock_in_nesting():
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import LockSanitizer
+
+    san = LockSanitizer(order=("a",))
+    a = san.wrap(threading.Lock(), "a")
+    rogue = san.wrap(threading.Lock(), "rogue")
+    with a:
+        with rogue:
+            pass
+    assert len(san.violations) == 1
+    assert "not in the declared order" in san.violations[0].note
+
+
+def test_lockcheck_accounts_contended_wait():
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import LockSanitizer
+
+    san = LockSanitizer()
+    lock = san.wrap(threading.Lock(), "l")
+    started = threading.Event()
+
+    def holder():
+        with lock:
+            started.set()
+            import time
+
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait()
+    with lock:
+        pass
+    t.join()
+    assert san.total_wait_s >= 0.02
+    assert san.acquired["l"] == 2
+    assert san.violations == []
+
+
+def test_lockcheck_cross_thread_semaphore_release():
+    """A permit acquired on one thread and released on another (the
+    two-phase dispatch/fetch handoff) must be popped from the ACQUIRER's
+    held stack — regression: the stale entry manufactured bogus order
+    violations on every later acquisition and grew the stack forever."""
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import LockSanitizer
+
+    san = LockSanitizer(order=("lock", "sem"))
+    sem = san.wrap(threading.Semaphore(2), "sem")
+    lock = san.wrap(threading.Lock(), "lock")
+    sem.acquire()
+    t = threading.Thread(target=sem.release)
+    t.start()
+    t.join()
+    with lock:  # must NOT report "lock after sem" — sem was handed back
+        pass
+    assert san.violations == [], [str(v) for v in san.violations]
+    assert san._stacks[threading.get_ident()] == []
+
+
+def test_instrument_locks_skips_asyncio_primitives():
+    """asyncio locks/semaphores duck-type acquire/release but acquire() is
+    a coroutine — a sync wrapper would return it un-awaited (truthy!) and
+    the permit count would never move, silently unbounding the batcher's
+    rings. They must not be swapped."""
+    import asyncio
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import (
+        InstrumentedLock,
+        instrument_locks,
+    )
+
+    class Mixed:
+        def __init__(self):
+            self._ring = asyncio.Semaphore(2)
+            self._mutex = threading.Lock()
+
+    obj = Mixed()
+    ring = obj._ring
+    with instrument_locks(obj):
+        assert obj._ring is ring  # untouched
+        assert isinstance(obj._mutex, InstrumentedLock)
+
+
+def test_instrument_locks_swaps_and_restores(warm_engine):
+    import threading
+
+    from mlops_tpu.analysis.lockcheck import (
+        InstrumentedLock,
+        instrument_locks,
+    )
+
+    original = warm_engine._acc_lock
+    with instrument_locks(warm_engine) as san:
+        assert isinstance(warm_engine._acc_lock, InstrumentedLock)
+        assert isinstance(warm_engine._compile_lock, InstrumentedLock)
+        warm_engine.monitor_snapshot()
+        assert san.acquired.get("_acc_lock", 0) >= 1
+        assert san.violations == []
+    assert warm_engine._acc_lock is original
+    assert isinstance(original, type(threading.Lock()))
 
 
 # ------------------------------------------------------------ Layer 2
@@ -235,16 +575,31 @@ def test_cli_analyze_nonzero_on_fixtures_and_zero_on_package(capsys):
 
     package = Path(__file__).parents[1] / "mlops_tpu"
     assert main(["analyze", "--no-trace", "--strict", str(package)]) == 0
+    # The CI gate shape minus the (slow) trace layer: concurrency rules
+    # and the stale-suppression audit are clean on the shipped package.
+    assert (
+        main(
+            ["analyze", "--no-trace", "--strict", "--concurrency",
+             "--fail-stale", str(package)]
+        )
+        == 0
+    )
 
 
 @pytest.mark.slow
 def test_cli_analyze_full_two_layer_gate(capsys):
-    """`mlops-tpu analyze --strict mlops_tpu/` — the exact CI invocation —
-    exits 0 with every entry point traced."""
+    """`mlops-tpu analyze --strict --concurrency --fail-stale mlops_tpu/`
+    — the exact CI invocation — exits 0 with every entry point traced."""
     from mlops_tpu.cli import main
 
     package = Path(__file__).parents[1] / "mlops_tpu"
-    assert main(["analyze", "--strict", str(package)]) == 0
+    assert (
+        main(
+            ["analyze", "--strict", "--concurrency", "--fail-stale",
+             str(package)]
+        )
+        == 0
+    )
     out = capsys.readouterr().out
     # One note per registered entry point (analysis/entrypoints.py) —
     # keep in lockstep with the trace-layer test's count above.
@@ -252,9 +607,12 @@ def test_cli_analyze_full_two_layer_gate(capsys):
 
 
 def test_rule_catalog_documented():
-    """Every rule ID (both layers) appears in docs/static-analysis.md."""
+    """Every rule ID (all three layers + the suppression audit) appears in
+    docs/static-analysis.md."""
+    from mlops_tpu.analysis import CONCURRENCY_RULES
+    from mlops_tpu.analysis.suppressions import STALE_RULE
     from mlops_tpu.analysis.traces import TRACE_RULES
 
     doc = (Path(__file__).parents[1] / "docs" / "static-analysis.md").read_text()
-    for rule in [*RULES, *TRACE_RULES]:
+    for rule in [*RULES, *CONCURRENCY_RULES, STALE_RULE, *TRACE_RULES]:
         assert rule in doc, f"{rule} missing from docs/static-analysis.md"

@@ -391,16 +391,26 @@ class _Proxy:
 # ------------------------------------------------------------- bench keys
 def test_bench_breakdown_and_monitor_keys(engine, sample_request):
     """The CI contract for the new bench keys: breakdown_ms carries
-    fetch/fetch_copy/fetch_sync (fetch = copy + sync) and the monitor
-    stage emits monitor_fetch_per_s — asserted against the real stage
-    functions, tier-1 (no subprocess bench run)."""
+    fetch/fetch_copy/fetch_sync (fetch = copy + sync), the batch-1 stage
+    emits lock_wait_ms (instrumented lock contention, PR 5), and the
+    monitor stage emits monitor_fetch_per_s — asserted against the real
+    stage functions, tier-1 (no subprocess bench run)."""
     import bench
 
     batch1 = bench._batch1_stage(engine, sample_request[0])
     bd = batch1["breakdown_ms"]
     assert {"encode", "dispatch", "fetch", "fetch_copy", "fetch_sync"} <= set(bd)
+    # Instrumented lock wait: finite, non-negative, and small on this
+    # uncontended single-caller loop (seconds would mean a lock held
+    # across blocking work leaked back into the hot path).
+    assert 0.0 <= batch1["lock_wait_ms"] < 1000.0
+    # fetch is the median of per-rep (copy + sync) while the sub-keys are
+    # per-stage medians — the two statistics drift apart whenever copy and
+    # sync jitter is correlated across reps, by tens of µs under load. The
+    # tolerance only needs to catch a STRUCTURAL break (a sub-stage
+    # dropped from the sum ≈ ms-scale), not scheduler noise.
     assert bd["fetch"] == pytest.approx(
-        bd["fetch_copy"] + bd["fetch_sync"], abs=0.002
+        bd["fetch_copy"] + bd["fetch_sync"], abs=0.2
     )
     monitor = bench._monitor_stage(engine)
     assert monitor["monitor_fetch_per_s"] > 0

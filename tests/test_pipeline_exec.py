@@ -65,38 +65,75 @@ def test_executor_backpressure_bounds_in_flight_items():
 @pytest.mark.parametrize("where", ["source", "stage", "batch-stage", "sink"])
 def test_executor_failure_propagates_and_drains(where):
     """The ORIGINAL exception must reach the caller from any position, with
-    every worker thread joined (no hung threads, no blocked producers)."""
+    every worker thread joined (no hung threads, no blocked producers) —
+    under SEEDED SCHEDULE PERTURBATION (analysis/lockcheck.py): each seed
+    shifts which stages are mid-flight when the failure lands, so the
+    drain path is exercised across genuinely different interleavings."""
+    from mlops_tpu.analysis.lockcheck import SchedulePerturber
 
-    def src():
-        for i in range(50):
-            if where == "source" and i == 10:
-                raise ValueError("boom in source")
-            yield i
+    for seed in (0, 1, 2):
+        perturber = SchedulePerturber(seed, max_delay_s=0.0005)
 
-    def mid(x):
-        if where == "stage" and x == 10:
-            raise ValueError("boom in stage")
-        return x
+        def src():
+            for i in range(50):
+                if where == "source" and i == 10:
+                    raise ValueError("boom in source")
+                yield i
 
-    def batch(xs):
-        if where == "batch-stage" and 10 in xs:
-            raise ValueError("boom in batch-stage")
-        return xs
+        def mid(x):
+            if where == "stage" and x == 10:
+                raise ValueError("boom in stage")
+            return x
 
-    def sink(x):
-        if where == "sink" and x == 10:
-            raise ValueError("boom in sink")
+        def batch(xs):
+            if where == "batch-stage" and 10 in xs:
+                raise ValueError("boom in batch-stage")
+            return xs
 
-    before = threading.active_count()
-    with pytest.raises(ValueError, match="boom"):
-        run_pipeline(
-            src(),
-            [Stage("mid", mid), Stage("batch", batch, batch_max=4)],
-            sink,
+        def sink(x):
+            if where == "sink" and x == 10:
+                raise ValueError("boom in sink")
+
+        before = threading.active_count()
+        with pytest.raises(ValueError, match="boom"):
+            run_pipeline(
+                src(),
+                [
+                    Stage("mid", perturber.wrap(mid)),
+                    Stage("batch", perturber.wrap(batch), batch_max=4),
+                ],
+                perturber.wrap(sink),
+                depth=3,
+            )
+        # run_pipeline joins its workers before re-raising.
+        assert threading.active_count() == before, f"seed {seed} leaked"
+
+
+def test_executor_perturbed_schedules_bit_identical_across_seeds():
+    """Three seeded schedules, one answer: random per-stage delays shift
+    thread interleavings (and batch-gather groupings) run to run, while
+    FIFO ordering must keep the output BIT-IDENTICAL to the serial loop."""
+    from mlops_tpu.analysis.lockcheck import SchedulePerturber
+
+    expected = [-(x * x) for x in range(150)]
+    for seed in (0, 1, 2):
+        perturber = SchedulePerturber(seed, max_delay_s=0.0005)
+        out = []
+        stats = run_pipeline(
+            range(150),
+            [
+                Stage("sq", perturber.wrap(lambda x: x * x)),
+                Stage(
+                    "neg",
+                    perturber.wrap(lambda xs: [-x for x in xs]),
+                    batch_max=4,
+                ),
+            ],
+            perturber.wrap(out.append),
             depth=3,
         )
-    # run_pipeline joins its workers before re-raising.
-    assert threading.active_count() == before
+        assert out == expected, f"seed {seed} output diverged"
+        assert stats.items == 150
 
 
 def test_executor_batch_stage_is_grouping_invariant():
