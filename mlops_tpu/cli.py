@@ -53,6 +53,16 @@ def build_parser() -> argparse.ArgumentParser:
                 default=None,
                 help="cache directory (sugar for cache.dir=<dir>)",
             )
+        if name == "serve":
+            p.add_argument(
+                "--workers",
+                type=int,
+                default=None,
+                help="HTTP front-end processes (sugar for serve.workers=N): "
+                "N >= 2 binds one port from N processes via SO_REUSEPORT, "
+                "all feeding one engine process over the shared-memory "
+                "ring; 0/1 = single-process server",
+            )
     # `analyze` takes paths + flags, not config overrides: static analysis
     # must run identically with zero configuration (CI, pre-commit).
     analyze = sub.add_parser(

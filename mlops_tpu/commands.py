@@ -28,6 +28,10 @@ def run(args: argparse.Namespace) -> int:
     # form is the documented container-build invocation).
     if getattr(args, "cache_dir", None):
         config.cache.dir = args.cache_dir
+    # `serve --workers N` is sugar for `serve serve.workers=N` (the flag
+    # form is the documented deployment invocation).
+    if getattr(args, "workers", None) is not None:
+        config.serve.workers = args.workers
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
@@ -495,6 +499,17 @@ def _serve(config) -> int:
     config.serve.service_name = os.environ.get(
         "SERVICE_NAME", config.serve.service_name
     )
+    # Inconsistent worker/ring geometry fails the rollout HERE with the
+    # constraint named (ServeConfigError), before anything binds or warms.
+    config.serve.validate()
+    if config.serve.workers > 1:
+        # Multi-worker plane: N SO_REUSEPORT front-end processes feeding
+        # this process's engine over the shared-memory ring
+        # (serve/frontend.py). The front ends fork inside
+        # serve_multi_worker BEFORE the bundle/backend loads.
+        from mlops_tpu.serve.frontend import serve_multi_worker
+
+        return serve_multi_worker(config, _resolve_bundle(config, model_dir))
     from mlops_tpu.compilecache.cache import from_config
 
     bundle = load_bundle(_resolve_bundle(config, model_dir))

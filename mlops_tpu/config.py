@@ -169,10 +169,34 @@ class MonitorConfig:
     drift_ref_size: int = 2048  # per-feature reference sample for K-S
 
 
+class ServeConfigError(ValueError):
+    """An inconsistent serving geometry, named at startup.
+
+    Raised by ``ServeConfig.validate()`` for ring/worker shapes that the
+    server used to clamp silently into locals — a deployment that asked
+    for ``max_inflight=8`` on a 4-thread pool now fails its rollout with
+    the constraint spelled out instead of quietly serving with different
+    numbers than its config says."""
+
+
 @dataclasses.dataclass
 class ServeConfig:
     host: str = "0.0.0.0"
     port: int = 5000  # parity: `app/Dockerfile:22-24`
+    workers: int = 0  # HTTP front-end PROCESSES. 0/1 = the single-process
+    # asyncio server (serve/server.py). >= 2 = the multi-worker plane
+    # (serve/frontend.py): N processes each bind the same port via
+    # SO_REUSEPORT (kernel load-balances accepts), parse/validate/encode
+    # requests, and feed ONE engine process over the zero-copy
+    # shared-memory ring (serve/ipc.py). Linux-only (SO_REUSEPORT + fork)
+    ring_slots_small: int = 64  # per-front-end request slots whose slab
+    # holds up to GROUP_ROW_BUCKET rows (the coalescable class — batch-1
+    # traffic rides these). Slots bound admission: a front end with no
+    # free slot sheds 503 + Retry-After instead of queueing unboundedly
+    ring_slots_large: int = 4  # per-front-end slots sized at max_batch
+    # rows (the solo class; small requests may overflow into them, large
+    # requests never take a small slot)
+    shed_retry_after_s: int = 1  # Retry-After header on shed 503s
     service_name: str = "credit-default-api"
     model_directory: str = "model"  # parity: MODEL_DIRECTORY (`app/main.py:27`)
     max_batch: int = 256  # request-size cap; must equal the largest warmed
@@ -210,6 +234,49 @@ class ServeConfig:
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable
     # with serve.profile_dir=/tmp/profile when debugging a pod
+
+    def validate(self) -> "ServeConfig":
+        """Reject inconsistent worker/ring geometries at startup.
+
+        One named error per broken invariant (``ServeConfigError``)
+        instead of the ad-hoc warn-and-clamp that used to live in server
+        locals: a config that says one thing while the server runs
+        another is exactly the silent degradation this gate exists to
+        stop. Returns self so call sites can chain."""
+        problems: list[str] = []
+        if self.max_workers < 1:
+            problems.append(f"serve.max_workers={self.max_workers} must be >= 1")
+        if self.max_batch < 1:
+            problems.append(f"serve.max_batch={self.max_batch} must be >= 1")
+        inflight_cap = max(1, self.max_workers - 2)
+        if not 1 <= self.max_inflight <= inflight_cap:
+            problems.append(
+                f"serve.max_inflight={self.max_inflight} outside "
+                f"[1, max(1, serve.max_workers - 2) = {inflight_cap}]: the "
+                "dispatch bound, the fetch ring, and one thread of headroom "
+                "(solo fast path / monitor fetch) must fit the predict pool "
+                "— raise serve.max_workers or lower serve.max_inflight"
+            )
+        if self.workers < 0:
+            problems.append(f"serve.workers={self.workers} must be >= 0")
+        if self.workers > 1:
+            if self.ring_slots_small < 1 or self.ring_slots_large < 1:
+                problems.append(
+                    f"serve.ring_slots_small={self.ring_slots_small} / "
+                    f"serve.ring_slots_large={self.ring_slots_large} must "
+                    "each be >= 1 with serve.workers > 1 (every front end "
+                    "needs at least one slot per bucket class, or whole "
+                    "request classes would shed 100%)"
+                )
+            if self.shed_retry_after_s < 1:
+                problems.append(
+                    f"serve.shed_retry_after_s={self.shed_retry_after_s} "
+                    "must be >= 1 (the shed 503 contract promises a "
+                    "positive Retry-After)"
+                )
+        if problems:
+            raise ServeConfigError("; ".join(problems))
+        return self
 
 
 @dataclasses.dataclass

@@ -16,7 +16,24 @@ percentiles, jit warmup over fixed batch buckets, and micro-batch padding so
 steady-state serving never recompiles.
 """
 
-from mlops_tpu.serve.engine import InferenceEngine
-from mlops_tpu.serve.server import HttpServer, serve_forever
+# LAZY exports: `serve.engine`/`serve.server` pull jax at import time,
+# but the multi-worker front-end processes (serve/frontend.py) import
+# sibling modules (httpcore, ipc, wire, metrics) from this package and
+# must stay jax-free — an eager import here would drag the whole backend
+# into every forked worker.
+_EXPORTS = {
+    "InferenceEngine": "mlops_tpu.serve.engine",
+    "HttpServer": "mlops_tpu.serve.server",
+    "serve_forever": "mlops_tpu.serve.server",
+}
 
 __all__ = ["HttpServer", "InferenceEngine", "serve_forever"]
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -19,11 +19,14 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
-from mlops_tpu.serve.engine import (
-    GROUP_ROW_BUCKET,
-    GROUP_SLOT_BUCKETS,
-    InferenceEngine,
-)
+from mlops_tpu.serve.engine import InferenceEngine
+
+# The coalescing policy constants come from the jax-free wire-contract
+# module shared with the multi-worker plane: the shared-memory ring
+# service (serve/ipc.py RingService) applies the SAME small-request
+# grouping rule engine-side, so one process or N, identical requests
+# ride identical compiled shapes.
+from mlops_tpu.serve.wire import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
 
 # Declared order for the two-phase rings, OUTERMOST FIRST (tpulint Layer 3
 # manifest — analysis/concurrency.py / lockcheck.py): the fetch ring is

@@ -99,21 +99,18 @@ class _GroupHandle:
         if self.out is not None:
             _start_copy(self.out)
 
-# Micro-batching shape grid: concurrent requests coalesce into [R, B, ...]
-# stacks — R request-slots (padded up to a slot bucket), each padded to B
-# rows. Only small requests coalesce; big ones already fill the MXU alone.
-# Slot buckets go to 64: on a remote-attached chip every dispatch pays a
-# flat transport round trip (measured ~70-90 ms through this harness's
-# tunnel), so request throughput scales with requests-per-dispatch — 64
-# batch-1 requests in one vmapped program cost the same wall time as one.
-# Row buckets are (1, 8): batch-1 is the dominant serving shape and
-# padding it to 8 rows made every grouped dispatch compute 8x the rows it
-# returned — on CPU backends (serial compute) that padding was the
-# throughput ceiling. An all-batch-1 group now rides the [R, 1, ...]
-# family; mixed small sizes pad to 8 as before.
-GROUP_SLOT_BUCKETS = (2, 4, 8, 16, 32, 64)
-GROUP_ROW_BUCKETS = (1, 8)
-GROUP_ROW_BUCKET = GROUP_ROW_BUCKETS[-1]
+# Group geometry + response formatting live in the jax-free wire-contract
+# module (serve/wire.py) so front-end processes can share them without
+# this module's jax import; re-exported here because the batcher, the
+# tests, and the compile-cache warmers have always imported them from the
+# engine.
+from mlops_tpu.serve.wire import (  # noqa: E402, F401  (re-exports)
+    GROUP_ROW_BUCKET,
+    GROUP_ROW_BUCKETS,
+    GROUP_SLOT_BUCKETS,
+    empty_response,
+    format_response,
+)
 
 
 class InferenceEngine:
@@ -446,11 +443,7 @@ class InferenceEngine:
         if handle is None:
             # Empty request: nothing to score, no drift signal (an empty
             # batch must not poison the drift gauges with statistic=1).
-            return {
-                "predictions": [],
-                "outliers": [],
-                "feature_drift_batch": dict.fromkeys(SCHEMA.feature_names, 0.0),
-            }
+            return empty_response()
         handle.start_copy()
         return self.fetch_arrays(handle)
 
@@ -495,6 +488,16 @@ class InferenceEngine:
         seed's 3-leaf tree fetch paid a device->host transfer per leaf
         (~70-90 ms each through the remote-chip tunnel — measured), the
         packed buffer pays exactly one."""
+        return format_response(*self.fetch_arrays_raw(handle))
+
+    def fetch_arrays_raw(
+        self, handle: _ArraysHandle
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The fetch minus the dict/list formatting: ``(predictions f64[n],
+        outliers f64[n], drift f64[D] rounded)`` — exactly what
+        `format_response` turns into the wire dict. The shared-memory ring
+        service (serve/ipc.py) writes these arrays straight into response
+        slabs so the front-end processes format the identical floats."""
         n, rows = handle.n, handle.rows
         if handle.packed:
             arr = np.asarray(handle.out)
@@ -507,13 +510,11 @@ class InferenceEngine:
             predictions = np.asarray(out["predictions"])[:n]
             outliers = np.asarray(out["outliers"])[:n]
             drift = np.asarray(out["feature_drift_batch"])
-        return {
-            "predictions": predictions.astype(float).tolist(),
-            "outliers": outliers.astype(float).tolist(),
-            "feature_drift_batch": dict(
-                zip(SCHEMA.feature_names, drift.astype(float).round(6).tolist())
-            ),
-        }
+        return (
+            predictions.astype(float),
+            outliers.astype(float),
+            drift.astype(float).round(6),
+        )
 
     # ----------------------------------------------------- grouped predict
     def predict_group(
@@ -548,9 +549,44 @@ class InferenceEngine:
                 f"grouped requests must have 1..{GROUP_ROW_BUCKET} records, "
                 f"got sizes {sizes}"
             )
+        # ONE encode pass over the whole group, split back into per-request
+        # views: encoding is row-wise (vocab lookup + standardization), so
+        # the flat encode is bit-identical to per-request encodes while
+        # doing the Python/dict work once instead of per request — this
+        # host work is serial (GIL) and sits on the grouped hot path.
+        flat = [record for records in requests for record in records]
+        ds = self.bundle.preprocessor.encode(records_to_columns(flat))
+        parts, offset = [], 0
+        for n in sizes:
+            parts.append(
+                (ds.cat_ids[offset : offset + n], ds.numeric[offset : offset + n])
+            )
+            offset += n
+        return self.dispatch_group_arrays(parts)
 
+    def dispatch_group_arrays(
+        self, parts: list[tuple[np.ndarray, np.ndarray]]
+    ) -> _GroupHandle:
+        """Grouped dispatch from PRE-ENCODED per-request arrays — the entry
+        the shared-memory ring service uses (serve/ipc.py): front-end
+        processes encode before enqueue (the native encoder releases the
+        GIL there), so the engine process scatters rows straight into the
+        group buffers without touching records or the preprocessor.
+        Requires 2..GROUP_SLOT_BUCKETS[-1] requests of 1..GROUP_ROW_BUCKET
+        rows each (the callers' coalescing policy guarantees it)."""
+        sizes = [cat.shape[0] for cat, _ in parts]
+        if not 2 <= len(parts) <= GROUP_SLOT_BUCKETS[-1]:
+            raise ValueError(
+                f"grouped dispatch takes 2..{GROUP_SLOT_BUCKETS[-1]} "
+                f"requests, got {len(parts)}"
+            )
+        if not all(1 <= n <= GROUP_ROW_BUCKET for n in sizes):
+            raise ValueError(
+                f"grouped requests must have 1..{GROUP_ROW_BUCKET} records, "
+                f"got sizes {sizes}"
+            )
         slots = GROUP_SLOT_BUCKETS[
-            bisect.bisect_left(GROUP_SLOT_BUCKETS, len(requests))
+            bisect.bisect_left(GROUP_SLOT_BUCKETS, len(parts))
         ]
         # Batch-1-only groups (the dominant serving traffic) take the
         # [slots, 1] shape family — no row padding, ~8x less compute per
@@ -559,19 +595,11 @@ class InferenceEngine:
         cat = np.zeros((slots, rows, SCHEMA.num_categorical), np.int32)
         num = np.zeros((slots, rows, SCHEMA.num_numeric), np.float32)
         mask = np.zeros((slots, rows), bool)
-        # ONE encode pass over the whole group, scattered into slots:
-        # encoding is row-wise (vocab lookup + standardization), so the
-        # flat encode is bit-identical to per-request encodes while doing
-        # the Python/dict work once instead of per request — this host
-        # work is serial (GIL) and sits on the grouped hot path.
-        flat = [record for records in requests for record in records]
-        ds = self.bundle.preprocessor.encode(records_to_columns(flat))
-        offset = 0
-        for i, n in enumerate(sizes):
-            cat[i, :n] = ds.cat_ids[offset : offset + n]
-            num[i, :n] = ds.numeric[offset : offset + n]
+        for i, (part_cat, part_num) in enumerate(parts):
+            n = sizes[i]
+            cat[i, :n] = part_cat
+            num[i, :n] = part_num
             mask[i, :n] = True
-            offset += n
 
         out = self._dispatch_fused(
             ("group", slots, rows), self._predict_group, cat, num, mask
@@ -585,26 +613,35 @@ class InferenceEngine:
         group) and slice it back into per-request responses."""
         if handle.responses is not None:
             return handle.responses
-        sizes, rows = handle.sizes, handle.rows
+        sizes, preds, outs, drifts = self.fetch_group_raw(handle)
+        return [
+            format_response(preds[i, :n], outs[i, :n], drifts[i])
+            for i, n in enumerate(sizes)
+        ]
+
+    def fetch_group_raw(
+        self, handle: _GroupHandle
+    ) -> tuple[list[int], np.ndarray, np.ndarray, np.ndarray]:
+        """The grouped fetch minus the per-request dict building:
+        ``(sizes, predictions f64[slots, rows], outliers f64[slots, rows],
+        drift f64[slots, D] rounded)``. Degenerate handles (solo fallback
+        responses) never reach here — the ring service only groups through
+        `dispatch_group_arrays`."""
+        if handle.responses is not None:
+            raise ValueError("degenerate group handle carries formatted "
+                             "responses; fetch_group owns that path")
+        rows = handle.rows
         arr = np.asarray(handle.out)  # [slots, 2*rows + D]
         # Response assembly is serial host Python on the grouped hot path:
         # do the dtype casts/rounding ONCE over the stacked arrays, then
         # slice per slot (per-slot .astype/.round cost ~3x more).
         p, o, d = packed_layout(rows)
-        preds = arr[:, p].astype(float)
-        outs = arr[:, o].astype(float)
-        drifts = arr[:, d].astype(float).round(6)
-        names = SCHEMA.feature_names
-        responses = []
-        for i, n in enumerate(sizes):
-            responses.append(
-                {
-                    "predictions": preds[i, :n].tolist(),
-                    "outliers": outs[i, :n].tolist(),
-                    "feature_drift_batch": dict(zip(names, drifts[i].tolist())),
-                }
-            )
-        return responses
+        return (
+            handle.sizes,
+            arr[:, p].astype(float),
+            arr[:, o].astype(float),
+            arr[:, d].astype(float).round(6),
+        )
 
     def _bucket_for(self, n: int) -> int | None:
         i = bisect.bisect_left(self.buckets, n)

@@ -1,0 +1,482 @@
+"""Multi-worker server plane: SO_REUSEPORT front ends + one engine.
+
+``mlops-tpu serve --workers N`` (serve.workers >= 2) replaces the
+single-process asyncio server with N front-end PROCESSES that each bind
+the same host:port through ``SO_REUSEPORT`` — the kernel load-balances
+accepted connections across them, so HTTP parsing, pydantic validation,
+JSON serialization, and feature ENCODING (the native C++ encoder) run on
+N cores instead of fighting one GIL — all feeding ONE engine process
+over the zero-copy shared-memory ring (`serve/ipc.py`). The engine
+process owns everything expensive exactly once: the compile cache, the
+warmed exec tables, the device monitor accumulator.
+
+Process model (Linux): the parent builds the ring and reserves the port,
+FORKS the front ends BEFORE initializing any backend (children inherit
+the mmap + doorbells and never touch jax), then loads the bundle, warms
+the engine, and runs the ring service. Front ends restart freely — a
+crashed worker is respawned by the supervisor loop and re-attaches to
+its slot partition via the shm generation counters; the engine process
+is the one that must stay up (docs/operations.md "Multi-worker plane").
+
+Load shedding: each front end's slot partition is its bounded admission
+queue, per bucket class (small/coalescable vs large/solo). No free slot
+=> immediate ``503`` with ``Retry-After`` — overload degrades into fast
+rejections while admitted requests keep their latency, instead of an
+unbounded queue melting p99 (the fleet-goodput framing of PAPERS.md
+arXiv 2502.06982).
+
+Graceful drain: SIGTERM to the parent forwards to every front end; each
+stops accepting, finishes in-flight exchanges, and exits; the parent
+then drains the ring service (every accepted slot still gets its
+response) and exits 0. The engine survives front-end churn by
+construction — it never blocks on front-end state.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import multiprocessing
+import os
+import signal
+import socket
+import time
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.config import Config, ServeConfig
+from mlops_tpu.serve.httpcore import HttpProtocol, _LazyJson
+from mlops_tpu.serve.ipc import RequestRing, RingClient, RingService, ShmWorkerMetrics
+from mlops_tpu.serve.metrics import render_ring_metrics
+from mlops_tpu.serve.wire import empty_response, format_response
+
+logger = logging.getLogger("mlops_tpu.serve")
+
+
+def reuseport_socket(host: str, port: int) -> socket.socket:
+    """A bound (not listening) TCP socket with SO_REUSEPORT: every front
+    end binds its own; the kernel hashes incoming connections across all
+    LISTENING sockets on the tuple. The parent binds one too — never
+    listening — purely to pin the port (port=0 resolution, respawn
+    safety)."""
+    if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover - non-Linux
+        raise OSError("SO_REUSEPORT is not available on this platform")
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    sock.bind((host, port))
+    return sock
+
+
+class FrontendServer(HttpProtocol):
+    """The ring-backed front end: the same HTTP protocol, validation, and
+    two-event logging as the single-process server, with the engine call
+    replaced by claim slot -> write pre-encoded arrays -> await the
+    completion doorbell -> format the raw response arrays (the identical
+    `format_response` the engine-side fetch uses, so responses are
+    bit-identical to the single-process path)."""
+
+    def __init__(
+        self,
+        config: ServeConfig,
+        ring: RequestRing,
+        worker_id: int,
+        preprocessor: Any,
+    ) -> None:
+        super().__init__(config)
+        self.ring = ring
+        self.worker_id = worker_id
+        self.preprocessor = preprocessor
+        self.client = RingClient(ring, worker_id)
+        self.metrics = ShmWorkerMetrics(ring, worker_id)
+        # The ring's large slabs are sized by the parent to the (possibly
+        # bucket-clamped) request cap; the slab capacity is the contract.
+        self.max_batch = min(config.max_batch, ring.large_rows)
+        # Encoding runs in a tiny thread pool: the native C++ encoder
+        # releases the GIL, and a 256-row encode would otherwise stall
+        # the accept loop.
+        import concurrent.futures
+
+        self._encode_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix=f"encode-w{worker_id}"
+        )
+
+    # ------------------------------------------------------------- routes
+    def _ready(self) -> bool:
+        return self.ring.engine_ready and not self.draining
+
+    async def _metrics_endpoint(self):
+        # Every gauge renders straight from shared memory — all workers'
+        # request/latency blocks, the ring depth/shed counters, and the
+        # engine-process monitor aggregate (single-flight in the engine's
+        # telemetry loop; a front end never touches the device). Any
+        # worker can serve the scrape with the full fleet view, which is
+        # what SO_REUSEPORT requires: Prometheus lands on a random one.
+        return (
+            200,
+            render_ring_metrics(self.ring),
+            "text/plain; version=0.0.4",
+        )
+
+    async def _score(self, record_dicts: list[dict], request_id: str):
+        """The ring-backed scoring hook under the shared `_predict` shell
+        (serve/httpcore.py): admission first, then encode, then the slot
+        round trip."""
+        if not record_dicts:
+            return empty_response()
+        from mlops_tpu.schema import records_to_columns
+
+        n = len(record_dicts)
+        # ADMISSION BEFORE ENCODE: a to-be-shed request must cost nothing
+        # — the row count is known from the validated records, so the
+        # shed 503 never queues through (or wastes) the encode pool, and
+        # its latency stays flat no matter how deep the overload.
+        slot = self.client.claim(n)
+        if slot is None:
+            # Bounded admission per bucket class: shed FAST with a
+            # Retry-After instead of queueing — the slots free up as
+            # in-flight responses land, so a well-behaved client's retry
+            # lands in capacity.
+            self.client.count_shed(n)
+            retry_s = self.config.shed_retry_after_s
+            return (
+                503,
+                {
+                    "detail": "overloaded: no free "
+                    f"{'small' if n <= self.ring.small_rows else 'large'} "
+                    f"request slot; retry in {retry_s}s"
+                },
+                "application/json",
+                {"retry-after": str(retry_s)},
+            )
+        submitted = False
+        try:
+            loop = asyncio.get_running_loop()
+            # Encode BEFORE enqueue (the tentpole's division of labor):
+            # the engine process receives ready-to-scatter arrays and
+            # spends its cycles on device dispatch only. The native
+            # encoder releases the GIL, so the pool keeps the accept loop
+            # responsive through a 256-row encode.
+            ds = await loop.run_in_executor(
+                self._encode_pool,
+                lambda: self.preprocessor.encode(
+                    records_to_columns(record_dicts)
+                ),
+            )
+            future = self.client.submit(slot, ds.cat_ids, ds.numeric)
+            submitted = True
+            timeout = self.config.request_timeout_s
+            try:
+                if timeout:
+                    status = await asyncio.wait_for(future, timeout)
+                else:
+                    status = await future
+            except asyncio.TimeoutError:
+                logger.error(
+                    "prediction deadline (%.1fs) exceeded request_id=%s — "
+                    "engine stall?",
+                    timeout,
+                    request_id,
+                )
+                self.client.abandon(slot)
+                slot = None
+                return (
+                    503,
+                    {
+                        "detail": f"prediction exceeded the "
+                        f"{timeout:g}s deadline"
+                    },
+                    "application/json",
+                )
+            if status != 0:
+                # The engine process logged the traceback; the wire
+                # contract matches the single-process 500.
+                self.client.release(slot)
+                slot = None
+                return 500, {"detail": "prediction failed"}, "application/json"
+            pred, out, drift = self.client.response_arrays(slot)
+            # format_response materializes Python floats, so the slab is
+            # quiescent before release.
+            response = format_response(
+                np.array(pred), np.array(out), np.array(drift)
+            )
+            self.client.release(slot)
+            slot = None
+            return response
+        # Top-of-handler boundary (same contract as the single-process
+        # server): ANY failure becomes a logged 500, never a dropped
+        # connection or a leaked slot.
+        except Exception:  # tpulint: disable=TPU201
+            logger.exception("prediction failed request_id=%s", request_id)
+            if slot is not None:
+                if submitted:
+                    self.client.abandon(slot)
+                else:
+                    self.client.release(slot)
+            return 500, {"detail": "prediction failed"}, "application/json"
+
+    # ---------------------------------------------------------- lifecycle
+    async def start(self) -> asyncio.AbstractServer:
+        """Bind this worker's own SO_REUSEPORT socket and hook the
+        completion doorbell into the event loop."""
+        sock = reuseport_socket(self.config.host, self.config.port)
+        loop = asyncio.get_running_loop()
+        loop.add_reader(
+            self.ring.worker_doorbells[self.worker_id].fileno(),
+            self.client.on_doorbell,
+        )
+        return await asyncio.start_server(self.handle_connection, sock=sock)
+
+    def stop_doorbell(self) -> None:
+        with contextlib.suppress(Exception):
+            asyncio.get_running_loop().remove_reader(
+                self.ring.worker_doorbells[self.worker_id].fileno()
+            )
+
+
+# --------------------------------------------------------------- children
+def _frontend_main(
+    worker_id: int,
+    config: ServeConfig,
+    ring: RequestRing,
+    preprocess_path: str,
+) -> None:
+    """Front-end child process entry (forked — everything arrives by
+    inheritance). Never imports jax, never touches the device."""
+    from mlops_tpu.data.encode import Preprocessor
+
+    preprocessor = Preprocessor.load(preprocess_path)
+    try:
+        asyncio.run(_run_frontend(worker_id, config, ring, preprocessor))
+    except KeyboardInterrupt:  # pragma: no cover - interactive stop
+        pass
+
+
+async def _run_frontend(
+    worker_id: int, config: ServeConfig, ring: RequestRing, preprocessor
+) -> None:
+    server = FrontendServer(config, ring, worker_id, preprocessor)
+    srv = await server.start()
+    logger.info(
+        "frontend %d serving %s on %s:%s (pid %d)",
+        worker_id, config.service_name, config.host, config.port, os.getpid(),
+    )
+    loop = asyncio.get_running_loop()
+    draining = asyncio.Event()
+
+    def _drain(signum=None, frame=None) -> None:
+        server.draining = True
+        draining.set()
+        srv.close()
+        for w in list(server._connections - server._busy):
+            w.close()  # idle keep-alive readers see EOF; handlers exit
+
+    with contextlib.suppress(NotImplementedError, RuntimeError):
+        loop.add_signal_handler(signal.SIGTERM, _drain)
+        loop.add_signal_handler(signal.SIGINT, _drain)
+
+    parent = os.getppid()
+
+    async def _watch_plane() -> None:
+        # Two drain triggers besides the direct SIGTERM: the engine
+        # flipping the ring's shared drain flag (a front end forked
+        # mid-drain, or a missed signal), and a DEAD engine process — no
+        # response will ever arrive for a submitted slot, so drain
+        # immediately rather than serving timeouts.
+        while not draining.is_set():
+            await asyncio.sleep(1.0)
+            if ring.draining:
+                logger.info("frontend %d: ring drain flag set; draining",
+                            worker_id)
+                _drain()
+            elif os.getppid() != parent:
+                logger.error("frontend %d: engine process died; draining",
+                             worker_id)
+                _drain()
+
+    watchdog = asyncio.create_task(_watch_plane())
+    await draining.wait()
+    # Busy exchanges get a bounded window to finish their responses and
+    # in-flight ring slots to land (the kubelet's grace period is the
+    # hard stop).
+    deadline = loop.time() + 30.0
+    while (server._busy or server.client.pending_count()) and (
+        loop.time() < deadline
+    ):
+        await asyncio.sleep(0.05)
+    for w in list(server._connections):
+        w.close()
+    server.stop_doorbell()
+    watchdog.cancel()
+    with contextlib.suppress(asyncio.TimeoutError):
+        await asyncio.wait_for(srv.wait_closed(), timeout=5)
+    logger.info("frontend %d drained; exiting", worker_id)
+
+
+def start_frontends(
+    config: ServeConfig,
+    ring: RequestRing,
+    preprocess_path: str,
+) -> list[multiprocessing.Process]:
+    """Fork one front-end process per worker (call BEFORE any jax backend
+    initializes in the parent — the children inherit a clean world)."""
+    return [
+        _respawn(config, ring, preprocess_path, worker_id)
+        for worker_id in range(ring.workers)
+    ]
+
+
+# ----------------------------------------------------------------- parent
+def serve_multi_worker(config: Config, bundle_dir: str) -> int:
+    """Parent orchestration: ring -> fork front ends -> engine -> serve.
+
+    Order matters: the front ends fork BEFORE the bundle loads so no
+    backend state (device handles, runtime threads) crosses the fork;
+    the parent then becomes the engine process. Respawned front ends
+    (supervisor loop) do fork from the jax-initialized parent — safe
+    because the children never execute jax code paths — but the common
+    case forks from the clean pre-backend world.
+    """
+    from pathlib import Path
+
+    serve_cfg = config.serve.validate()
+    if not hasattr(os, "fork") or not hasattr(socket, "SO_REUSEPORT"):
+        raise SystemExit(
+            "serve.workers > 1 needs fork + SO_REUSEPORT (Linux); run "
+            "single-process (serve.workers=0) on this platform"
+        )
+    preprocess_path = str(Path(bundle_dir) / "preprocess.npz")
+    if not Path(preprocess_path).is_file():
+        raise SystemExit(f"no preprocessor at {preprocess_path}")
+
+    # Same invariant the single-process server clamps at runtime: the
+    # request cap must not exceed the largest warmed bucket, or
+    # steady-state traffic triggers exact-shape compiles on the serving
+    # hot path. Front ends cannot see the engine, but the bucket grid IS
+    # config here (warmup_batch_sizes feeds the engine below), so clamp
+    # BEFORE sizing slabs and forking — the children enforce the clamped
+    # cap via their 413 gate.
+    max_batch = serve_cfg.max_batch
+    max_bucket = max(serve_cfg.warmup_batch_sizes)
+    if max_batch > max_bucket:
+        logger.warning(
+            "serve.max_batch=%d exceeds largest warmup bucket %d; clamping",
+            max_batch,
+            max_bucket,
+        )
+        max_batch = max_bucket
+
+    ring = RequestRing(
+        workers=serve_cfg.workers,
+        slots_small=serve_cfg.ring_slots_small,
+        slots_large=serve_cfg.ring_slots_large,
+        large_rows=max_batch,
+    )
+    # Reserve the port once (also resolves port=0), then hand the concrete
+    # port to every child; the placeholder never listens, so the kernel
+    # routes nothing to it.
+    placeholder = reuseport_socket(serve_cfg.host, serve_cfg.port)
+    import dataclasses
+
+    child_cfg = dataclasses.replace(
+        serve_cfg, port=placeholder.getsockname()[1], max_batch=max_batch
+    )
+    procs = start_frontends(child_cfg, ring, preprocess_path)
+    logger.info(
+        "serving %s on %s:%s with %d SO_REUSEPORT front ends (pids %s)",
+        serve_cfg.service_name, child_cfg.host, child_cfg.port,
+        len(procs), [p.pid for p in procs],
+    )
+
+    stopping = {"sigterm": False}
+
+    def _sigterm(signum, frame=None) -> None:
+        stopping["sigterm"] = True
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+
+    service = None
+    try:
+        # ---- the parent becomes the engine process ----
+        from mlops_tpu.bundle import load_bundle
+        from mlops_tpu.compilecache.cache import from_config
+        from mlops_tpu.serve.engine import InferenceEngine
+
+        bundle = load_bundle(bundle_dir)
+        engine = InferenceEngine(
+            bundle,
+            buckets=tuple(serve_cfg.warmup_batch_sizes),
+            service_name=serve_cfg.service_name,
+            enable_grouping=serve_cfg.batch_window_ms > 0,
+            compile_cache=from_config(config),
+            warmup_workers=config.cache.warmup_workers,
+        )
+        service = RingService(
+            engine,
+            ring,
+            max_group=serve_cfg.max_group,
+            max_inflight=serve_cfg.max_inflight,
+            threads=serve_cfg.max_workers,
+            monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
+            monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
+        )
+        # Service first, then warmup: early requests AOT-compile on
+        # demand exactly like the single-process bind-first model, and
+        # /healthz/ready flips when every bucket is compiled.
+        service.start()
+        engine.warmup()
+        ring.set_ready(True)
+        logger.info(
+            "warmup complete; ready %s",
+            _LazyJson(getattr(engine, "warmup_stats", {})),
+        )
+
+        # ---- supervise: respawn crashed front ends until SIGTERM ----
+        while not stopping["sigterm"]:
+            time.sleep(0.5)
+            for i, proc in enumerate(procs):
+                if proc.is_alive() or stopping["sigterm"]:
+                    continue
+                logger.error(
+                    "frontend %d (pid %s) died with exit code %s; respawning",
+                    i, proc.pid, proc.exitcode,
+                )
+                procs[i] = _respawn(child_cfg, ring, preprocess_path, i)
+        return 0
+    finally:
+        # ---- graceful drain ----
+        ring.set_draining()
+        ring.set_ready(False)
+        for proc in procs:
+            if proc.is_alive() and proc.pid:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(proc.pid, signal.SIGTERM)
+        for proc in procs:
+            proc.join(timeout=35)
+            if proc.is_alive():  # pragma: no cover - stuck child
+                proc.terminate()
+                proc.join(timeout=5)
+        if service is not None:
+            service.stop()
+        placeholder.close()
+        ring.close()
+        logger.info("multi-worker plane drained; exiting")
+
+
+def _respawn(
+    config: ServeConfig, ring: RequestRing, preprocess_path: str, worker_id: int
+) -> multiprocessing.Process:
+    """Fork a replacement front end for one worker slot partition (the
+    generation counters in shm make any of the dead worker's in-flight
+    completions stale on arrival)."""
+    ctx = multiprocessing.get_context("fork")
+    proc = ctx.Process(
+        target=_frontend_main,
+        args=(worker_id, config, ring, preprocess_path),
+        name=f"mlops-tpu-frontend-{worker_id}",
+    )
+    proc.start()
+    return proc

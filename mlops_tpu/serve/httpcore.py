@@ -1,0 +1,380 @@
+"""Engine-free HTTP/1.1 core: protocol, routing skeleton, wire helpers.
+
+The dependency-free asyncio protocol that used to live inside
+``serve/server.py``'s engine-coupled server, extracted so a process can
+parse, validate, and answer HTTP without an engine (or jax) anywhere in
+sight: the single-process ``HttpServer`` subclasses ``HttpProtocol``
+against a live ``InferenceEngine``, and the multi-worker front ends
+(``serve/frontend.py``) subclass it against the shared-memory request
+ring (``serve/ipc.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import re
+import uuid
+from typing import Any
+
+import pydantic
+
+from mlops_tpu.config import ServeConfig
+from mlops_tpu.schema import LoanApplicant
+
+logger = logging.getLogger("mlops_tpu.serve")
+
+# Compact separators: the default ", "/": " pads every response body (and
+# both structured log events) with bytes pure of whitespace — on the c128
+# throughput path serialization is measurable hot-path CPU.
+def _dumps(payload) -> str:
+    return json.dumps(payload, separators=(",", ":"))
+
+
+class _LazyJson:
+    """Defer json.dumps of a log payload to %s-formatting time: the dumps
+    runs only when a handler actually emits the record, so a deployment
+    that filters (not just disables) INFO never pays per-request
+    serialization of full request/response bodies."""
+
+    __slots__ = ("_payload",)
+
+    def __init__(self, payload):
+        self._payload = payload
+
+    def __str__(self) -> str:
+        return _dumps(self._payload)
+
+
+_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+            409: "Conflict", 413: "Payload Too Large",
+            422: "Unprocessable Entity", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+# (status, content_type) -> precomputed immutable head prefix. Statuses and
+# content types form a tiny closed set, so the f-string formatting + encode
+# of the static head runs once per pair instead of once per response.
+_HEAD_PREFIXES: dict[tuple[int, str], bytes] = {}
+_KEEP_ALIVE_TAIL = b"connection: keep-alive\r\n\r\n"
+_CLOSE_TAIL = b"connection: close\r\n\r\n"
+
+
+def _head_prefix(status: int, content_type: str) -> bytes:
+    prefix = _HEAD_PREFIXES.get((status, content_type))
+    if prefix is None:
+        reason = _REASONS.get(status, "OK")
+        prefix = _HEAD_PREFIXES[(status, content_type)] = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: {content_type}\r\n"
+        ).encode()
+    return prefix
+
+_DOCS_HTML = """<!doctype html>
+<html><head><title>{title}</title></head>
+<body style="font-family: sans-serif; max-width: 42rem; margin: 2rem auto">
+<h1>{title}</h1>
+<p>TPU-native credit-default inference service.</p>
+<ul>
+<li><code>POST /predict</code> — body: JSON list of loan-applicant records;
+returns <code>{{"predictions": [...], "outliers": [...],
+"feature_drift_batch": {{...}}}}</code></li>
+<li><code>GET /healthz/live</code> — liveness probe</li>
+<li><code>GET /healthz/ready</code> — readiness probe (model loaded + jit warm)</li>
+<li><code>GET /metrics</code> — Prometheus metrics</li>
+<li><code>POST /debug/profile/start</code>, <code>POST /debug/profile/stop</code>
+— capture a <code>jax.profiler</code> device trace (view in TensorBoard)</li>
+</ul>
+</body></html>"""
+
+
+class HttpProtocol:
+    """Engine-free HTTP/1.1 layer: connection handling, head parsing,
+    response encoding, request-id plumbing, docs/openapi routes, and the
+    drain bookkeeping — everything a front-end PROCESS needs without an
+    engine in sight (serve/frontend.py subclasses this against the
+    shared-memory ring; HttpServer below subclasses it against a live
+    InferenceEngine). Subclasses implement `_predict`, `_ready`,
+    `_metrics_endpoint`, `_profile`, and set `self.metrics` (anything
+    with ``observe_request(route, status, latency_ms)``)."""
+
+    MAX_BODY_BYTES = 16 * 1024 * 1024
+    MAX_HEADERS = 100
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.metrics: Any = None  # subclass responsibility
+        self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
+        # Request-size cap for the 413 gate; subclasses tighten it (the
+        # single-process server clamps to the engine's largest warmed
+        # bucket, front ends to the ring's slab capacity).
+        self.max_batch = config.max_batch
+        self._openapi: dict | None = None  # built lazily, served cached
+        # Drain bookkeeping: open client transports and the subset with an
+        # exchange currently in flight (between request read and response
+        # write). SIGTERM closes idle transports immediately and lets busy
+        # ones finish their current response (serve/server.py::_serve).
+        # Concurrency note (tpulint Layer 3): every mutable field here is
+        # EVENT-LOOP CONFINED — touched only from coroutines on the one
+        # asyncio thread — which is why none of them carries a lock.
+        self.draining = False
+        self._connections: set[asyncio.StreamWriter] = set()
+        self._busy: set[asyncio.StreamWriter] = set()
+
+    # ------------------------------------------------------ subclass hooks
+    async def _predict(self, body: bytes, request_id: str | None = None):
+        """The reference's `predict()` endpoint (`app/main.py:42-86`):
+        validate -> log InferenceData -> score -> log ModelOutput ->
+        respond. The SHELL — validation, the 422/413 contracts, and the
+        two-event structured logging — is shared verbatim by every plane;
+        subclasses provide only `_score` (engine call or ring round
+        trip), which returns the response dict, or a pre-built
+        (status, payload, content_type[, headers]) tuple for its error
+        paths (deadline 503, shed 503, failure 500)."""
+        try:
+            records = self._applicant_list.validate_json(body)
+        except pydantic.ValidationError as err:
+            return 422, {"detail": json.loads(err.json())}, "application/json"
+        if len(records) > self.max_batch:
+            # Cap guards the compiled-shape grid: anything beyond the
+            # largest warmed bucket would trigger an exact-shape compile
+            # per novel size. Offline scoring of big files goes through
+            # predict-file.
+            return (
+                413,
+                {
+                    "detail": f"batch of {len(records)} exceeds "
+                    f"max_batch={self.max_batch}"
+                },
+                "application/json",
+            )
+        request_id = request_id or uuid.uuid4().hex
+        record_dicts = [r.model_dump() for r in records]
+        # Two layers keep log formatting off the hot path: isEnabledFor
+        # skips everything when the deployment silences INFO, and
+        # _LazyJson defers the dumps of the full payload to record-emit
+        # time (a filtered/sampled handler never serializes at all).
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                "%s",
+                _LazyJson(
+                    {
+                        "service_name": self.config.service_name,
+                        "type": "InferenceData",
+                        "request_id": request_id,
+                        "data": record_dicts,
+                    }
+                ),
+            )
+        response = await self._score(record_dicts, request_id)
+        if isinstance(response, tuple):
+            return response  # subclass error path, already wire-shaped
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                "%s",
+                _LazyJson(
+                    {
+                        "service_name": self.config.service_name,
+                        "type": "ModelOutput",
+                        "request_id": request_id,
+                        "data": response,
+                    }
+                ),
+            )
+        return 200, response, "application/json"
+
+    async def _score(self, record_dicts: list[dict], request_id: str):
+        raise NotImplementedError
+
+    def _ready(self) -> bool:
+        raise NotImplementedError
+
+    async def _metrics_endpoint(self):
+        raise NotImplementedError
+
+    def _profile(self, action: str):
+        # Profiling captures a device trace — only the engine-owning
+        # process can serve it; front ends report it unavailable.
+        return 404, {"detail": "profiling disabled"}, "application/json"
+
+    # ----------------------------------------------------------- HTTP layer
+    async def handle_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._connections.add(writer)
+        try:
+            while True:
+                # Line-by-line head read. This is NOT an event-loop cost:
+                # readline() on already-buffered bytes returns without
+                # suspending, so a whole head arriving in one TCP segment
+                # (the normal case) costs one suspension total. It also
+                # keeps the old tolerance for bare-LF request heads, which
+                # a single readuntil(b"\r\n\r\n") would hang on.
+                request_line = await reader.readline()
+                if not request_line:
+                    break
+                try:
+                    method, path, _ = request_line.decode("latin1").split(" ", 2)
+                except ValueError:
+                    await self._write_response(writer, 400, {"detail": "bad request"})
+                    break
+                headers = {}
+                header_error = False
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    if len(headers) >= self.MAX_HEADERS:
+                        header_error = True
+                        break
+                    name, _, value = line.decode("latin1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                if header_error:
+                    await self._write_response(
+                        writer, 400, {"detail": "too many headers"}
+                    )
+                    break
+                body = b""
+                try:
+                    length = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    await self._write_response(
+                        writer, 400, {"detail": "bad content-length"}
+                    )
+                    break
+                if length > self.MAX_BODY_BYTES:
+                    await self._write_response(
+                        writer,
+                        413,
+                        {"detail": f"body exceeds {self.MAX_BODY_BYTES} bytes"},
+                    )
+                    break
+                if length:
+                    body = await reader.readexactly(length)
+
+                # A draining server finishes the current exchange but
+                # advertises connection: close and stops looping.
+                keep_alive = (
+                    headers.get("connection", "keep-alive") != "close"
+                    and not self.draining
+                )
+                self._busy.add(writer)
+                try:
+                    start = time.perf_counter()
+                    request_id = self._request_id(headers)
+                    route_path = path.split("?", 1)[0]
+                    # Routes return (status, payload, content_type) with an
+                    # optional 4th element of extra header lines (the shed
+                    # path's Retry-After).
+                    result = await self._route(
+                        method, route_path, body, request_id
+                    )
+                    status, payload, content_type = result[:3]
+                    extra_headers = result[3] if len(result) > 3 else None
+                    latency_ms = (time.perf_counter() - start) * 1e3
+                    self.metrics.observe_request(route_path, status, latency_ms)
+                    keep_alive = keep_alive and not self.draining
+                    await self._write_response(
+                        writer, status, payload, content_type, keep_alive,
+                        request_id=request_id, extra_headers=extra_headers,
+                    )
+                finally:
+                    self._busy.discard(writer)
+                if not keep_alive:
+                    break
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+        ):
+            pass
+        finally:
+            self._connections.discard(writer)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+    def _request_id(self, headers: dict) -> str:
+        """Honor a well-formed inbound ``x-request-id`` (so the caller's
+        trace id correlates the two log events end to end — the reference
+        only ever generates its own, `app/main.py:57`); mint one otherwise.
+        The charset/length gate keeps log-injection text out of the
+        structured stream."""
+        inbound = headers.get("x-request-id", "")
+        if inbound and self._REQUEST_ID_RE.match(inbound):
+            return inbound
+        return uuid.uuid4().hex
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload,
+        content_type: str = "application/json",
+        keep_alive: bool = True,
+        request_id: str | None = None,
+        extra_headers: dict[str, str] | None = None,
+    ) -> None:
+        if isinstance(payload, (dict, list)):
+            body = _dumps(payload).encode()
+        elif isinstance(payload, str):
+            body = payload.encode()
+        else:
+            body = payload
+        # Static head parts are precomputed bytes (_head_prefix); only the
+        # per-response fields (length, request id) format here.
+        head = [
+            _head_prefix(status, content_type),
+            b"content-length: %d\r\n" % len(body),
+        ]
+        if request_id:
+            head.append(b"x-request-id: " + request_id.encode() + b"\r\n")
+        if extra_headers:
+            for name, value in extra_headers.items():
+                head.append(f"{name}: {value}\r\n".encode())
+        head.append(_KEEP_ALIVE_TAIL if keep_alive else _CLOSE_TAIL)
+        head.append(body)
+        writer.write(b"".join(head))
+        await writer.drain()
+
+    # -------------------------------------------------------------- routing
+    async def _route(
+        self, method: str, path: str, body: bytes, request_id: str | None = None
+    ):
+        if path == "/predict" and method == "POST":
+            return await self._predict(body, request_id)
+        if path.startswith("/debug/profile/") and method == "POST":
+            return self._profile(path.removeprefix("/debug/profile/"))
+        if method == "GET":
+            if path == "/":
+                # Interactive Swagger UI (reference parity: FastAPI serves
+                # its docs at `/`, `app/main.py:37`).
+                from mlops_tpu.serve.openapi import SWAGGER_HTML
+
+                return (
+                    200,
+                    SWAGGER_HTML.format(title=self.config.service_name),
+                    "text/html",
+                )
+            if path == "/docs/plain":
+                return 200, _DOCS_HTML.format(title=self.config.service_name), "text/html"
+            if path == "/openapi.json":
+                from mlops_tpu.serve.openapi import build_openapi
+
+                if self._openapi is None:
+                    self._openapi = build_openapi(self.config.service_name)
+                return 200, self._openapi, "application/json"
+            if path == "/healthz/live":
+                return 200, {"status": "alive"}, "application/json"
+            if path == "/healthz/ready":
+                if self._ready():
+                    return 200, {"status": "ready"}, "application/json"
+                return 503, {"status": "warming"}, "application/json"
+            if path == "/metrics":
+                return await self._metrics_endpoint()
+        return 404, {"detail": "not found"}, "application/json"
+

@@ -1,0 +1,798 @@
+"""Zero-copy shared-memory IPC: N HTTP front ends -> ONE engine process.
+
+The multi-worker server plane's transport (ROADMAP item 1): front-end
+processes validate + encode requests and place the feature arrays
+directly into fixed-slot shared-memory slabs; the engine process scores
+them (coalescing concurrent small requests into one grouped device
+dispatch, exactly like the in-process micro-batcher) and writes the raw
+response arrays back into the same slot. Only 8-byte descriptors cross a
+queue — the arrays never serialize, never copy through a pipe, and never
+touch a pickle.
+
+Topology and ownership:
+
+- One anonymous ``mmap`` (MAP_SHARED) created by the parent BEFORE
+  forking the front ends — no named segments, no resource-tracker
+  cleanup, freed by the kernel when the last process unmaps.
+- Every front end owns a fixed PARTITION of the slots (its admission
+  queue): slot claim/release is event-loop confined per worker, so the
+  only cross-process lock in the whole plane is the one guarding the
+  submission queue's head index.
+- Two slot classes per worker: ``small`` slabs hold up to
+  ``GROUP_ROW_BUCKET`` rows (the coalescable class — batch-1 traffic),
+  ``large`` slabs hold up to ``max_batch`` rows (solo dispatches; small
+  requests may overflow into a free large slab, never the reverse).
+  Exhausting a class is the load-shed signal: the front end answers
+  503 + Retry-After instead of queueing unboundedly.
+- Per-slot GENERATION counters: a front end bumps the generation when it
+  claims a slot, the engine stamps the response with the request's
+  generation, and completions with a stale generation are dropped — a
+  crashed-and-restarted front end can never be handed a dead request's
+  response, and a crashed front end never wedges the ring (the engine
+  always answers into the slab and moves on; nobody has to read it).
+- Wakeups are ``eventfd``-style doorbells (``os.eventfd`` where the
+  kernel provides it, a non-blocking self-pipe otherwise): one rung by
+  front ends when they enqueue, one per worker rung by the engine when
+  responses land. Front ends register theirs with the event loop
+  (``loop.add_reader``); the engine's collector thread blocks in
+  ``select``.
+
+Lock discipline (tpulint Layer 3): the manifest below is checked
+statically by `analysis/concurrency.py` and at runtime by the lock
+sanitizer in the seeded stress tests (tests/test_frontend.py). Locks
+only ever guard INDEX ARITHMETIC — slab reads/writes happen outside
+every lock, on slots exclusively owned between claim and release.
+"""
+
+from __future__ import annotations
+
+import logging
+import mmap
+import multiprocessing
+import os
+import select
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.serve.metrics import (
+    MON_BATCHES,
+    MON_FETCHED_AT,
+    MON_FETCHES,
+    MON_HAS,
+    MON_OUTLIERS,
+    MON_ROWS,
+    RING_STATUSES,
+    ServingMetrics,
+)
+from mlops_tpu.serve.wire import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+
+logger = logging.getLogger("mlops_tpu.serve")
+
+# Declared lock order, OUTERMOST FIRST — the single source of truth for
+# both halves of tpulint Layer 3 (static: analysis/concurrency.py TPU401;
+# runtime: analysis/lockcheck.py in the perturbed stress tests).
+#
+# RequestRing._submit_lock is the ONE cross-process lock (submission-queue
+# head index); it is a leaf — nothing is ever acquired under it, and it
+# is never held across slab writes, doorbells, or blocking work.
+#
+# RingService: ``_inflight`` is the dispatch bound, acquired by the
+# collector thread and released by the pool thread that finishes the job
+# — a cross-method/cross-thread pair exactly like the micro-batcher's
+# (declared below for TPU404). ``_complete_lock`` serializes pool threads
+# producing into a worker's completion queue; ``_mon_lock`` guards the
+# host-side monitor fold for engines without a device accumulator. Both
+# are leaves; the only nesting anywhere is (conceptually) holding an
+# ``_inflight`` permit while taking them, which the declared order
+# permits.
+TPULINT_LOCK_ORDER = {
+    "RequestRing": ("_submit_lock",),
+    "RingService": ("_inflight", "_complete_lock", "_mon_lock"),
+}
+TPULINT_CROSS_METHOD_SEMAPHORES = {"RingService": ("_inflight",)}
+
+SMALL, LARGE = 0, 1  # slot classes (stats/gauge indices)
+
+STATUSES = RING_STATUSES  # closed status set for the request matrices
+_STATUS_IDX = {s: i for i, s in enumerate(STATUSES)}
+_ROUTES = ServingMetrics.KNOWN_ROUTES + ("<other>",)
+_ROUTE_IDX = {r: i for i, r in enumerate(_ROUTES)}
+
+
+class Doorbell:
+    """A cross-process wakeup: ``eventfd`` when the kernel provides it, a
+    non-blocking self-pipe otherwise. Created before fork, shared by
+    inheritance. ``ring()`` never blocks (a full pipe already means the
+    reader has a pending wakeup) and tolerates a closed peer (a crashed
+    front end must not take the engine down with EPIPE)."""
+
+    def __init__(self) -> None:
+        if hasattr(os, "eventfd"):
+            fd = os.eventfd(0, os.EFD_NONBLOCK)
+            self._rfd = self._wfd = fd
+            self._token = (1).to_bytes(8, "little")
+        else:  # pragma: no cover - non-Linux fallback
+            self._rfd, self._wfd = os.pipe()
+            os.set_blocking(self._rfd, False)
+            os.set_blocking(self._wfd, False)
+            self._token = b"\x01"
+
+    def fileno(self) -> int:
+        return self._rfd
+
+    def ring(self) -> None:
+        try:
+            os.write(self._wfd, self._token)
+        except (BlockingIOError, BrokenPipeError, OSError):
+            pass  # full pipe = wakeup already pending; closed peer = gone
+
+    def wait(self, timeout_s: float | None = None) -> bool:
+        """Block (in select, so other processes' writes wake us) until the
+        doorbell rings or the timeout passes; drains the counter."""
+        ready, _, _ = select.select([self._rfd], [], [], timeout_s)
+        if ready:
+            self.drain()
+            return True
+        return False
+
+    def drain(self) -> None:
+        try:
+            while os.read(self._rfd, 8):
+                if self._rfd == self._wfd:
+                    break  # eventfd: one read swallows the whole counter
+        except (BlockingIOError, OSError):
+            pass
+
+    def close(self) -> None:
+        for fd in {self._rfd, self._wfd}:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def _pack(slot: int, gen: int) -> int:
+    return (int(gen) & 0xFFFFFFFF) << 32 | (int(slot) & 0xFFFFFFFF)
+
+
+def _unpack(entry: int) -> tuple[int, int]:
+    return int(entry) & 0xFFFFFFFF, (int(entry) >> 32) & 0xFFFFFFFF
+
+
+class RequestRing:
+    """The shared-memory segment + typed views + descriptor queues.
+
+    Build ONCE in the parent (`RequestRing(...)`) before forking; every
+    forked process sees the same pages through the inherited ``mmap``.
+    All multi-word data races are excluded by ownership (a slot belongs
+    to exactly one side between claim and completion; stats blocks have
+    one writer each); the descriptor queues use 8-byte aligned
+    head/tail counters whose producers are serialized by
+    ``_submit_lock`` (submissions, cross-process) or the service's
+    ``_complete_lock`` (completions, engine threads only).
+    """
+
+    def __init__(
+        self,
+        workers: int,
+        slots_small: int,
+        slots_large: int,
+        large_rows: int,
+        small_rows: int = GROUP_ROW_BUCKET,
+    ) -> None:
+        if workers < 1:
+            raise ValueError("workers must be >= 1")
+        self.workers = workers
+        self.slots_small = slots_small
+        self.slots_large = slots_large
+        self.small_rows = small_rows
+        self.large_rows = max(large_rows, small_rows)
+        self.n_small = workers * slots_small
+        self.n_large = workers * slots_large
+        self.n_slots = self.n_small + self.n_large
+        C, N = SCHEMA.num_categorical, SCHEMA.num_numeric
+        self.n_features = D = C + N
+        self._nb = len(ServingMetrics.LATENCY_BUCKETS)
+
+        plan: list[tuple[str, np.dtype, tuple[int, ...]]] = [
+            # control flags: [0] engine_ready, [1] draining
+            ("ctl", np.dtype(np.uint64), (2,)),
+            # submission queue (MPSC: front ends -> engine collector)
+            ("sub_entries", np.dtype(np.uint64), (self.n_slots,)),
+            ("sub_head", np.dtype(np.uint64), (1,)),
+            ("sub_tail", np.dtype(np.uint64), (1,)),
+            # per-worker completion queues (engine -> one front end)
+            ("comp_entries", np.dtype(np.uint64),
+             (workers, slots_small + slots_large)),
+            ("comp_head", np.dtype(np.uint64), (workers,)),
+            ("comp_tail", np.dtype(np.uint64), (workers,)),
+            # per-slot headers. slot_busy marks submitted-but-not-released
+            # slots IN SHM so the state survives a front-end crash: a
+            # respawned incarnation must quarantine those slots (the
+            # engine may still write their slabs) instead of re-freeing
+            # them — see RingClient.__init__.
+            ("slot_gen", np.dtype(np.uint32), (self.n_slots,)),
+            ("slot_n", np.dtype(np.uint32), (self.n_slots,)),
+            ("slot_busy", np.dtype(np.uint32), (self.n_slots,)),
+            ("resp_gen", np.dtype(np.uint32), (self.n_slots,)),
+            ("resp_status", np.dtype(np.uint32), (self.n_slots,)),
+            # request slabs (front end writes, engine reads)
+            ("small_cat", np.dtype(np.int32), (self.n_small, small_rows, C)),
+            ("small_num", np.dtype(np.float32), (self.n_small, small_rows, N)),
+            ("large_cat", np.dtype(np.int32),
+             (self.n_large, self.large_rows, C)),
+            ("large_num", np.dtype(np.float32),
+             (self.n_large, self.large_rows, N)),
+            # response slabs (engine writes, front end reads): f64
+            # [predictions rows ‖ outliers rows ‖ drift D] — f64 because
+            # that is exactly what `fetch_*_raw` hands `format_response`,
+            # so the bytes the front end formats are the bytes the
+            # single-process path would have formatted (bit-identity)
+            ("small_resp", np.dtype(np.float64),
+             (self.n_small, 2 * small_rows + D)),
+            ("large_resp", np.dtype(np.float64),
+             (self.n_large, 2 * self.large_rows + D)),
+            # per-worker serving stats (single writer: that worker)
+            ("req_counts", np.dtype(np.uint64),
+             (workers, len(_ROUTES), len(STATUSES) + 1)),
+            ("lat_counts", np.dtype(np.uint64), (workers, self._nb)),
+            ("lat_sum_ms", np.dtype(np.float64), (workers,)),
+            ("lat_n", np.dtype(np.uint64), (workers,)),
+            ("shed", np.dtype(np.uint64), (workers, 2)),
+            ("inflight", np.dtype(np.uint64), (workers, 2)),
+            # monitor aggregate (single writer: the engine process)
+            ("mon_vals", np.dtype(np.float64), (8,)),
+            ("mon_drift_last", np.dtype(np.float64), (D,)),
+            ("mon_drift_mean", np.dtype(np.float64), (D,)),
+        ]
+        offset = 0
+        offsets = {}
+        for name, dtype, shape in plan:
+            offset = (offset + 63) & ~63  # 64-byte align every region
+            offsets[name] = offset
+            offset += dtype.itemsize * int(np.prod(shape))
+        self._mm = mmap.mmap(-1, offset)  # anonymous MAP_SHARED
+        for name, dtype, shape in plan:
+            view = np.frombuffer(
+                self._mm, dtype=dtype, count=int(np.prod(shape)),
+                offset=offsets[name],
+            ).reshape(shape)
+            setattr(self, name, view)
+
+        # The one cross-process lock (submission head/tail); "fork"
+        # context — the whole plane is built on inheritance.
+        self._submit_lock = multiprocessing.get_context("fork").Lock()
+        self.engine_doorbell = Doorbell()
+        self.worker_doorbells = [Doorbell() for _ in range(workers)]
+
+    # ------------------------------------------------------ control flags
+    @property
+    def engine_ready(self) -> bool:
+        return bool(self.ctl[0])
+
+    def set_ready(self, ready: bool) -> None:
+        self.ctl[0] = 1 if ready else 0
+
+    @property
+    def draining(self) -> bool:
+        return bool(self.ctl[1])
+
+    def set_draining(self) -> None:
+        self.ctl[1] = 1
+
+    # ---------------------------------------------------- slot geometry
+    def worker_slots(self, worker: int) -> tuple[range, range]:
+        """(small slot ids, large slot ids) owned by ``worker``."""
+        s0 = worker * self.slots_small
+        l0 = self.n_small + worker * self.slots_large
+        return (
+            range(s0, s0 + self.slots_small),
+            range(l0, l0 + self.slots_large),
+        )
+
+    def slot_class(self, slot: int) -> int:
+        return SMALL if slot < self.n_small else LARGE
+
+    def slot_owner(self, slot: int) -> int:
+        if slot < self.n_small:
+            return slot // self.slots_small
+        return (slot - self.n_small) // self.slots_large
+
+    def request_views(self, slot: int) -> tuple[np.ndarray, np.ndarray]:
+        """(cat int32[rows, C], num f32[rows, N]) slab views for ``slot``
+        — full slab; callers slice by the row count they wrote."""
+        if slot < self.n_small:
+            return self.small_cat[slot], self.small_num[slot]
+        i = slot - self.n_small
+        return self.large_cat[i], self.large_num[i]
+
+    def response_views(
+        self, slot: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(predictions, outliers, drift) f64 views of the response slab,
+        sliced to the slot's recorded row count."""
+        n = int(self.slot_n[slot])
+        if slot < self.n_small:
+            resp, rows = self.small_resp[slot], self.small_rows
+        else:
+            resp, rows = self.large_resp[slot - self.n_small], self.large_rows
+        return resp[:n], resp[rows : rows + n], resp[2 * rows :]
+
+    # ------------------------------------------------------- descriptors
+    def submit(self, slot: int, gen: int) -> None:
+        """Front-end side: enqueue a filled slot for the engine. The lock
+        guards ONLY the head bump; the doorbell rings outside it."""
+        entry = _pack(slot, gen)
+        with self._submit_lock:
+            head = int(self.sub_head[0])
+            self.sub_entries[head % self.n_slots] = entry
+            self.sub_head[0] = head + 1
+        self.engine_doorbell.ring()
+
+    def pop_submissions(self) -> list[tuple[int, int]]:
+        """Engine side (single consumer): drain everything queued."""
+        out: list[tuple[int, int]] = []
+        with self._submit_lock:
+            head = int(self.sub_head[0])
+            tail = int(self.sub_tail[0])
+            while tail < head:
+                out.append(_unpack(int(self.sub_entries[tail % self.n_slots])))
+                tail += 1
+            self.sub_tail[0] = tail
+        return out
+
+    def push_completion(self, slot: int, gen: int) -> None:
+        """Engine side: hand a finished slot back to its owner. Producers
+        (pool threads) must serialize externally (RingService holds
+        ``_complete_lock``); the consumer is the owning front end's event
+        loop, which only ever advances the tail — capacity equals the
+        worker's slot count, so the queue can never overflow."""
+        worker = self.slot_owner(slot)
+        cap = self.comp_entries.shape[1]
+        head = int(self.comp_head[worker])
+        self.comp_entries[worker, head % cap] = _pack(slot, gen)
+        self.comp_head[worker] = head + 1
+
+    def pop_completions(self, worker: int) -> list[tuple[int, int]]:
+        """Front-end side (single consumer per worker)."""
+        out: list[tuple[int, int]] = []
+        cap = self.comp_entries.shape[1]
+        head = int(self.comp_head[worker])
+        tail = int(self.comp_tail[worker])
+        while tail < head:
+            out.append(_unpack(int(self.comp_entries[worker, tail % cap])))
+            tail += 1
+        self.comp_tail[worker] = tail
+        return out
+
+    # ----------------------------------------------------------- monitor
+    def write_monitor(self, snapshot: dict[str, Any]) -> None:
+        """Engine-process single writer: install a `monitor_snapshot`
+        aggregate for the front ends' /metrics renders. Field-at-a-time
+        f64 stores are individually atomic; a scrape racing this write
+        can see a mid-update mix, which Prometheus gauges tolerate (same
+        contract as a scrape racing the single-process fetch)."""
+        if not snapshot:
+            return
+        self.mon_vals[MON_ROWS] = float(snapshot["rows"])
+        self.mon_vals[MON_OUTLIERS] = float(snapshot["outliers"])
+        self.mon_vals[MON_BATCHES] = float(snapshot["batches"])
+        self.mon_drift_last[:] = np.fromiter(
+            snapshot["drift_last"].values(), np.float64, self.n_features
+        )
+        self.mon_drift_mean[:] = np.fromiter(
+            snapshot["drift_mean"].values(), np.float64, self.n_features
+        )
+        self.mon_vals[MON_FETCHES] += 1
+        self.mon_vals[MON_FETCHED_AT] = time.monotonic()
+        self.mon_vals[MON_HAS] = 1.0
+
+    def close(self) -> None:
+        self.engine_doorbell.close()
+        for bell in self.worker_doorbells:
+            bell.close()
+        # The mmap itself is left to the garbage collector / process exit:
+        # numpy views pin the buffer, and the kernel reclaims the pages
+        # when the last process goes away.
+
+
+class ShmWorkerMetrics:
+    """`ServingMetrics.observe_request`-compatible recorder writing into a
+    worker's shared stats block — single writer (that worker's event
+    loop), so no lock; cross-process readers see monotonic counters."""
+
+    def __init__(self, ring: RequestRing, worker: int) -> None:
+        self._ring = ring
+        self._worker = worker
+        self._buckets = ServingMetrics.LATENCY_BUCKETS
+
+    def observe_request(self, route: str, status: int, latency_ms: float) -> None:
+        ring, w = self._ring, self._worker
+        r = _ROUTE_IDX.get(route, _ROUTE_IDX["<other>"])
+        s = _STATUS_IDX.get(status, len(STATUSES))
+        ring.req_counts[w, r, s] += 1
+        ring.lat_sum_ms[w] += latency_ms
+        ring.lat_n[w] += 1
+        for i, edge in enumerate(self._buckets):
+            if latency_ms <= edge:
+                ring.lat_counts[w, i] += 1
+                break
+
+
+class RingClient:
+    """One front end's view of the ring: slot claim/submit/release plus
+    the completion doorbell. Everything here is EVENT-LOOP CONFINED to
+    the owning worker process (the free lists, the pending map, the
+    inflight gauges) — the only shared mutations go through
+    `RequestRing.submit` (locked) and the slabs (exclusively owned)."""
+
+    def __init__(self, ring: RequestRing, worker: int) -> None:
+        self.ring = ring
+        self.worker = worker
+        small, large = ring.worker_slots(worker)
+        # Restart-safe: generations AND the busy flags persist in shm. A
+        # slot the DEAD incarnation submitted but never released
+        # (slot_busy == 1) may still have an engine write in flight
+        # against its response slab — it goes into QUARANTINE, not the
+        # free list, until the engine's completion for it arrives (the
+        # engine answers every accepted descriptor, so quarantine always
+        # drains; the residual leak windows — a crash in the microseconds
+        # between the busy-flag store and the descriptor push, or inside
+        # the consume-completion-then-release callback — cost one slot of
+        # capacity until the pod restarts, never correctness). Bumping every
+        # generation makes any completion addressed to the dead
+        # incarnation stale on arrival, and the engine's stale-generation
+        # write guard (RingService._run_job) refuses to touch a slab
+        # whose slot has moved on.
+        self._free: tuple[list[int], list[int]] = ([], [])
+        self._quarantined: set[int] = set()
+        for slot in (*small, *large):
+            ring.slot_gen[slot] += 1
+            if int(ring.slot_busy[slot]):
+                self._quarantined.add(slot)
+            else:
+                self._free[ring.slot_class(slot)].append(slot)
+        ring.inflight[worker, :] = 0
+        # slot -> (generation, future). A future that died waiting (the
+        # request deadline) leaves its entry as a ZOMBIE: the slot is NOT
+        # reusable until the engine's completion arrives — reusing it
+        # early would let a stale in-flight response scribble over a new
+        # request's slab.
+        self._pending: dict[int, tuple[int, Any]] = {}
+
+    # -------------------------------------------------------------- claim
+    def claim(self, n_rows: int) -> int | None:
+        """A free slot whose slab fits ``n_rows``, or None (shed). Small
+        requests prefer the small class and may overflow into large;
+        large requests never take a small slab."""
+        small_free, large_free = self._free
+        if n_rows <= self.ring.small_rows and small_free:
+            slot = small_free.pop()
+        elif large_free:
+            slot = large_free.pop()
+        else:
+            return None
+        self.ring.inflight[self.worker, self.ring.slot_class(slot)] += 1
+        return slot
+
+    def count_shed(self, n_rows: int) -> None:
+        cls = SMALL if n_rows <= self.ring.small_rows else LARGE
+        self.ring.shed[self.worker, cls] += 1
+
+    def submit(self, slot: int, cat: np.ndarray, num: np.ndarray):
+        """Write the encoded arrays into the slot's slab and enqueue it.
+        Returns the asyncio future the completion resolves (with the
+        engine's response status)."""
+        import asyncio
+
+        n = cat.shape[0]
+        ring = self.ring
+        slab_cat, slab_num = ring.request_views(slot)
+        slab_cat[:n] = cat
+        slab_num[:n] = num
+        ring.slot_n[slot] = n
+        gen = (int(ring.slot_gen[slot]) + 1) & 0xFFFFFFFF
+        ring.slot_gen[slot] = gen
+        # Busy BEFORE the descriptor push: if this process dies anywhere
+        # past here, the next incarnation quarantines the slot instead of
+        # racing the engine for its slab.
+        ring.slot_busy[slot] = 1
+        future = asyncio.get_running_loop().create_future()
+        self._pending[slot] = (gen, future)
+        ring.submit(slot, gen)
+        return future
+
+    def release(self, slot: int) -> None:
+        """Return a slot whose response has been consumed (or that was
+        never submitted) to the free list."""
+        self._pending.pop(slot, None)
+        self.ring.slot_busy[slot] = 0
+        cls = self.ring.slot_class(slot)
+        self._free[cls].append(slot)
+        self.ring.inflight[self.worker, cls] -= 1
+
+    def abandon(self, slot: int) -> None:
+        """Deadline/error path after a successful submit: if the response
+        already landed, the slot is safe to reuse now; otherwise leave
+        the pending entry as a zombie — the completion handler releases
+        it when the engine answers (never reuse a slab with an engine
+        write potentially in flight)."""
+        entry = self._pending.get(slot)
+        # A deadline-CANCELLED future means the engine's answer is still
+        # in flight — only a future that actually carries the response
+        # (done, not cancelled) proves the slab is quiescent.
+        if entry is None or (entry[1].done() and not entry[1].cancelled()):
+            self.release(slot)
+
+    def response_arrays(
+        self, slot: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return self.ring.response_views(slot)
+
+    # -------------------------------------------------------- completions
+    def on_doorbell(self) -> None:
+        """Event-loop reader callback for this worker's doorbell: drain
+        completion descriptors, resolve live futures, release zombies,
+        and drain the quarantine (slots inherited busy from a crashed
+        incarnation — the engine answering them is the proof their slabs
+        are quiescent)."""
+        ring = self.ring
+        ring.worker_doorbells[self.worker].drain()
+        for slot, gen in ring.pop_completions(self.worker):
+            entry = self._pending.get(slot)
+            if entry is None or entry[0] != gen:
+                # Stale generation: a completion addressed to the dead
+                # incarnation. If the slot sat in quarantine, this is the
+                # all-clear to reuse it.
+                if slot in self._quarantined:
+                    self._quarantined.discard(slot)
+                    ring.slot_busy[slot] = 0
+                    self._free[ring.slot_class(slot)].append(slot)
+                continue
+            _, future = entry
+            if future.done() or future.cancelled():
+                self.release(slot)  # zombie: waiter gave up; reuse now
+            elif int(ring.resp_gen[slot]) != gen:
+                # Descriptor/slab mismatch: the slab does not carry THIS
+                # request's answer (should be impossible for a live
+                # incarnation — the engine stamps resp_gen before the
+                # completion). Leave the future pending; the deadline
+                # turns it into a 503 and the zombie path reclaims.
+                logger.error(
+                    "ring completion for slot %d gen %d but slab carries "
+                    "gen %d; dropping", slot, gen, int(ring.resp_gen[slot]),
+                )
+            else:
+                future.set_result(int(ring.resp_status[slot]))
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+
+class RingService:
+    """Engine-process half: collect submitted slots, coalesce small
+    requests into grouped device dispatches (the micro-batcher's policy,
+    greedy over whatever is queued — under load the queue is never
+    empty, which is exactly when grouping pays), run them on a small
+    thread pool so device round trips overlap, write raw responses into
+    the slabs, and ring the owners' doorbells.
+
+    The engine always answers every accepted descriptor — success or a
+    status-1 error — so front-end futures never wait on a dropped slot,
+    and it never blocks on front-end state, so front-end churn (crash,
+    restart, kill -9) cannot wedge the engine.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        ring: RequestRing,
+        max_group: int = 64,
+        max_inflight: int = 4,
+        threads: int = 8,
+        monitor_fetch_every_s: float = 2.0,
+        monitor_fetch_every_requests: int = 512,
+    ) -> None:
+        import concurrent.futures
+
+        self.engine = engine
+        self.ring = ring
+        # A group can never exceed the largest warmed slot bucket — beyond
+        # it there is no compiled shape to run (same clamp as the
+        # in-process micro-batcher).
+        self.max_group = max(2, min(max_group, GROUP_SLOT_BUCKETS[-1]))
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2, threads), thread_name_prefix="ring"
+        )
+        self._inflight = threading.BoundedSemaphore(max_inflight)
+        self._complete_lock = threading.Lock()
+        self._mon_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._collector: threading.Thread | None = None
+        self._telemetry: threading.Thread | None = None
+        self._mon_period = monitor_fetch_every_s
+        self._mon_every = monitor_fetch_every_requests
+        self._accumulating = bool(getattr(engine, "monitor_accumulating", False))
+        self._requests_since_fetch = 0  # collector-thread private counter;
+        # the telemetry thread only READS it (a torn read costs one fetch
+        # of cadence, never correctness — the totals live on device)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self._collector = threading.Thread(
+            target=self._collect, name="ring-collector", daemon=True
+        )
+        self._collector.start()
+        if self._accumulating and self._mon_period > 0:
+            self._telemetry = threading.Thread(
+                target=self._telemetry_loop, name="ring-telemetry", daemon=True
+            )
+            self._telemetry.start()
+
+    def stop(self) -> None:
+        """Drain: stop collecting, finish in-flight jobs, final monitor
+        write. Safe to call twice."""
+        self._stop.set()
+        self.ring.engine_doorbell.ring()  # wake the collector's select
+        for thread in (self._collector, self._telemetry):
+            if thread is not None:
+                thread.join(timeout=10)
+        self._pool.shutdown(wait=True)
+        if self._accumulating:
+            try:
+                self.ring.write_monitor(self.engine.monitor_snapshot())
+            except Exception:  # tpulint: disable=TPU201
+                logger.exception("final monitor snapshot failed on drain")
+
+    # ------------------------------------------------------------ collect
+    def _collect(self) -> None:
+        ring = self.ring
+        while not self._stop.is_set():
+            descs = ring.pop_submissions()
+            if not descs:
+                ring.engine_doorbell.wait(timeout_s=1.0)
+                continue
+            self._requests_since_fetch += len(descs)
+            groupable: list[tuple[int, int]] = []
+            solo: list[tuple[int, int]] = []
+            can_group = getattr(self.engine, "supports_grouping", False)
+            for slot, gen in descs:
+                n = int(ring.slot_n[slot])
+                if can_group and 1 <= n <= GROUP_ROW_BUCKET:
+                    groupable.append((slot, gen))
+                else:
+                    solo.append((slot, gen))
+            jobs: list[list[tuple[int, int]]] = []
+            for i in range(0, len(groupable), self.max_group):
+                jobs.append(groupable[i : i + self.max_group])
+            jobs.extend([d] for d in solo)
+            for job in jobs:
+                # Backpressure: the dispatch bound blocks the collector,
+                # submissions pile in the ring, front ends run out of
+                # slots, and the SHED path answers 503 — bounded end to
+                # end with no unbounded queue anywhere.
+                self._inflight.acquire()
+                self._pool.submit(self._run_job, job)
+
+    # --------------------------------------------------------------- jobs
+    def _run_job(self, job: list[tuple[int, int]]) -> None:
+        ring = self.ring
+        try:
+            try:
+                raws = self._score(job)
+                status = 0
+            # The breadth is the contract: ANY scoring failure (device
+            # error, geometry bug) must become a status-1 completion on
+            # every waiting slot — a dropped descriptor would strand the
+            # front end's future until its deadline.
+            except Exception:  # tpulint: disable=TPU201
+                logger.exception("ring dispatch failed (%d slots)", len(job))
+                raws, status = None, 1
+            for i, (slot, gen) in enumerate(job):
+                # Stale-generation write guard: if the slot has moved on
+                # (its front end crashed and the respawned incarnation
+                # bumped the generation), REFUSE to touch the slab — with
+                # the quarantine on the client side this job's slot
+                # cannot have been re-claimed, but the guard keeps slab
+                # writes correct even if a future client mismanages the
+                # free list. The completion still goes out: it is what
+                # releases the quarantined slot.
+                if status == 0 and int(ring.slot_gen[slot]) == gen:
+                    pred, out, drift = raws[i]
+                    resp_pred, resp_out, resp_drift = ring.response_views(slot)
+                    resp_pred[:] = pred
+                    resp_out[:] = out
+                    resp_drift[:] = drift
+                ring.resp_status[slot] = status
+                ring.resp_gen[slot] = gen
+            owners = set()
+            for slot, gen in job:
+                with self._complete_lock:
+                    ring.push_completion(slot, gen)
+                owners.add(ring.slot_owner(slot))
+            for worker in owners:
+                ring.worker_doorbells[worker].ring()
+        finally:
+            self._inflight.release()
+
+    def _score(
+        self, job: list[tuple[int, int]]
+    ) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Score one job -> per-slot raw (predictions, outliers, drift).
+        Multi-slot jobs ride ONE grouped device dispatch
+        (`dispatch_group_arrays` — the arrays come pre-encoded from the
+        front ends, so the engine process does zero per-record Python)."""
+        ring, engine = self.ring, self.engine
+        parts = []
+        for slot, _ in job:
+            n = int(ring.slot_n[slot])
+            cat, num = ring.request_views(slot)
+            parts.append((cat[:n], num[:n]))
+        if len(parts) >= 2:
+            handle = engine.dispatch_group_arrays(parts)
+            sizes, preds, outs, drifts = engine.fetch_group_raw(handle)
+            raws = [
+                (preds[i, :n], outs[i, :n], drifts[i])
+                for i, n in enumerate(sizes)
+            ]
+        else:
+            cat, num = parts[0]
+            handle = engine.dispatch_arrays(cat, num)
+            handle.start_copy()
+            raws = [engine.fetch_arrays_raw(handle)]
+        if not self._accumulating:
+            self._fold_host_monitor(raws)
+        return raws
+
+    def _fold_host_monitor(
+        self, raws: list[tuple[np.ndarray, np.ndarray, np.ndarray]]
+    ) -> None:
+        """Host-side monitor fold for engines without a device accumulator
+        (the sklearn flavor / test stubs) — the seed's per-response
+        `observe_prediction`, landed in the shared block instead. The
+        numpy reductions run OUTSIDE the lock; only the scalar
+        read-modify-writes sit inside."""
+        rows = sum(len(pred) for pred, _, _ in raws)
+        outliers = float(sum(float(out.sum()) for _, out, _ in raws))
+        last = raws[-1][2]
+        ring = self.ring
+        with self._mon_lock:
+            ring.mon_vals[MON_ROWS] += rows
+            ring.mon_vals[MON_OUTLIERS] += outliers
+            ring.mon_vals[MON_BATCHES] += len(raws)
+            ring.mon_drift_last[:] = last
+            ring.mon_vals[MON_HAS] = 1.0
+
+    # ----------------------------------------------------------- telemetry
+    def _telemetry_loop(self) -> None:
+        """Single-flight monitor aggregate reads, ENGINE PROCESS ONLY (the
+        front ends render whatever this loop last wrote): fetch when K
+        ring requests accumulated or the T-second cadence lapses with
+        traffic outstanding — the device is never fetched per request or
+        per scrape."""
+        tick = min(0.25, self._mon_period)
+        last_fetch = time.monotonic()
+        while not self._stop.wait(tick):
+            due_k = self._mon_every and (
+                self._requests_since_fetch >= self._mon_every
+            )
+            due_t = (
+                time.monotonic() - last_fetch >= self._mon_period
+                and self._requests_since_fetch > 0
+            )
+            never = self.ring.mon_vals[MON_HAS] == 0.0
+            if not (due_k or due_t or never):
+                continue
+            self._requests_since_fetch = 0
+            last_fetch = time.monotonic()
+            try:
+                self.ring.write_monitor(self.engine.monitor_snapshot())
+            # A transient device fetch failure keeps the last-written
+            # gauges; the next tick retries (same contract as the
+            # single-process fetch task's done-callback).
+            except Exception:  # tpulint: disable=TPU201
+                logger.exception("ring monitor fetch failed; gauges stale")

@@ -12,6 +12,18 @@ import threading
 import time
 from collections import defaultdict
 
+# ---- multi-worker exposition constants (shared with serve/ipc.py) ----
+# Closed status set for the per-worker shared-memory request matrices
+# (the protocol layer's reason set); anything else lands in the
+# catch-all column rendered as status="other".
+RING_STATUSES = (200, 400, 404, 409, 413, 422, 500, 503)
+RING_CLASSES = ("small", "large")  # slot classes (ring depth/shed labels)
+# Field indices of the ring's monitor-aggregate block (engine-process
+# single writer; see RequestRing.write_monitor).
+MON_ROWS, MON_OUTLIERS, MON_BATCHES, MON_FETCHES, MON_FETCHED_AT, MON_HAS = (
+    range(6)
+)
+
 
 class ServingMetrics:
     # Fixed latency histogram buckets (ms).
@@ -130,3 +142,109 @@ class ServingMetrics:
                     f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
                 )
             return "\n".join(lines) + "\n"
+
+
+def render_ring_metrics(ring) -> str:
+    """Prometheus exposition for the MULTI-WORKER plane, rendered entirely
+    from the shared-memory ring (serve/ipc.py RequestRing — duck-typed
+    here to keep this module import-light): every front end's
+    request/latency block with a ``worker`` label, the
+    ``mlops_tpu_ring_depth`` / ``mlops_tpu_shed_total`` gauges for every
+    worker (always emitted, so a scrape proves each worker exists even
+    before it served traffic), and the engine-process monitor aggregate
+    (single-flight: only the engine's telemetry loop ever reads the
+    device; front ends serve this text from shm, so ANY of the N
+    SO_REUSEPORT workers answers a scrape with the full fleet view)."""
+    from mlops_tpu.schema import SCHEMA
+
+    routes = ServingMetrics.KNOWN_ROUTES + ("<other>",)
+    buckets = ServingMetrics.LATENCY_BUCKETS
+    lines = ["# TYPE mlops_tpu_requests_total counter"]
+    for w in range(ring.workers):
+        for r_i, route in enumerate(routes):
+            for s_i, status in enumerate(RING_STATUSES):
+                count = int(ring.req_counts[w, r_i, s_i])
+                if count:
+                    lines.append(
+                        f'mlops_tpu_requests_total{{route="{route}",'
+                        f'status="{status}",worker="{w}"}} {count}'
+                    )
+            other = int(ring.req_counts[w, r_i, len(RING_STATUSES)])
+            if other:
+                lines.append(
+                    f'mlops_tpu_requests_total{{route="{route}",'
+                    f'status="other",worker="{w}"}} {other}'
+                )
+    lines.append("# TYPE mlops_tpu_request_latency_ms histogram")
+    for w in range(ring.workers):
+        cumulative = 0
+        for edge, count in zip(buckets, ring.lat_counts[w]):
+            cumulative += int(count)
+            label = "+Inf" if edge == float("inf") else str(edge)
+            lines.append(
+                f'mlops_tpu_request_latency_ms_bucket{{le="{label}",'
+                f'worker="{w}"}} {cumulative}'
+            )
+        lines.append(
+            f'mlops_tpu_request_latency_ms_sum{{worker="{w}"}} '
+            f"{float(ring.lat_sum_ms[w])}"
+        )
+        lines.append(
+            f'mlops_tpu_request_latency_ms_count{{worker="{w}"}} '
+            f"{int(ring.lat_n[w])}"
+        )
+    lines.append("# TYPE mlops_tpu_ring_depth gauge")
+    for w in range(ring.workers):
+        for c_i, cls in enumerate(RING_CLASSES):
+            lines.append(
+                f'mlops_tpu_ring_depth{{worker="{w}",class="{cls}"}} '
+                f"{int(ring.inflight[w, c_i])}"
+            )
+    lines.append("# TYPE mlops_tpu_shed_total counter")
+    for w in range(ring.workers):
+        for c_i, cls in enumerate(RING_CLASSES):
+            lines.append(
+                f'mlops_tpu_shed_total{{worker="{w}",class="{cls}"}} '
+                f"{int(ring.shed[w, c_i])}"
+            )
+    lines.append("# TYPE mlops_tpu_rows_scored_total counter")
+    lines.append(
+        f"mlops_tpu_rows_scored_total {int(ring.mon_vals[MON_ROWS])}"
+    )
+    lines.append("# TYPE mlops_tpu_outliers_total counter")
+    lines.append(
+        f"mlops_tpu_outliers_total {int(ring.mon_vals[MON_OUTLIERS])}"
+    )
+    if ring.mon_vals[MON_HAS]:
+        lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
+        for feature, score in zip(SCHEMA.feature_names, ring.mon_drift_last):
+            lines.append(
+                f'mlops_tpu_feature_drift_score{{feature="{feature}"}} '
+                f"{float(score)}"
+            )
+        # Mean drift exists only on the device-accumulator path (written
+        # by RequestRing.write_monitor, which also counts fetches); the
+        # host-side fold for non-accumulating engines tracks no mean, and
+        # rendering zeros would read as "no drift" where the
+        # single-process server correctly emits no series at all.
+        if int(ring.mon_vals[MON_FETCHES]):
+            lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
+            for feature, score in zip(
+                SCHEMA.feature_names, ring.mon_drift_mean
+            ):
+                lines.append(
+                    f'mlops_tpu_feature_drift_mean{{feature="{feature}"}} '
+                    f"{float(score)}"
+                )
+    fetches = int(ring.mon_vals[MON_FETCHES])
+    if fetches:
+        lines.append("# TYPE mlops_tpu_monitor_fetches_total counter")
+        lines.append(f"mlops_tpu_monitor_fetches_total {fetches}")
+        lines.append("# TYPE mlops_tpu_monitor_batches_total counter")
+        lines.append(
+            f"mlops_tpu_monitor_batches_total {int(ring.mon_vals[MON_BATCHES])}"
+        )
+        age = time.monotonic() - float(ring.mon_vals[MON_FETCHED_AT])
+        lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
+        lines.append(f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}")
+    return "\n".join(lines) + "\n"

@@ -60,6 +60,27 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                         },
                         "422": {"description": "Request body failed validation"},
                         "413": {"description": "Batch exceeds the serving cap"},
+                        "503": {
+                            "description": (
+                                "Load shed or deadline. Overload: the "
+                                "admission queue for the request's bucket "
+                                "class is full; the response carries a "
+                                "Retry-After header (seconds) and the "
+                                "request was NOT scored — retry after the "
+                                "advertised delay. Deadline: the predict "
+                                "exceeded serve.request_timeout_s "
+                                "(no Retry-After header)."
+                            ),
+                            "headers": {
+                                "Retry-After": {
+                                    "description": (
+                                        "Seconds to wait before retrying "
+                                        "(present only on overload sheds)"
+                                    ),
+                                    "schema": {"type": "integer"},
+                                }
+                            },
+                        },
                     },
                 }
             },

@@ -14,97 +14,45 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import contextlib
-import json
 import logging
-import time
-import re
-import uuid
-from typing import Awaitable, Callable
-
-import pydantic
 
 from mlops_tpu.config import ServeConfig
-from mlops_tpu.schema import LoanApplicant
 from mlops_tpu.serve.batcher import MicroBatcher
 from mlops_tpu.serve.engine import InferenceEngine
+
+# The engine-free protocol layer lives in serve/httpcore.py (shared with
+# the multi-worker front ends); the names re-exported here keep the
+# seed-era import surface (`from mlops_tpu.serve.server import ...`)
+# working.
+from mlops_tpu.serve.httpcore import (  # noqa: F401  (re-exports)
+    HttpProtocol,
+    _DOCS_HTML,
+    _LazyJson,
+    _dumps,
+)
 from mlops_tpu.serve.metrics import ServingMetrics
 
 logger = logging.getLogger("mlops_tpu.serve")
 
-# Compact separators: the default ", "/": " pads every response body (and
-# both structured log events) with bytes pure of whitespace — on the c128
-# throughput path serialization is measurable hot-path CPU.
-def _dumps(payload) -> str:
-    return json.dumps(payload, separators=(",", ":"))
 
-
-class _LazyJson:
-    """Defer json.dumps of a log payload to %s-formatting time: the dumps
-    runs only when a handler actually emits the record, so a deployment
-    that filters (not just disables) INFO never pays per-request
-    serialization of full request/response bodies."""
-
-    __slots__ = ("_payload",)
-
-    def __init__(self, payload):
-        self._payload = payload
-
-    def __str__(self) -> str:
-        return _dumps(self._payload)
-
-
-_REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
-            409: "Conflict", 413: "Payload Too Large",
-            422: "Unprocessable Entity", 500: "Internal Server Error",
-            503: "Service Unavailable"}
-# (status, content_type) -> precomputed immutable head prefix. Statuses and
-# content types form a tiny closed set, so the f-string formatting + encode
-# of the static head runs once per pair instead of once per response.
-_HEAD_PREFIXES: dict[tuple[int, str], bytes] = {}
-_KEEP_ALIVE_TAIL = b"connection: keep-alive\r\n\r\n"
-_CLOSE_TAIL = b"connection: close\r\n\r\n"
-
-
-def _head_prefix(status: int, content_type: str) -> bytes:
-    prefix = _HEAD_PREFIXES.get((status, content_type))
-    if prefix is None:
-        reason = _REASONS.get(status, "OK")
-        prefix = _HEAD_PREFIXES[(status, content_type)] = (
-            f"HTTP/1.1 {status} {reason}\r\n"
-            f"content-type: {content_type}\r\n"
-        ).encode()
-    return prefix
-
-_DOCS_HTML = """<!doctype html>
-<html><head><title>{title}</title></head>
-<body style="font-family: sans-serif; max-width: 42rem; margin: 2rem auto">
-<h1>{title}</h1>
-<p>TPU-native credit-default inference service.</p>
-<ul>
-<li><code>POST /predict</code> — body: JSON list of loan-applicant records;
-returns <code>{{"predictions": [...], "outliers": [...],
-"feature_drift_batch": {{...}}}}</code></li>
-<li><code>GET /healthz/live</code> — liveness probe</li>
-<li><code>GET /healthz/ready</code> — readiness probe (model loaded + jit warm)</li>
-<li><code>GET /metrics</code> — Prometheus metrics</li>
-<li><code>POST /debug/profile/start</code>, <code>POST /debug/profile/stop</code>
-— capture a <code>jax.profiler</code> device trace (view in TensorBoard)</li>
-</ul>
-</body></html>"""
-
-
-class HttpServer:
-    MAX_BODY_BYTES = 16 * 1024 * 1024
-    MAX_HEADERS = 100
+class HttpServer(HttpProtocol):
+    """The single-process server: HTTP protocol + a live InferenceEngine
+    in one process (micro-batcher, predict thread pool, device-monitor
+    telemetry). The multi-worker plane (serve/frontend.py) runs the same
+    protocol in N SO_REUSEPORT processes against the shared-memory ring
+    instead."""
 
     def __init__(self, engine: InferenceEngine, config: ServeConfig):
+        super().__init__(config.validate())
         self.engine = engine
-        self.config = config
-        # Clamps land in LOCALS, never back into the caller's ServeConfig:
-        # a config object reused to build a second server (tests, multi-
+        # The request cap can never exceed the largest warmed bucket, or
+        # steady-state traffic would hit exact-shape recompiles. Clamps
+        # land in LOCALS, never back into the caller's ServeConfig: a
+        # config object reused to build a second server (tests, multi-
         # port deployments) must see its original values (ADVICE r5).
-        # Invariant: the request cap can never exceed the largest warmed
-        # bucket, or steady-state traffic would hit exact-shape recompiles.
+        # This one stays a runtime clamp (not a ServeConfig.validate
+        # error) because the bound is the ENGINE's bucket grid, which the
+        # config layer cannot see.
         self.max_batch = config.max_batch
         if config.max_batch > engine.max_bucket:
             logger.warning(
@@ -115,52 +63,30 @@ class HttpServer:
             self.max_batch = engine.max_bucket
         self.metrics = ServingMetrics()
         max_workers = max(1, config.max_workers)
-        # Dispatch bound + fetch ring (>= 1) + one thread of headroom (solo
-        # fast path, monitor fetch) must fit the pool, so the dispatch
-        # bound caps at max_workers - 2 — floor 1 keeps tiny pools
-        # (max_workers <= 2) functional even though they cannot honor the
-        # headroom invariant.
-        inflight_cap = max(1, max_workers - 2)
+        # validate() guarantees dispatch bound + fetch ring (>= 1) + one
+        # thread of headroom (solo fast path, monitor fetch) fit the pool.
         max_inflight = config.max_inflight
-        if not 1 <= config.max_inflight <= inflight_cap:
-            logger.warning(
-                "serve.max_inflight=%d outside [1, max_workers-2=%d]; "
-                "clamping (dispatch + fetch ring + headroom must fit the "
-                "predict pool; 0 would wedge dispatches)",
-                config.max_inflight,
-                inflight_cap,
-            )
-            max_inflight = min(max(1, config.max_inflight), inflight_cap)
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="predict"
         )
-        self._applicant_list = pydantic.TypeAdapter(list[LoanApplicant])
         self._profiling = False
-        self._openapi: dict | None = None  # built lazily, served cached
         # Device-resident monitor aggregate telemetry (serve/engine.py
         # monitor_snapshot): the request path only counts requests; the
         # aggregate is fetched OFF the hot path — after K requests, on the
         # T-second timer (started by start()), and on /metrics scrapes.
         # Concurrency note (tpulint Layer 3): every mutable field below
-        # (_monitor_requests, _monitor_task, _busy, _connections, draining)
-        # is EVENT-LOOP CONFINED — touched only from coroutines on the one
-        # asyncio thread, never from the predict executor — which is why
-        # none of them carries a lock. Work crossing into the executor goes
-        # through run_in_executor and returns via awaited futures; keep it
-        # that way rather than adding locks here.
+        # (_monitor_requests, _monitor_task, and the base class's drain
+        # sets) is EVENT-LOOP CONFINED — touched only from coroutines on
+        # the one asyncio thread, never from the predict executor — which
+        # is why none of them carries a lock. Work crossing into the
+        # executor goes through run_in_executor and returns via awaited
+        # futures; keep it that way rather than adding locks here.
         self._monitor_accumulating = bool(
             getattr(engine, "monitor_accumulating", False)
         )
         self._monitor_requests = 0  # predicts since the last fetch
         self._monitor_task: asyncio.Task | None = None
         self._monitor_timer_task: asyncio.Task | None = None
-        # Drain bookkeeping: open client transports and the subset with an
-        # exchange currently in flight (between request read and response
-        # write). SIGTERM closes idle transports immediately and lets busy
-        # ones finish their current response (serve/server.py::_serve).
-        self.draining = False
-        self._connections: set[asyncio.StreamWriter] = set()
-        self._busy: set[asyncio.StreamWriter] = set()
         self.batcher = MicroBatcher(
             engine,
             self._executor,
@@ -177,209 +103,44 @@ class HttpServer:
             ),
         )
 
-    # ----------------------------------------------------------- HTTP layer
-    async def handle_connection(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
-        self._connections.add(writer)
-        try:
-            while True:
-                # Line-by-line head read. This is NOT an event-loop cost:
-                # readline() on already-buffered bytes returns without
-                # suspending, so a whole head arriving in one TCP segment
-                # (the normal case) costs one suspension total. It also
-                # keeps the old tolerance for bare-LF request heads, which
-                # a single readuntil(b"\r\n\r\n") would hang on.
-                request_line = await reader.readline()
-                if not request_line:
-                    break
-                try:
-                    method, path, _ = request_line.decode("latin1").split(" ", 2)
-                except ValueError:
-                    await self._write_response(writer, 400, {"detail": "bad request"})
-                    break
-                headers = {}
-                header_error = False
-                while True:
-                    line = await reader.readline()
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                    if len(headers) >= self.MAX_HEADERS:
-                        header_error = True
-                        break
-                    name, _, value = line.decode("latin1").partition(":")
-                    headers[name.strip().lower()] = value.strip()
-                if header_error:
-                    await self._write_response(
-                        writer, 400, {"detail": "too many headers"}
-                    )
-                    break
-                body = b""
-                try:
-                    length = int(headers.get("content-length", 0) or 0)
-                except ValueError:
-                    await self._write_response(
-                        writer, 400, {"detail": "bad content-length"}
-                    )
-                    break
-                if length > self.MAX_BODY_BYTES:
-                    await self._write_response(
-                        writer,
-                        413,
-                        {"detail": f"body exceeds {self.MAX_BODY_BYTES} bytes"},
-                    )
-                    break
-                if length:
-                    body = await reader.readexactly(length)
+    # ------------------------------------------------------------- routes
+    def _ready(self) -> bool:
+        return bool(self.engine.ready)
 
-                # A draining server finishes the current exchange but
-                # advertises connection: close and stops looping.
-                keep_alive = (
-                    headers.get("connection", "keep-alive") != "close"
-                    and not self.draining
-                )
-                self._busy.add(writer)
-                try:
-                    start = time.perf_counter()
-                    request_id = self._request_id(headers)
-                    route_path = path.split("?", 1)[0]
-                    status, payload, content_type = await self._route(
-                        method, route_path, body, request_id
-                    )
-                    latency_ms = (time.perf_counter() - start) * 1e3
-                    self.metrics.observe_request(route_path, status, latency_ms)
-                    keep_alive = keep_alive and not self.draining
-                    await self._write_response(
-                        writer, status, payload, content_type, keep_alive,
-                        request_id=request_id,
-                    )
-                finally:
-                    self._busy.discard(writer)
-                if not keep_alive:
-                    break
-        except (
-            asyncio.IncompleteReadError,
-            ConnectionResetError,
-            BrokenPipeError,
+    async def _metrics_endpoint(self):
+        # Idle replicas scrape free: once a fetch has drained the
+        # device window and no predicts arrived since, the window
+        # is provably all-zero — skip the device round trip
+        # (~70-90 ms on a remote-attached chip) per scrape.
+        if self._monitor_accumulating and (
+            self._monitor_requests > 0
+            or self.metrics.monitor_fetches == 0
         ):
-            pass
-        finally:
-            self._connections.discard(writer)
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
-
-    _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
-
-    def _request_id(self, headers: dict) -> str:
-        """Honor a well-formed inbound ``x-request-id`` (so the caller's
-        trace id correlates the two log events end to end — the reference
-        only ever generates its own, `app/main.py:57`); mint one otherwise.
-        The charset/length gate keeps log-injection text out of the
-        structured stream."""
-        inbound = headers.get("x-request-id", "")
-        if inbound and self._REQUEST_ID_RE.match(inbound):
-            return inbound
-        return uuid.uuid4().hex
-
-    async def _write_response(
-        self,
-        writer: asyncio.StreamWriter,
-        status: int,
-        payload,
-        content_type: str = "application/json",
-        keep_alive: bool = True,
-        request_id: str | None = None,
-    ) -> None:
-        if isinstance(payload, (dict, list)):
-            body = _dumps(payload).encode()
-        elif isinstance(payload, str):
-            body = payload.encode()
-        else:
-            body = payload
-        # Static head parts are precomputed bytes (_head_prefix); only the
-        # per-response fields (length, request id) format here.
-        head = [
-            _head_prefix(status, content_type),
-            b"content-length: %d\r\n" % len(body),
-        ]
-        if request_id:
-            head.append(b"x-request-id: " + request_id.encode() + b"\r\n")
-        head.append(_KEEP_ALIVE_TAIL if keep_alive else _CLOSE_TAIL)
-        head.append(body)
-        writer.write(b"".join(head))
-        await writer.drain()
-
-    # -------------------------------------------------------------- routing
-    async def _route(
-        self, method: str, path: str, body: bytes, request_id: str | None = None
-    ):
-        if path == "/predict" and method == "POST":
-            return await self._predict(body, request_id)
-        if path.startswith("/debug/profile/") and method == "POST":
-            return self._profile(path.removeprefix("/debug/profile/"))
-        if method == "GET":
-            if path == "/":
-                # Interactive Swagger UI (reference parity: FastAPI serves
-                # its docs at `/`, `app/main.py:37`).
-                from mlops_tpu.serve.openapi import SWAGGER_HTML
-
-                return (
-                    200,
-                    SWAGGER_HTML.format(title=self.config.service_name),
-                    "text/html",
+            # Scrapes read FRESH: at most one aggregate fetch per
+            # scrape (Prometheus cadence, ~15 s) — the per-request
+            # path stays fetch-free. Awaits the single-flight slot
+            # (joining any fetch already in flight) so a scrape
+            # racing the K-trigger/timer can never apply an older
+            # snapshot after a newer one. BOUNDED + best-effort: a
+            # stalled device read (tunnel hang) or a failing one
+            # must never wedge or 500 the scrape — on timeout or
+            # error the gauges keep their last values (the task's
+            # done-callback logs the failure) and Prometheus still
+            # gets a page. shield(): the timeout abandons the wait,
+            # never cancels the shared fetch task. Flat 1 s,
+            # INDEPENDENT of the cadence knob in both directions: a
+            # raised monitor_fetch_every_s must not let a stalled
+            # fetch hold scrapes toward Prometheus's 10 s
+            # scrape_timeout, and a sub-second cadence must not
+            # shrink the wait below what a healthy remote-chip
+            # fetch needs.
+            timeout = 1.0
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(
+                    asyncio.shield(self._spawn_monitor_fetch()),
+                    timeout=timeout,
                 )
-            if path == "/docs/plain":
-                return 200, _DOCS_HTML.format(title=self.config.service_name), "text/html"
-            if path == "/openapi.json":
-                from mlops_tpu.serve.openapi import build_openapi
-
-                if self._openapi is None:
-                    self._openapi = build_openapi(self.config.service_name)
-                return 200, self._openapi, "application/json"
-            if path == "/healthz/live":
-                return 200, {"status": "alive"}, "application/json"
-            if path == "/healthz/ready":
-                if self.engine.ready:
-                    return 200, {"status": "ready"}, "application/json"
-                return 503, {"status": "warming"}, "application/json"
-            if path == "/metrics":
-                # Idle replicas scrape free: once a fetch has drained the
-                # device window and no predicts arrived since, the window
-                # is provably all-zero — skip the device round trip
-                # (~70-90 ms on a remote-attached chip) per scrape.
-                if self._monitor_accumulating and (
-                    self._monitor_requests > 0
-                    or self.metrics.monitor_fetches == 0
-                ):
-                    # Scrapes read FRESH: at most one aggregate fetch per
-                    # scrape (Prometheus cadence, ~15 s) — the per-request
-                    # path stays fetch-free. Awaits the single-flight slot
-                    # (joining any fetch already in flight) so a scrape
-                    # racing the K-trigger/timer can never apply an older
-                    # snapshot after a newer one. BOUNDED + best-effort: a
-                    # stalled device read (tunnel hang) or a failing one
-                    # must never wedge or 500 the scrape — on timeout or
-                    # error the gauges keep their last values (the task's
-                    # done-callback logs the failure) and Prometheus still
-                    # gets a page. shield(): the timeout abandons the wait,
-                    # never cancels the shared fetch task. Flat 1 s,
-                    # INDEPENDENT of the cadence knob in both directions: a
-                    # raised monitor_fetch_every_s must not let a stalled
-                    # fetch hold scrapes toward Prometheus's 10 s
-                    # scrape_timeout, and a sub-second cadence must not
-                    # shrink the wait below what a healthy remote-chip
-                    # fetch needs.
-                    timeout = 1.0
-                    with contextlib.suppress(Exception):
-                        await asyncio.wait_for(
-                            asyncio.shield(self._spawn_monitor_fetch()),
-                            timeout=timeout,
-                        )
-                return 200, self.metrics.render(), "text/plain; version=0.0.4"
-        return 404, {"detail": "not found"}, "application/json"
+        return 200, self.metrics.render(), "text/plain; version=0.0.4"
 
     def _profile(self, action: str):
         """On-demand device tracing (SURVEY.md SS5.1: the reference has no
@@ -410,45 +171,10 @@ class HttpServer:
             return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
         return 404, {"detail": "not found"}, "application/json"
 
-    async def _predict(self, body: bytes, request_id: str | None = None):
-        """The reference's `predict()` endpoint (`app/main.py:42-86`):
-        validate -> log InferenceData -> model -> log ModelOutput -> respond.
-        """
-        try:
-            records = self._applicant_list.validate_json(body)
-        except pydantic.ValidationError as err:
-            return 422, {"detail": json.loads(err.json())}, "application/json"
-        if len(records) > self.max_batch:
-            # Cap guards the compile cache: anything beyond the largest
-            # warmed bucket would trigger an exact-shape compile per novel
-            # size. Offline scoring of big files goes through predict-file.
-            return (
-                413,
-                {
-                    "detail": f"batch of {len(records)} exceeds "
-                    f"max_batch={self.max_batch}"
-                },
-                "application/json",
-            )
-
-        request_id = request_id or uuid.uuid4().hex
-        record_dicts = [r.model_dump() for r in records]
-        # Two layers keep log formatting off the hot path: isEnabledFor
-        # skips everything when the deployment silences INFO, and _LazyJson
-        # defers the dumps of the full payload to record-emit time (a
-        # filtered/sampled handler never serializes at all).
-        if logger.isEnabledFor(logging.INFO):
-            logger.info(
-                "%s",
-                _LazyJson(
-                    {
-                        "service_name": self.config.service_name,
-                        "type": "InferenceData",
-                        "request_id": request_id,
-                        "data": record_dicts,
-                    }
-                ),
-            )
+    async def _score(self, record_dicts: list[dict], request_id: str):
+        """The single-process scoring hook under the shared `_predict`
+        shell (serve/httpcore.py): micro-batcher -> engine, with the
+        deadline and failure contracts."""
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
@@ -494,19 +220,7 @@ class HttpServer:
             self._maybe_fetch_monitor()
         else:
             self.metrics.observe_prediction(response)
-        if logger.isEnabledFor(logging.INFO):
-            logger.info(
-                "%s",
-                _LazyJson(
-                    {
-                        "service_name": self.config.service_name,
-                        "type": "ModelOutput",
-                        "request_id": request_id,
-                        "data": response,
-                    }
-                ),
-            )
-        return 200, response, "application/json"
+        return response
 
     # ------------------------------------------------- monitor telemetry
     def _spawn_monitor_fetch(self) -> asyncio.Task:

@@ -1,0 +1,65 @@
+"""The serving wire contract, jax-free: group geometry + response shape.
+
+Both halves of the multi-worker plane need these without importing the
+engine (whose module pulls jax): the HTTP front-end processes
+(`serve/frontend.py`) size ring slabs and coalescing classes from the
+group geometry and format responses from raw arrays; the engine process
+uses the same constants to pick compiled shapes and the same formatter
+for its in-process fetch — which is what makes the two planes
+bit-identical by construction. `serve/engine.py` re-exports everything
+here, so historical imports keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.schema import SCHEMA
+
+# Micro-batching shape grid: concurrent requests coalesce into [R, B, ...]
+# stacks — R request-slots (padded up to a slot bucket), each padded to B
+# rows. Only small requests coalesce; big ones already fill the MXU alone.
+# Slot buckets go to 64: on a remote-attached chip every dispatch pays a
+# flat transport round trip (measured ~70-90 ms through this harness's
+# tunnel), so request throughput scales with requests-per-dispatch — 64
+# batch-1 requests in one vmapped program cost the same wall time as one.
+# Row buckets are (1, 8): batch-1 is the dominant serving shape and
+# padding it to 8 rows made every grouped dispatch compute 8x the rows it
+# returned — on CPU backends (serial compute) that padding was the
+# throughput ceiling. An all-batch-1 group now rides the [R, 1, ...]
+# family; mixed small sizes pad to 8 as before.
+GROUP_SLOT_BUCKETS = (2, 4, 8, 16, 32, 64)
+GROUP_ROW_BUCKETS = (1, 8)
+GROUP_ROW_BUCKET = GROUP_ROW_BUCKETS[-1]
+
+
+def format_response(
+    predictions: np.ndarray, outliers: np.ndarray, drift: np.ndarray
+) -> dict[str, Any]:
+    """Raw response arrays -> the reference response dict.
+
+    THE one formatting rule for every serving path: the in-process fetch
+    (`InferenceEngine.fetch_arrays`/`fetch_group`) and the multi-worker
+    front ends (which read the same f64 arrays back out of the
+    shared-memory ring) both format through here, so the two planes are
+    bit-identical by construction — the parity suite pins it
+    (tests/test_frontend.py). Inputs are the engine's raw-fetch contract:
+    f64 predictions/outliers of the request's row count and the f64 drift
+    vector already rounded to 6 places."""
+    return {
+        "predictions": predictions.tolist(),
+        "outliers": outliers.tolist(),
+        "feature_drift_batch": dict(zip(SCHEMA.feature_names, drift.tolist())),
+    }
+
+
+def empty_response() -> dict[str, Any]:
+    """The zero-row response (no device work, no drift signal) — shared by
+    `predict_arrays` and the front ends' local empty-request fast path."""
+    return {
+        "predictions": [],
+        "outliers": [],
+        "feature_drift_batch": dict.fromkeys(SCHEMA.feature_names, 0.0),
+    }

@@ -1,0 +1,169 @@
+"""CI live-server smoke: train -> `serve --workers 2` -> predict -> drain.
+
+The end-to-end proof that the multi-worker plane works as DEPLOYED (real
+CLI, real processes, real signals), not just under the in-process test
+harness:
+
+1. train a tiny bundle through the real CLI,
+2. launch `mlops-tpu serve --workers 2` (SO_REUSEPORT front ends + the
+   shared-memory ring) as a subprocess,
+3. wait for /healthz/ready (engine warmup),
+4. fire concurrent predicts from two separate connections and validate
+   the response contract (identical bodies -> identical responses),
+5. scrape /metrics and assert BOTH workers are present (ring gauges are
+   emitted per worker unconditionally) plus the request counters,
+6. SIGTERM the server and assert a clean drain: exit code 0, the drain
+   log line, and zero leaked-task warnings.
+
+Run from the repo root: `python scripts/serve_smoke.py` (CI pins
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RECORD = {"credit_limit": 12000, "age": 34}
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def post_predict(port: int, results: list, idx: int) -> None:
+    body = json.dumps([RECORD]).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=body,
+        headers={"content-type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        results[idx] = (resp.status, json.loads(resp.read()))
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="serve-smoke-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    print("# serve-smoke: training tiny bundle", flush=True)
+    train = subprocess.run(
+        [
+            sys.executable, "-m", "mlops_tpu", "train",
+            "data.rows=3000",
+            "model.hidden_dims=32,32", "model.embed_dim=4",
+            "train.steps=100", "train.eval_every=100",
+            "train.batch_size=256",
+            f"registry.root={tmp}/registry", f"registry.run_root={tmp}/runs",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    if train.returncode != 0:
+        print(train.stdout[-2000:], train.stderr[-2000:], sep="\n")
+        raise SystemExit("train failed")
+    bundle = json.loads(train.stdout.strip().splitlines()[-1])["bundle"]
+    print(f"# serve-smoke: bundle at {bundle}", flush=True)
+
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlops_tpu", "serve", "--workers", "2",
+            "serve.host=127.0.0.1", f"serve.port={port}",
+            f"serve.model_directory={bundle}",
+            "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    log_lines: list[str] = []
+    pump = threading.Thread(
+        target=lambda: log_lines.extend(iter(server.stdout.readline, "")),
+        daemon=True,
+    )
+    pump.start()
+    try:
+        print("# serve-smoke: waiting for readiness", flush=True)
+        deadline = time.time() + 600
+        ready = False
+        while time.time() < deadline and not ready:
+            if server.poll() is not None:
+                print("\n".join(log_lines[-50:]))
+                raise SystemExit("server died before readiness")
+            try:
+                status, _ = get(f"http://127.0.0.1:{port}/healthz/ready", 5)
+                ready = status == 200
+            except (urllib.error.URLError, OSError, urllib.error.HTTPError):
+                pass
+            if not ready:
+                time.sleep(1.0)
+        if not ready:
+            raise SystemExit("server never became ready")
+        print("# serve-smoke: ready; concurrent predicts", flush=True)
+
+        results: list = [None, None]
+        threads = [
+            threading.Thread(target=post_predict, args=(port, results, i))
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        for status, payload in results:
+            assert status == 200, results
+            assert set(payload) == {
+                "predictions", "outliers", "feature_drift_batch"
+            }, payload
+            assert len(payload["predictions"]) == 1
+        # Identical requests -> identical responses across connections
+        # (and therefore across whichever workers served them).
+        assert results[0][1] == results[1][1], results
+
+        status, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+        text = body.decode()
+        assert status == 200
+        for worker in (0, 1):
+            needle = f'mlops_tpu_ring_depth{{worker="{worker}",class="small"}}'
+            assert needle in text, f"worker {worker} missing from /metrics"
+        assert "mlops_tpu_requests_total" in text
+        print("# serve-smoke: /metrics shows both workers; draining",
+              flush=True)
+
+        server.send_signal(signal.SIGTERM)
+        rc = server.wait(timeout=90)
+        pump.join(timeout=10)
+        log = "\n".join(log_lines)
+        assert rc == 0, f"server exited {rc}"
+        assert "drained" in log, log[-2000:]
+        assert "Task was destroyed" not in log, log[-2000:]
+        assert "Traceback" not in log, log[-4000:]
+        print("# serve-smoke: OK (clean drain, zero leaked tasks)",
+              flush=True)
+        return 0
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

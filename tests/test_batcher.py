@@ -69,18 +69,21 @@ def test_fetch_ring_sizing_bounds_executor_footprint(engine):
     assert dispatch + fetch < workers
     server._executor.shutdown(wait=False)
 
-    # The clamp preserves the invariant for ANY config, not just the
-    # defaults: max_inflight == max_workers used to pass validation and
-    # leave zero headroom (dispatch + fetch > pool).
+    # Inconsistent geometry is REJECTED at startup with a named error
+    # (ServeConfig.validate), not silently clamped into server locals:
+    # max_inflight == max_workers used to pass validation, leave zero
+    # headroom (dispatch + fetch > pool), and serve with numbers the
+    # config never said.
+    import pytest
+
+    from mlops_tpu.config import ServeConfigError
+
     cfg = ServeConfig()
     cfg.max_workers = 4
     cfg.max_inflight = 4
-    server = HttpServer(engine, cfg)
-    dispatch = server.batcher._inflight._value
-    fetch = server.batcher._fetch_ring._value
-    assert dispatch + fetch < 4
+    with pytest.raises(ServeConfigError, match="max_inflight"):
+        HttpServer(engine, cfg)
     assert (cfg.max_workers, cfg.max_inflight) == (4, 4)  # never mutated
-    server._executor.shutdown(wait=False)
 
 
 def test_batcher_coalesces_concurrent_requests(engine, sample_request):
