@@ -10,13 +10,18 @@ over the zero-copy shared-memory ring (`serve/ipc.py`). The engine
 process owns everything expensive exactly once: the compile cache, the
 warmed exec tables, the device monitor accumulator.
 
-Process model (Linux): the parent builds the ring and reserves the port,
-FORKS the front ends BEFORE initializing any backend (children inherit
-the mmap + doorbells and never touch jax), then loads the bundle, warms
-the engine, and runs the ring service. Front ends restart freely — a
-crashed worker is respawned by the supervisor loop and re-attaches to
-its slot partition via the shm generation counters; the engine process
-is the one that must stay up (docs/operations.md "Multi-worker plane").
+Process model (Linux): the parent builds the ring, reserves the port,
+and FORKS one SPAWNER process (a zygote) BEFORE initializing any backend
+— the zygote inherits only the mmap + doorbells and never starts a
+thread, and it is the zygote that forks (and, when one crashes, refork)
+every front end. The parent then loads the bundle, warms the engine, and
+runs the ring service. Front ends restart freely — a crashed worker is
+respawned by the zygote within ~0.5 s and re-attaches to its slot
+partition via the shm generation counters; because every fork happens in
+the thread-free zygote, no child is ever forked from the engine's
+threaded world (jax/XLA runtime, dispatch pool, collector — the classic
+fork-after-threads deadlock). The engine process is the one that must
+stay up (docs/operations.md "Multi-worker plane").
 
 Load shedding: each front end's slot partition is its bounded admission
 queue, per bucket class (small/coalescable vs large/solo). No free slot
@@ -227,6 +232,13 @@ class FrontendServer(HttpProtocol):
             self.ring.worker_doorbells[self.worker_id].fileno(),
             self.client.on_doorbell,
         )
+        # One unconditional kick: a respawned client may have seeded
+        # credit for completions whose doorbell the DEAD incarnation
+        # already drained — the eventfd sits at 0, so add_reader alone
+        # would never fire, and with every slot quarantined no new
+        # traffic could ring it either (permanent 503s). A spurious call
+        # is harmless (zero credit pops nothing).
+        loop.call_soon(self.client.on_doorbell)
         return await asyncio.start_server(self.handle_connection, sock=sock)
 
     def stop_doorbell(self) -> None:
@@ -282,9 +294,11 @@ async def _run_frontend(
     async def _watch_plane() -> None:
         # Two drain triggers besides the direct SIGTERM: the engine
         # flipping the ring's shared drain flag (a front end forked
-        # mid-drain, or a missed signal), and a DEAD engine process — no
-        # response will ever arrive for a submitted slot, so drain
-        # immediately rather than serving timeouts.
+        # mid-drain, or a missed signal), and a DEAD parent — the zygote
+        # in production (it only exits after setting the drain flag or
+        # because the plane is coming down), the engine half in the test
+        # harness; either way nobody is supervising this worker anymore,
+        # so drain rather than linger.
         while not draining.is_set():
             await asyncio.sleep(1.0)
             if ring.draining:
@@ -292,7 +306,7 @@ async def _run_frontend(
                             worker_id)
                 _drain()
             elif os.getppid() != parent:
-                logger.error("frontend %d: engine process died; draining",
+                logger.error("frontend %d: parent process died; draining",
                              worker_id)
                 _drain()
 
@@ -328,24 +342,92 @@ def start_frontends(
     ]
 
 
+def _zygote_main(
+    config: ServeConfig, ring: RequestRing, preprocess_path: str
+) -> None:
+    """Spawner process: forked from the parent BEFORE the backend loads,
+    so every front end — the initial set and every respawn — forks from
+    this clean, thread-free world. Forking replacements from the engine
+    parent would snapshot a process whose collector, dispatch-pool, and
+    jax/XLA runtime threads may hold locks mid-flight; the child would
+    inherit those locks locked forever (fork-after-threads). The zygote
+    never starts a thread and never imports jax, so its forks are always
+    safe. It also supervises: a crashed front end is respawned until the
+    plane drains (SIGTERM, the ring's drain flag, or the engine process
+    dying)."""
+    stop = {"flag": False}
+
+    def _stop(signum=None, frame=None) -> None:
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    engine_pid = os.getppid()
+    procs = start_frontends(config, ring, preprocess_path)
+    logger.info(
+        "zygote %d spawned %d front ends (pids %s)",
+        os.getpid(), len(procs), [p.pid for p in procs],
+    )
+    while not stop["flag"] and not ring.draining:
+        time.sleep(0.5)
+        if os.getppid() != engine_pid:
+            # The engine is gone: no response will ever arrive for a
+            # submitted slot. Flip the shared drain flag so every front
+            # end stops accepting, then fall through to the join.
+            logger.error("zygote: engine process died; draining front ends")
+            ring.set_draining()
+            break
+        for i, proc in enumerate(procs):
+            if proc.is_alive() or stop["flag"]:
+                continue
+            logger.error(
+                "frontend %d (pid %s) died with exit code %s; respawning",
+                i, proc.pid, proc.exitcode,
+            )
+            procs[i] = _respawn(config, ring, preprocess_path, i)
+    for proc in procs:
+        if proc.is_alive() and proc.pid:
+            with contextlib.suppress(ProcessLookupError):
+                os.kill(proc.pid, signal.SIGTERM)
+    # One shared wall-clock budget for ALL joins (the children drain
+    # concurrently — per-child timeouts would compound when several are
+    # stuck), then SIGKILL the stragglers: they already ignored SIGTERM.
+    deadline = time.monotonic() + 35
+    for proc in procs:
+        proc.join(timeout=max(0.0, deadline - time.monotonic()))
+    for proc in procs:
+        if proc.is_alive():  # pragma: no cover - stuck child
+            proc.kill()
+            proc.join(timeout=5)
+
+
 # ----------------------------------------------------------------- parent
 def serve_multi_worker(config: Config, bundle_dir: str) -> int:
-    """Parent orchestration: ring -> fork front ends -> engine -> serve.
+    """Parent orchestration: ring -> fork zygote -> engine -> serve.
 
-    Order matters: the front ends fork BEFORE the bundle loads so no
-    backend state (device handles, runtime threads) crosses the fork;
-    the parent then becomes the engine process. Respawned front ends
-    (supervisor loop) do fork from the jax-initialized parent — safe
-    because the children never execute jax code paths — but the common
-    case forks from the clean pre-backend world.
+    Order matters: the zygote (which forks and supervises every front
+    end) forks BEFORE the bundle loads, so no backend state (device
+    handles, runtime threads) ever crosses a fork — respawns included,
+    because they fork from the zygote's thread-free world, never from
+    this jax-initialized parent. The parent then becomes the engine
+    process and only supervises the zygote.
     """
     from pathlib import Path
 
     serve_cfg = config.serve.validate()
-    if not hasattr(os, "fork") or not hasattr(socket, "SO_REUSEPORT"):
+    # eventfd is part of the gate, not just an optimization: the
+    # completion-credit protocol rides the eventfd counter, and the pipe
+    # fallback exists for dev harnesses, not deployments (macOS passes
+    # the fork + SO_REUSEPORT checks but has no eventfd).
+    if (
+        not hasattr(os, "fork")
+        or not hasattr(socket, "SO_REUSEPORT")
+        or not hasattr(os, "eventfd")
+    ):
         raise SystemExit(
-            "serve.workers > 1 needs fork + SO_REUSEPORT (Linux); run "
-            "single-process (serve.workers=0) on this platform"
+            "serve.workers > 1 needs fork + SO_REUSEPORT + eventfd "
+            "(Linux); run single-process (serve.workers=0) on this "
+            "platform"
         )
     preprocess_path = str(Path(bundle_dir) / "preprocess.npz")
     if not Path(preprocess_path).is_file():
@@ -383,11 +465,17 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     child_cfg = dataclasses.replace(
         serve_cfg, port=placeholder.getsockname()[1], max_batch=max_batch
     )
-    procs = start_frontends(child_cfg, ring, preprocess_path)
+    zygote = multiprocessing.get_context("fork").Process(
+        target=_zygote_main,
+        args=(child_cfg, ring, preprocess_path),
+        name="mlops-tpu-zygote",
+    )
+    zygote.start()
     logger.info(
-        "serving %s on %s:%s with %d SO_REUSEPORT front ends (pids %s)",
+        "serving %s on %s:%s with %d SO_REUSEPORT front ends "
+        "(zygote pid %s)",
         serve_cfg.service_name, child_cfg.host, child_cfg.port,
-        len(procs), [p.pid for p in procs],
+        serve_cfg.workers, zygote.pid,
     )
 
     stopping = {"sigterm": False}
@@ -434,31 +522,37 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             _LazyJson(getattr(engine, "warmup_stats", {})),
         )
 
-        # ---- supervise: respawn crashed front ends until SIGTERM ----
+        # ---- supervise the zygote (it supervises the front ends; this
+        # process must never fork again now that jax threads exist) ----
         while not stopping["sigterm"]:
             time.sleep(0.5)
-            for i, proc in enumerate(procs):
-                if proc.is_alive() or stopping["sigterm"]:
-                    continue
+            if not zygote.is_alive():
+                # Without the zygote no crashed front end can ever be
+                # respawned; exit nonzero so the orchestrator restarts
+                # the pod instead of limping with shrinking capacity.
                 logger.error(
-                    "frontend %d (pid %s) died with exit code %s; respawning",
-                    i, proc.pid, proc.exitcode,
+                    "zygote (pid %s) died with exit code %s; front-end "
+                    "respawn is impossible — exiting for restart",
+                    zygote.pid, zygote.exitcode,
                 )
-                procs[i] = _respawn(child_cfg, ring, preprocess_path, i)
+                return 1
         return 0
     finally:
         # ---- graceful drain ----
         ring.set_draining()
         ring.set_ready(False)
-        for proc in procs:
-            if proc.is_alive() and proc.pid:
-                with contextlib.suppress(ProcessLookupError):
-                    os.kill(proc.pid, signal.SIGTERM)
-        for proc in procs:
-            proc.join(timeout=35)
-            if proc.is_alive():  # pragma: no cover - stuck child
-                proc.terminate()
-                proc.join(timeout=5)
+        if zygote.is_alive() and zygote.pid:
+            with contextlib.suppress(ProcessLookupError):
+                os.kill(zygote.pid, signal.SIGTERM)
+        # The zygote forwards SIGTERM, joins every front end against one
+        # shared 35 s deadline (+5 s kill grace), then exits — give it
+        # that window plus slack. A zygote still alive after that already
+        # ignored one SIGTERM (its handler only sets a flag the join
+        # loops don't consult), so escalate straight to SIGKILL.
+        zygote.join(timeout=50)
+        if zygote.is_alive():  # pragma: no cover - stuck zygote
+            zygote.kill()
+            zygote.join(timeout=5)
         if service is not None:
             service.stop()
         placeholder.close()
@@ -471,7 +565,9 @@ def _respawn(
 ) -> multiprocessing.Process:
     """Fork a replacement front end for one worker slot partition (the
     generation counters in shm make any of the dead worker's in-flight
-    completions stale on arrival)."""
+    completions stale on arrival). Call only from a process without
+    running threads — the zygote in production, the harness process in
+    tests — never from the engine once its backend is up."""
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_frontend_main,

@@ -219,25 +219,52 @@ class HttpProtocol:
                     await self._write_response(writer, 400, {"detail": "bad request"})
                     break
                 headers = {}
-                header_error = False
+                header_error = None
                 while True:
                     line = await reader.readline()
                     if line in (b"\r\n", b"\n", b""):
                         break
                     if len(headers) >= self.MAX_HEADERS:
-                        header_error = True
+                        header_error = "too many headers"
                         break
                     name, _, value = line.decode("latin1").partition(":")
-                    headers[name.strip().lower()] = value.strip()
+                    name = name.strip().lower()
+                    if name == "content-length" and name in headers:
+                        # Duplicate Content-Length lines would collapse
+                        # last-wins in the dict while a conformant
+                        # intermediary rejects or picks another value
+                        # (RFC 9110 §8.6) — the desync is a smuggling
+                        # vector, so reject instead.
+                        header_error = "duplicate content-length"
+                        break
+                    headers[name] = value.strip()
                 if header_error:
                     await self._write_response(
-                        writer, 400, {"detail": "too many headers"}
+                        writer, 400, {"detail": header_error}
+                    )
+                    break
+                if "transfer-encoding" in headers:
+                    # No TE support: reading the chunk framing as the
+                    # next pipelined request would desync the connection
+                    # (RFC 9112 §6.1 smuggling vector) — reject and
+                    # CLOSE rather than guess at the body length.
+                    await self._write_response(
+                        writer, 400,
+                        {"detail": "transfer-encoding not supported"},
+                        keep_alive=False,
                     )
                     break
                 body = b""
-                try:
-                    length = int(headers.get("content-length", 0) or 0)
-                except ValueError:
+                # RFC 9110: Content-Length is 1*DIGIT. Bare int() also
+                # accepts '+5', '-1', '1_0', and unicode digits — parser
+                # disagreement with conformant intermediaries (request
+                # smuggling class), so gate on ASCII digits explicitly.
+                raw_length = headers.get("content-length", "")
+                if raw_length.isascii() and raw_length.isdigit():
+                    length = int(raw_length)
+                elif not raw_length:
+                    length = 0
+                else:
                     await self._write_response(
                         writer, 400, {"detail": "bad content-length"}
                     )

@@ -15,9 +15,15 @@ Topology and ownership:
   forking the front ends — no named segments, no resource-tracker
   cleanup, freed by the kernel when the last process unmaps.
 - Every front end owns a fixed PARTITION of the slots (its admission
-  queue): slot claim/release is event-loop confined per worker, so the
-  only cross-process lock in the whole plane is the one guarding the
-  submission queue's head index.
+  queue): slot claim/release is event-loop confined per worker. The only
+  cross-process lock a FRONT END ever takes is the submission queue's
+  head lock (microseconds of index arithmetic); the completion queue's
+  lock belongs to engine threads alone, and the completion consumer is
+  lock-free — its ordering fence on weakly-ordered CPUs is the COUNTED
+  doorbell (the eventfd value carries the number of published
+  completions; the consumer only consumes what a drained ring has
+  credited). A kill -9'd front end therefore cannot orphan the
+  completion lock and wedge the engine.
 - Two slot classes per worker: ``small`` slabs hold up to
   ``GROUP_ROW_BUCKET`` rows (the coalescable class — batch-1 traffic),
   ``large`` slabs hold up to ``max_batch`` rows (solo dispatches; small
@@ -76,22 +82,30 @@ logger = logging.getLogger("mlops_tpu.serve")
 # both halves of tpulint Layer 3 (static: analysis/concurrency.py TPU401;
 # runtime: analysis/lockcheck.py in the perturbed stress tests).
 #
-# RequestRing._submit_lock is the ONE cross-process lock (submission-queue
-# head index); it is a leaf — nothing is ever acquired under it, and it
-# is never held across slab writes, doorbells, or blocking work.
+# RequestRing._submit_lock and ._complete_lock are the two cross-process
+# locks (one per descriptor queue's head index). Beyond mutual exclusion
+# they order the producers' stores: plain numpy stores alone would only
+# be ordered under x86 TSO, and a weakly-ordered CPU (aarch64) could
+# otherwise observe a head bump before the slab bytes it advertises. On
+# the submission queue the consumer (engine collector) takes the same
+# lock, completing the fence; on the completion queue the consumer is
+# LOCK-FREE — only engine threads ever acquire ``_complete_lock``, so a
+# crashed front end cannot orphan it — and the consumer-side fence is
+# the counted doorbell instead (`Doorbell.ring(count)` / credit-limited
+# `pop_completions`). Both locks are leaves — nothing is ever acquired
+# under them, and neither is held across slab writes, doorbells, or
+# blocking work.
 #
 # RingService: ``_inflight`` is the dispatch bound, acquired by the
 # collector thread and released by the pool thread that finishes the job
 # — a cross-method/cross-thread pair exactly like the micro-batcher's
-# (declared below for TPU404). ``_complete_lock`` serializes pool threads
-# producing into a worker's completion queue; ``_mon_lock`` guards the
-# host-side monitor fold for engines without a device accumulator. Both
-# are leaves; the only nesting anywhere is (conceptually) holding an
-# ``_inflight`` permit while taking them, which the declared order
-# permits.
+# (declared below for TPU404). ``_mon_lock`` guards the host-side monitor
+# fold for engines without a device accumulator; a leaf. The only nesting
+# anywhere is (conceptually) holding an ``_inflight`` permit while taking
+# a leaf, which the declared order permits.
 TPULINT_LOCK_ORDER = {
-    "RequestRing": ("_submit_lock",),
-    "RingService": ("_inflight", "_complete_lock", "_mon_lock"),
+    "RequestRing": ("_submit_lock", "_complete_lock"),
+    "RingService": ("_inflight", "_mon_lock"),
 }
 TPULINT_CROSS_METHOD_SEMAPHORES = {"RingService": ("_inflight",)}
 
@@ -108,25 +122,42 @@ class Doorbell:
     non-blocking self-pipe otherwise. Created before fork, shared by
     inheritance. ``ring()`` never blocks (a full pipe already means the
     reader has a pending wakeup) and tolerates a closed peer (a crashed
-    front end must not take the engine down with EPIPE)."""
+    front end must not take the engine down with EPIPE).
+
+    ``ring(count)`` / ``drain() -> count`` make the doorbell a COUNTER,
+    not just a wakeup: the worker doorbells carry the number of
+    completions published, and that count is the consumer's CREDIT (see
+    `RingClient.on_doorbell`). The eventfd write/read pair synchronizes
+    through the kernel, so every store the producer made before ringing
+    is visible to the consumer after draining — the cross-process fence
+    that lets the completion consumer stay lock-free on weakly-ordered
+    CPUs."""
 
     def __init__(self) -> None:
         if hasattr(os, "eventfd"):
             fd = os.eventfd(0, os.EFD_NONBLOCK)
             self._rfd = self._wfd = fd
-            self._token = (1).to_bytes(8, "little")
         else:  # pragma: no cover - non-Linux fallback
             self._rfd, self._wfd = os.pipe()
             os.set_blocking(self._rfd, False)
             os.set_blocking(self._wfd, False)
-            self._token = b"\x01"
 
     def fileno(self) -> int:
         return self._rfd
 
-    def ring(self) -> None:
+    def ring(self, count: int = 1) -> None:
+        if self._rfd == self._wfd:
+            payload = count.to_bytes(8, "little")  # eventfd accumulates
+        else:  # pragma: no cover - non-Linux fallback
+            # One byte per unit of credit. Un-drained bytes are bounded
+            # by the completion queue's capacity (a slot cannot complete
+            # again before its credit is consumed), orders of magnitude
+            # under the 64 KiB pipe buffer — the fallback still exists
+            # only for dev harnesses; production multi-worker serving
+            # gates on eventfd (serve_multi_worker).
+            payload = b"\x01" * count
         try:
-            os.write(self._wfd, self._token)
+            os.write(self._wfd, payload)
         except (BlockingIOError, BrokenPipeError, OSError):
             pass  # full pipe = wakeup already pending; closed peer = gone
 
@@ -139,13 +170,21 @@ class Doorbell:
             return True
         return False
 
-    def drain(self) -> None:
+    def drain(self) -> int:
+        """Swallow the pending count and return it (0 on a spurious or
+        already-drained wake)."""
+        total = 0
         try:
-            while os.read(self._rfd, 8):
+            while True:
+                data = os.read(self._rfd, 8)
+                if not data:
+                    break
                 if self._rfd == self._wfd:
-                    break  # eventfd: one read swallows the whole counter
+                    return int.from_bytes(data, "little")  # whole counter
+                total += len(data)  # pragma: no cover - pipe fallback
         except (BlockingIOError, OSError):
             pass
+        return total
 
     def close(self) -> None:
         for fd in {self._rfd, self._wfd}:
@@ -171,9 +210,13 @@ class RequestRing:
     All multi-word data races are excluded by ownership (a slot belongs
     to exactly one side between claim and completion; stats blocks have
     one writer each); the descriptor queues use 8-byte aligned
-    head/tail counters whose producers are serialized by
-    ``_submit_lock`` (submissions, cross-process) or the service's
-    ``_complete_lock`` (completions, engine threads only).
+    head/tail counters. Submissions: producers and the consumer share
+    ``_submit_lock``, whose acquire/release pairing orders the slab
+    stores against the head bump on weakly-ordered CPUs. Completions:
+    producers (engine threads only) share ``_complete_lock``; the
+    consumer is lock-free and is fenced by the counted doorbell credit
+    instead (see `pop_completions`) — front ends never take this lock,
+    so front-end crashes can never orphan it.
     """
 
     def __init__(
@@ -263,9 +306,11 @@ class RequestRing:
             ).reshape(shape)
             setattr(self, name, view)
 
-        # The one cross-process lock (submission head/tail); "fork"
+        # The two cross-process locks (one per descriptor queue); "fork"
         # context — the whole plane is built on inheritance.
-        self._submit_lock = multiprocessing.get_context("fork").Lock()
+        ctx = multiprocessing.get_context("fork")
+        self._submit_lock = ctx.Lock()
+        self._complete_lock = ctx.Lock()
         self.engine_doorbell = Doorbell()
         self.worker_doorbells = [Doorbell() for _ in range(workers)]
 
@@ -346,23 +391,38 @@ class RequestRing:
         return out
 
     def push_completion(self, slot: int, gen: int) -> None:
-        """Engine side: hand a finished slot back to its owner. Producers
-        (pool threads) must serialize externally (RingService holds
-        ``_complete_lock``); the consumer is the owning front end's event
-        loop, which only ever advances the tail — capacity equals the
-        worker's slot count, so the queue can never overflow."""
+        """Engine side: hand a finished slot back to its owner. The lock
+        (acquired by ENGINE threads only — a crashed front end can never
+        orphan it and wedge the plane) serializes producing pool threads;
+        its acquisition order IS the queue order, so the counted doorbell
+        rung after a batch's last push fences every earlier-queued entry
+        too (the push of a later entry acquires the lock after the
+        earlier push released it). Capacity equals the worker's slot
+        count, so the queue can never overflow."""
         worker = self.slot_owner(slot)
         cap = self.comp_entries.shape[1]
-        head = int(self.comp_head[worker])
-        self.comp_entries[worker, head % cap] = _pack(slot, gen)
-        self.comp_head[worker] = head + 1
+        with self._complete_lock:
+            head = int(self.comp_head[worker])
+            self.comp_entries[worker, head % cap] = _pack(slot, gen)
+            self.comp_head[worker] = head + 1
 
-    def pop_completions(self, worker: int) -> list[tuple[int, int]]:
-        """Front-end side (single consumer per worker)."""
+    def pop_completions(
+        self, worker: int, limit: int | None = None
+    ) -> list[tuple[int, int]]:
+        """Front-end side (single consumer per worker): LOCK-FREE — the
+        tail has one writer (this consumer) and the consumer never
+        touches a cross-process lock, so a kill -9'd front end cannot
+        wedge the ring. Ordering safety comes from ``limit``: callers
+        pass the credit accumulated from the counted doorbell, and an
+        entry is only consumed once a doorbell rung AFTER its publication
+        has been drained (the eventfd syscall pair is the fence). Entries
+        beyond the credit wait for their ring."""
         out: list[tuple[int, int]] = []
         cap = self.comp_entries.shape[1]
         head = int(self.comp_head[worker])
         tail = int(self.comp_tail[worker])
+        if limit is not None:
+            head = min(head, tail + limit)
         while tail < head:
             out.append(_unpack(int(self.comp_entries[worker, tail % cap])))
             tail += 1
@@ -456,7 +516,26 @@ class RingClient:
                 self._quarantined.add(slot)
             else:
                 self._free[ring.slot_class(slot)].append(slot)
+        # The ring_depth gauge restarts at the quarantined-slot count, not
+        # zero: those slots are still occupied (the engine may be writing
+        # them) and the drain path in `on_doorbell` decrements as each one
+        # returns to the free list — so the gauge never undercounts after
+        # a worker crash.
         ring.inflight[worker, :] = 0
+        for slot in self._quarantined:
+            ring.inflight[worker, ring.slot_class(slot)] += 1
+        # Completion-consumption CREDIT (see pop_completions): normally
+        # accumulated from the counted doorbell; seeded here with the
+        # entries already queued, whose doorbell credit a dead
+        # incarnation may have drained and taken to its grave. A push
+        # racing this exact read could hand over a half-published entry —
+        # the gen/pending checks in on_doorbell drop it, costing at most
+        # one quarantined slot of capacity until the pod restarts (the
+        # same documented leak class as a crash between busy-flag and
+        # descriptor push), never a corrupt response.
+        self._credit = int(ring.comp_head[worker]) - int(
+            ring.comp_tail[worker]
+        )
         # slot -> (generation, future). A future that died waiting (the
         # request deadline) leaves its entry as a ZOMBIE: the slot is NOT
         # reusable until the engine's completion arrives — reusing it
@@ -522,10 +601,18 @@ class RingClient:
         it when the engine answers (never reuse a slab with an engine
         write potentially in flight)."""
         entry = self._pending.get(slot)
+        if entry is None:
+            # Already handled: `asyncio.wait_for` yields to the loop
+            # between cancelling the future and raising TimeoutError, and
+            # if the completion lands in that window `on_doorbell`'s
+            # zombie path releases the slot first. Releasing again here
+            # would put the slot on the free list twice — two requests
+            # sharing one slab — and underflow the inflight gauge.
+            return
         # A deadline-CANCELLED future means the engine's answer is still
         # in flight — only a future that actually carries the response
         # (done, not cancelled) proves the slab is quiescent.
-        if entry is None or (entry[1].done() and not entry[1].cancelled()):
+        if entry[1].done() and not entry[1].cancelled():
             self.release(slot)
 
     def response_arrays(
@@ -541,8 +628,16 @@ class RingClient:
         incarnation — the engine answering them is the proof their slabs
         are quiescent)."""
         ring = self.ring
-        ring.worker_doorbells[self.worker].drain()
-        for slot, gen in ring.pop_completions(self.worker):
+        credit = self._credit + ring.worker_doorbells[self.worker].drain()
+        self._credit = 0
+        # Any credit beyond what pops is SURPLUS, not a future
+        # entitlement (entries are always published before their ring,
+        # and a respawn's seeded credit can overlap the dead
+        # incarnation's still-undrained doorbell) — discard it rather
+        # than let a later consume run ahead of the fence; un-credited
+        # entries always arrive with their own ring.
+        popped = ring.pop_completions(self.worker, limit=credit)
+        for slot, gen in popped:
             entry = self._pending.get(slot)
             if entry is None or entry[0] != gen:
                 # Stale generation: a completion addressed to the dead
@@ -551,7 +646,9 @@ class RingClient:
                 if slot in self._quarantined:
                     self._quarantined.discard(slot)
                     ring.slot_busy[slot] = 0
-                    self._free[ring.slot_class(slot)].append(slot)
+                    cls = ring.slot_class(slot)
+                    self._free[cls].append(slot)
+                    ring.inflight[self.worker, cls] -= 1
                 continue
             _, future = entry
             if future.done() or future.cancelled():
@@ -609,7 +706,6 @@ class RingService:
             max_workers=max(2, threads), thread_name_prefix="ring"
         )
         self._inflight = threading.BoundedSemaphore(max_inflight)
-        self._complete_lock = threading.Lock()
         self._mon_lock = threading.Lock()
         self._stop = threading.Event()
         self._collector: threading.Thread | None = None
@@ -709,13 +805,15 @@ class RingService:
                     resp_drift[:] = drift
                 ring.resp_status[slot] = status
                 ring.resp_gen[slot] = gen
-            owners = set()
+            # The doorbell count IS the owner's consumption credit: ring
+            # AFTER the pushes with how many landed, per owner.
+            owners: dict[int, int] = {}
             for slot, gen in job:
-                with self._complete_lock:
-                    ring.push_completion(slot, gen)
-                owners.add(ring.slot_owner(slot))
-            for worker in owners:
-                ring.worker_doorbells[worker].ring()
+                ring.push_completion(slot, gen)
+                owner = ring.slot_owner(slot)
+                owners[owner] = owners.get(owner, 0) + 1
+            for worker, count in owners.items():
+                ring.worker_doorbells[worker].ring(count)
         finally:
             self._inflight.release()
 

@@ -12,7 +12,10 @@ harness:
    the response contract (identical bodies -> identical responses),
 5. scrape /metrics and assert BOTH workers are present (ring gauges are
    emitted per worker unconditionally) plus the request counters,
-6. SIGTERM the server and assert a clean drain: exit code 0, the drain
+6. kill -9 one front end and assert the zygote respawns it (the spawner
+   forked before the backend loaded — replacements never fork from the
+   engine's threaded world) and the plane keeps serving,
+7. SIGTERM the server and assert a clean drain: exit code 0, the drain
    log line, and zero leaked-task warnings.
 
 Run from the repo root: `python scripts/serve_smoke.py` (CI pins
@@ -23,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -145,8 +149,37 @@ def main() -> int:
             needle = f'mlops_tpu_ring_depth{{worker="{worker}",class="small"}}'
             assert needle in text, f"worker {worker} missing from /metrics"
         assert "mlops_tpu_requests_total" in text
-        print("# serve-smoke: /metrics shows both workers; draining",
-              flush=True)
+        print("# serve-smoke: /metrics shows both workers", flush=True)
+
+        # Kill -9 one front end: the zygote (forked before the backend
+        # loaded, so its forks never cross jax threads) must respawn it
+        # and the plane must keep serving.
+        spawn_line = next(line for line in log_lines if "spawned" in line)
+        pids = [
+            int(p) for p in
+            re.findall(r"\d+", spawn_line.split("(pids", 1)[1])
+        ]
+        os.kill(pids[0], signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            "respawning" in line for line in log_lines
+        ):
+            time.sleep(0.2)
+        assert any("respawning" in line for line in log_lines), (
+            "zygote never respawned the killed front end"
+        )
+        deadline = time.time() + 30
+        served = False
+        while time.time() < deadline and not served:
+            try:
+                results = [None]
+                post_predict(port, results, 0)
+                served = results[0][0] == 200
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.2)
+        assert served, "plane stopped serving after front-end respawn"
+        print("# serve-smoke: killed front end respawned by zygote; "
+              "draining", flush=True)
 
         server.send_signal(signal.SIGTERM)
         rc = server.wait(timeout=90)

@@ -285,10 +285,40 @@ def _edge_case_suite(port):
     assert status == 413
     assert b"exceeds" in payload
 
-    # 3) malformed Content-Length: 400, connection closed, no crash.
+    # 3) malformed Content-Length: 400, connection closed, no crash —
+    # non-numeric, negative, and the Python-only int() spellings RFC 9110
+    # forbids ('+5', '1_0' would parse but disagree with conformant
+    # intermediaries: request-smuggling surface).
+    for bad_length in (b"abc", b"-1", b"+5", b"1_0"):
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as sock:
+            sock.sendall(
+                b"POST /predict HTTP/1.1\r\nhost: t\r\ncontent-length: "
+                + bad_length + b"\r\n\r\n"
+            )
+            with sock.makefile("rb") as f:
+                status, _, _ = _recv_response(f)
+        assert status == 400, bad_length
+
+    # 3c) Transfer-Encoding is unsupported: reject AND close — reading
+    # the chunk framing as a next pipelined request would desync the
+    # connection (request-smuggling class).
     with socket.create_connection(("127.0.0.1", port), timeout=30) as sock:
         sock.sendall(
-            b"POST /predict HTTP/1.1\r\nhost: t\r\ncontent-length: abc\r\n\r\n"
+            b"POST /predict HTTP/1.1\r\nhost: t\r\n"
+            b"transfer-encoding: chunked\r\n\r\n"
+            b"5\r\nAAAAA\r\n0\r\n\r\n"
+        )
+        with sock.makefile("rb") as f:
+            status, _, _ = _recv_response(f)
+            assert status == 400
+            assert f.readline() == b"", "connection must close, not re-parse"
+
+    # 3d) duplicate Content-Length lines: 400 (last-wins parsing would
+    # disagree with conformant intermediaries — smuggling class).
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as sock:
+        sock.sendall(
+            b"POST /predict HTTP/1.1\r\nhost: t\r\n"
+            b"content-length: 4\r\ncontent-length: 30\r\n\r\n[{}]"
         )
         with sock.makefile("rb") as f:
             status, _, _ = _recv_response(f)
@@ -524,6 +554,80 @@ def test_respawn_quarantines_inflight_slots_until_engine_answers(prep_path):
         for t in threads:
             t.join(timeout=30)
         assert [r[0] for r in results] == [200, 200]
+
+
+# ------------------------------------------------- slot accounting (unit)
+def test_abandon_after_zombie_release_is_a_noop():
+    """`asyncio.wait_for` cancels the deadline future and yields to the
+    loop before TimeoutError reaches the handler; if the completion lands
+    in that window, `on_doorbell`'s zombie path releases the slot first.
+    The late `abandon()` must then do nothing — releasing again would put
+    the slot on the free list twice (two requests sharing one slab) and
+    underflow the inflight gauge."""
+    import asyncio
+
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.ipc import RingClient
+
+    async def scenario():
+        ring = RequestRing(
+            workers=1, slots_small=2, slots_large=1, large_rows=8
+        )
+        try:
+            client = RingClient(ring, 0)
+            slot = client.claim(1)
+            cat = np.zeros((1, SCHEMA.num_categorical), np.int32)
+            num = np.zeros((1, SCHEMA.num_numeric), np.float32)
+            future = client.submit(slot, cat, num)
+            future.cancel()  # the deadline fired mid-wait_for
+            # ...and the engine's completion lands in the cancellation
+            # window, before the TimeoutError handler runs:
+            gen = int(ring.slot_gen[slot])
+            ring.resp_status[slot] = 0
+            ring.resp_gen[slot] = gen
+            ring.push_completion(slot, gen)
+            ring.worker_doorbells[0].ring(1)  # publish the credit
+            client.on_doorbell()  # zombie path releases the slot
+            free = sum(len(f) for f in client._free)
+            inflight = int(ring.inflight.sum())
+            assert inflight == 0
+            client.abandon(slot)  # the late TimeoutError handler
+            assert sum(len(f) for f in client._free) == free, "double free"
+            assert int(ring.inflight.sum()) == inflight, "gauge underflow"
+        finally:
+            ring.close()
+
+    asyncio.run(scenario())
+
+
+def test_respawned_client_counts_quarantined_slots_as_inflight():
+    """The ring_depth gauge must not undercount across a worker crash: a
+    respawned incarnation starts its inflight gauge at the quarantined
+    (inherited-busy) slot count, and the quarantine drain decrements it
+    as the engine's completions free each slot."""
+    from mlops_tpu.serve.ipc import LARGE, SMALL, RingClient
+
+    ring = RequestRing(workers=1, slots_small=2, slots_large=1, large_rows=8)
+    try:
+        small, _ = ring.worker_slots(0)
+        busy = small[0]
+        ring.slot_busy[busy] = 1  # the dead incarnation's in-flight slot
+        # Worst-case ordering: the engine answered (stale generation) and
+        # the DEAD incarnation drained the doorbell credit before dying —
+        # the respawned client must seed its credit from the entries
+        # already queued, or the quarantine would never drain.
+        ring.push_completion(busy, int(ring.slot_gen[busy]))
+        ring.worker_doorbells[0].ring(1)
+        ring.worker_doorbells[0].drain()  # credit died with the worker
+        client = RingClient(ring, 0)
+        assert int(ring.inflight[0, SMALL]) == 1
+        assert int(ring.inflight[0, LARGE]) == 0
+        assert client._credit == 1
+        client.on_doorbell()
+        assert int(ring.inflight[0, SMALL]) == 0
+        assert busy in client._free[SMALL]
+    finally:
+        ring.close()
 
 
 # ---------------------------------------------------------- lock hygiene
