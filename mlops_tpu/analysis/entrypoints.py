@@ -11,7 +11,13 @@ jaxpr the analyzer inspects is the program production compiles:
 - ``serve-predict-packed`` — `ops/predict.py make_packed_predict_base`
   (the serving hot path in its packed single-buffer cacheable form: one
   flat f32 output + the device monitor accumulator), traced at every
-  warmup bucket the engine compiles.
+  warmup bucket the engine compiles. The lifecycle shadow's candidate
+  warmup (`lifecycle/shadow.py`) is THIS entry too: params ride as
+  arguments, so an identical-architecture candidate shares the
+  incumbent's executables outright, and an architecture change warms
+  through `compilecache/warmup.py serve_predict_jobs` — the same
+  registered entry id, so the warmers/registry sync test keeps pinning
+  ``CACHE_ENTRY_IDS`` with no lifecycle-private program anywhere.
 - ``serve-predict-group-packed`` — `ops/predict.py
   make_packed_grouped_base` (the micro-batcher's packed vmapped
   dispatch), traced across slot buckets.

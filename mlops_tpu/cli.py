@@ -32,7 +32,14 @@ def build_parser() -> argparse.ArgumentParser:
         ("versions", "list registered versions, stages, tags"),
         ("gc", "prune registry orphans (and old unstaged versions)"),
         ("validate", "schema-check a CSV (OOV / unparseable counts)"),
-        ("serve", "serve a bundle over HTTP"),
+        ("serve", "serve a bundle over HTTP (lifecycle.enabled=true also "
+                  "runs the drift-triggered retrain -> shadow -> gated "
+                  "hot-promotion loop in-process)"),
+        ("lifecycle", "one-shot offline lifecycle pass: retrain a "
+                      "candidate from the labeled window "
+                      "(lifecycle.labeled_path), grade it against the "
+                      "incumbent through the AUC/calibration gates, and "
+                      "register it when it passes"),
         ("bench", "run the inference benchmark"),
         ("predict-file", "batch-score a CSV offline"),
         ("score-batch", "bulk-score 1M-scale rows data-parallel over the mesh"),

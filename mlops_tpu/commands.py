@@ -524,7 +524,15 @@ def _serve(config) -> int:
         compile_cache=from_config(config),
         warmup_workers=config.cache.warmup_workers,
     )
-    serve_forever(engine, config.serve)
+    lifecycle = None
+    if config.lifecycle.enabled:
+        # Serve-integrated closed loop (mlops_tpu/lifecycle/): the
+        # controller thread watches the monitor aggregates, retrains off
+        # the hot path, shadow-mirrors, and hot-promotes through gates.
+        from mlops_tpu.lifecycle import LifecycleController
+
+        lifecycle = LifecycleController(engine, config)
+    serve_forever(engine, config.serve, lifecycle=lifecycle)
     return 0
 
 
@@ -575,6 +583,66 @@ def _warmup(config) -> int:
     return 0
 
 
+def _lifecycle(config) -> int:
+    """One-shot OFFLINE lifecycle pass (the CI/cron twin of the
+    serve-integrated loop): incumbent bundle + labeled window ->
+    retrained candidate -> AUC/calibration gates (no mirrored traffic
+    offline, so the latency gate auto-passes) -> register on pass. Exit
+    0 = promoted/registered, 3 = gates rejected the candidate, SystemExit
+    on a window that cannot produce a candidate at all."""
+    from mlops_tpu.bundle import ModelRegistry, load_bundle
+    from mlops_tpu.lifecycle import (
+        LifecycleError,
+        ShadowEngine,
+        evaluate_gates,
+        run_retrain,
+    )
+    from mlops_tpu.serve import InferenceEngine
+
+    incumbent = load_bundle(_resolve_bundle(config))
+    try:
+        result = run_retrain(incumbent, config, generation=2)
+    except LifecycleError as err:
+        raise SystemExit(f"lifecycle: {err}")
+    # Grade through the REAL packed serving programs (bucket-shaped
+    # chunks), exactly what the serve-integrated shadow does — small
+    # bucket grid, no grouping: this is a batch pass, not a server.
+    live = InferenceEngine(
+        incumbent,
+        buckets=tuple(config.serve.warmup_batch_sizes),
+        enable_grouping=False,
+    )
+    live.warmup()
+    shadow = ShadowEngine(live, result.bundle)
+    shadow.warm()
+    report = shadow.evaluate(result.holdout, result.holdout_incumbent)
+    decision = evaluate_gates(report, config.lifecycle)
+    model_uri = None
+    if decision.passed and config.lifecycle.auto_promote:
+        registry = ModelRegistry(config.registry.root)
+        model_uri = registry.register(
+            config.registry.model_name,
+            result.candidate_dir,
+            tags={"lifecycle": "gated-promotion"},
+        )
+    print(
+        json.dumps(
+            {
+                "candidate": str(result.candidate_dir),
+                "labeled_rows": result.labeled_rows,
+                "retrain_wall_s": result.wall_s,
+                "auc_candidate": round(report.auc_candidate, 6),
+                "auc_incumbent": round(report.auc_incumbent, 6),
+                "auc_delta": round(report.auc_delta, 6),
+                "ece_candidate": round(report.ece_candidate, 6),
+                "gates": decision.as_dict(),
+                "model_uri": model_uri,
+            }
+        )
+    )
+    return 0 if decision.passed else 3
+
+
 def _analyze(config) -> int:
     """Handler-table entry for parser/handler sync (tests/test_cli.py);
     ``run()`` intercepts `analyze` before config loading, so this shim only
@@ -599,5 +667,6 @@ _HANDLERS = {
     "score-batch": _score_batch,
     "bench": _bench,
     "serve": _serve,
+    "lifecycle": _lifecycle,
     "warmup": _warmup,
 }

@@ -321,6 +321,144 @@ class ScoreConfig:
     # the output JSON's "path" field records which ran)
 
 
+class LifecycleConfigError(ValueError):
+    """An inconsistent lifecycle geometry, named at startup (the
+    ``ServeConfigError`` discipline applied to the controller knobs)."""
+
+
+@dataclasses.dataclass
+class LifecycleConfig:
+    """The closed-loop controller (`mlops_tpu/lifecycle/`): drift-triggered
+    retrain -> shadow serve -> gated hot promotion. Disabled by default —
+    `serve` grows the loop only when ``lifecycle.enabled=true`` (or the
+    one-shot offline pass runs via ``mlops-tpu lifecycle``)."""
+
+    enabled: bool = False
+    dir: str = "lifecycle"  # controller state root: the on-disk sample
+    # reservoir, candidate bundles (candidates/gen-N), retrain checkpoints
+    labeled_path: str = ""  # labeled window source (CSV/Parquet WITH the
+    # target column) for retrain + the candidate-vs-incumbent gates.
+    # Serving traffic is unlabeled; ground truth (the realized default)
+    # arrives out of band — this file is that delivery point. Empty =
+    # retrain triggers are observed but can never produce a candidate
+    # ---------------------------------------------------------- triggers
+    drift_threshold: float = 0.9  # fire when the WINDOWED per-feature mean
+    # drift score (1 - p_val, monitor aggregates between controller ticks)
+    # exceeds this on any feature
+    outlier_threshold: float = 0.5  # ... or the windowed outlier rate does
+    min_window_rows: int = 256  # a trigger window must carry at least this
+    # many scored rows (a near-empty window's statistics are noise)
+    hysteresis_windows: int = 2  # consecutive over-threshold windows
+    # required before firing — one noisy window can never retrain-storm
+    cooldown_s: float = 300.0  # dead time after any trigger/outcome during
+    # which new spikes neither fire nor accumulate hysteresis
+    tick_s: float = 1.0  # controller evaluation cadence (its own thread,
+    # off the request path)
+    # ----------------------------------------------------------- retrain
+    reservoir_rows: int = 8192  # bounded on-disk sample reservoir fed from
+    # the serve path (algorithm-R over every scored row)
+    retrain_steps: int = 300  # incremental fine-tune budget from the
+    # incumbent's params over the labeled window
+    retrain_batch_size: int = 256
+    min_labeled_rows: int = 512  # labeled window smaller than this skips
+    # retrain (the gate evaluation would be statistically meaningless)
+    refit_preprocessor: bool = False  # True re-fits normalization stats on
+    # the labeled window via `fit_streaming` (single-process serving
+    # only): the multi-worker plane's front ends encode with the
+    # preprocessor loaded at fork, so the ring plane forces False — the
+    # encode contract is part of the promotion contract there. False
+    # (default) also makes the hot swap's one-generation guarantee cover
+    # the encode stage unconditionally (the preprocessor is then
+    # identical across generations); with a refit, a request already
+    # past encode when a swap lands scores old-stats rows against the
+    # new params for that instant (serve/engine.py swap_bundle)
+    # ------------------------------------------------------------ shadow
+    mirror_fraction: float = 0.1  # fraction of live traffic mirrored to
+    # the shadow candidate (dispatch-only; responses discarded)
+    shadow_min_mirrors: int = 32  # mirrored dispatches to accumulate
+    # before the gates are evaluated
+    shadow_max_s: float = 600.0  # evaluate anyway after this long in
+    # shadow (a traffic lull must not wedge the loop mid-candidate)
+    # ------------------------------------------------------------- gates
+    max_auc_drop: float = 0.01  # candidate AUC may trail the incumbent's
+    # by at most this (epsilon) on the labeled holdout
+    max_ece: float = 0.1  # candidate expected-calibration-error bound
+    max_p99_ratio: float = 2.0  # candidate p99 latency bound, relative to
+    # the incumbent's on the same mirrored/holdout shapes
+    auto_promote: bool = True  # False stops after the gate report (the
+    # human-in-the-loop mode; promote later via the registry CLI)
+
+    def validate(self) -> "LifecycleConfig":
+        problems: list[str] = []
+        if not 0.0 < self.drift_threshold <= 1.0:
+            problems.append(
+                f"lifecycle.drift_threshold={self.drift_threshold} must be "
+                "in (0, 1] (drift scores are 1 - p_val)"
+            )
+        if not 0.0 < self.outlier_threshold <= 1.0:
+            problems.append(
+                f"lifecycle.outlier_threshold={self.outlier_threshold} "
+                "must be in (0, 1] (a rate)"
+            )
+        if self.hysteresis_windows < 1:
+            problems.append(
+                f"lifecycle.hysteresis_windows={self.hysteresis_windows} "
+                "must be >= 1 (0 would fire on no evidence at all)"
+            )
+        if not 0.0 <= self.mirror_fraction <= 1.0:
+            problems.append(
+                f"lifecycle.mirror_fraction={self.mirror_fraction} must be "
+                "in [0, 1]"
+            )
+        if self.reservoir_rows < 1:
+            problems.append(
+                f"lifecycle.reservoir_rows={self.reservoir_rows} must be >= 1"
+            )
+        if self.retrain_steps < 1:
+            problems.append(
+                f"lifecycle.retrain_steps={self.retrain_steps} must be >= 1"
+            )
+        if self.max_p99_ratio <= 0:
+            problems.append(
+                f"lifecycle.max_p99_ratio={self.max_p99_ratio} must be > 0"
+            )
+        if self.tick_s <= 0:
+            problems.append(
+                f"lifecycle.tick_s={self.tick_s} must be > 0 (a zero tick "
+                "turns the controller thread into a busy loop of "
+                "fetch-and-reset device round trips contending the "
+                "accumulator lock with live traffic)"
+            )
+        if self.cooldown_s < 0:
+            problems.append(
+                f"lifecycle.cooldown_s={self.cooldown_s} must be >= 0"
+            )
+        if self.min_window_rows < 1:
+            problems.append(
+                f"lifecycle.min_window_rows={self.min_window_rows} must "
+                "be >= 1"
+            )
+        if self.min_labeled_rows < 2:
+            problems.append(
+                f"lifecycle.min_labeled_rows={self.min_labeled_rows} must "
+                "be >= 2 (the holdout split needs both classes a chance "
+                "to exist)"
+            )
+        if self.shadow_min_mirrors < 0:
+            problems.append(
+                f"lifecycle.shadow_min_mirrors={self.shadow_min_mirrors} "
+                "must be >= 0"
+            )
+        if self.shadow_max_s <= 0:
+            problems.append(
+                f"lifecycle.shadow_max_s={self.shadow_max_s} must be > 0 "
+                "(the shadow phase needs a bounded evaluation deadline)"
+            )
+        if problems:
+            raise LifecycleConfigError("; ".join(problems))
+        return self
+
+
 @dataclasses.dataclass
 class CacheConfig:
     """Persistent AOT executable cache (`mlops_tpu/compilecache/`)."""
@@ -351,6 +489,9 @@ class Config:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     registry: RegistryConfig = dataclasses.field(default_factory=RegistryConfig)
     score: ScoreConfig = dataclasses.field(default_factory=ScoreConfig)
+    lifecycle: LifecycleConfig = dataclasses.field(
+        default_factory=LifecycleConfig
+    )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 

@@ -1,0 +1,52 @@
+"""Lifecycle controller: the closed MLOps loop (ROADMAP item 5).
+
+train -> register -> serve -> monitor was three fast subsystems and a
+gap; this package closes it: threshold policies over the device-resident
+monitor aggregates (`triggers`), an off-hot-path incremental retrain fed
+by a bounded on-disk sample reservoir (`retrain`), a shadow engine that
+AOT-warms the candidate through the existing compile cache and mirrors
+live traffic (`shadow`), and gated zero-downtime promotion with instant
+rollback (`promote`) — orchestrated by `controller.LifecycleController`,
+which `mlops-tpu serve` runs in-process when ``lifecycle.enabled=true``
+and `mlops-tpu lifecycle` drives as a one-shot offline pass.
+
+This package lives ENGINE-SIDE only: it (transitively) imports jax via
+`serve/engine.py`, so the multi-worker plane's jax-free front-end
+processes must never import it — the engine process owns the loop there.
+"""
+
+from mlops_tpu.lifecycle.controller import LifecycleController
+from mlops_tpu.lifecycle.promote import (
+    GateDecision,
+    evaluate_gates,
+    expected_calibration_error,
+    promote_engine,
+    rollback_engine,
+    roc_auc_np,
+)
+from mlops_tpu.lifecycle.retrain import (
+    LifecycleError,
+    RetrainResult,
+    SampleReservoir,
+    run_retrain,
+)
+from mlops_tpu.lifecycle.shadow import ShadowEngine, ShadowReport
+from mlops_tpu.lifecycle.triggers import TriggerDecision, TriggerPolicy
+
+__all__ = [
+    "GateDecision",
+    "LifecycleController",
+    "LifecycleError",
+    "RetrainResult",
+    "SampleReservoir",
+    "ShadowEngine",
+    "ShadowReport",
+    "TriggerDecision",
+    "TriggerPolicy",
+    "evaluate_gates",
+    "expected_calibration_error",
+    "promote_engine",
+    "roc_auc_np",
+    "rollback_engine",
+    "run_retrain",
+]

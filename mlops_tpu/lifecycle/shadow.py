@@ -1,0 +1,299 @@
+"""Shadow serving: warm the candidate, mirror live traffic, collect deltas.
+
+The ``ShadowEngine`` holds a FULL candidate ``InferenceEngine`` (its own
+device-resident params/monitor/temperature and its own accumulator — the
+candidate's monitor folds never touch the live aggregate) plus frozen
+references to the incumbent's serving state, captured at construction.
+
+Warmup rides the existing AOT compile cache: the cache keys already
+encode the model hash, so flagship and candidate executables coexist in
+one store, and candidate warmup routes through exactly the registered
+``serve-predict-packed`` / ``serve-predict-group-packed`` entry points
+(`compilecache/warmup.py serve_predict_jobs` — the tpulint Layer-2
+registry audits the same programs). The common lifecycle case — a
+fine-tune with an UNCHANGED architecture — is even cheaper: the packed
+programs take params/monitor/temperature as ARGUMENTS, so the incumbent's
+already-compiled executables serve the candidate bit-for-bit; ``warm()``
+detects the matching model fingerprint and shares the live exec table
+instead of compiling anything (``warm_mode == "shared"``).
+
+Mirroring is dispatch-only: the controller drains the engine tee's queue
+on ITS thread and calls ``mirror()`` with copies of real request arrays —
+the candidate scores them (timed), the incumbent's params score the SAME
+rows through the SAME compiled executable (fresh throwaway accumulator,
+so the live monitor aggregate is never double-counted), responses are
+discarded, and only the deltas accumulate: candidate vs incumbent
+latency on real request shapes and the per-row prediction shift.
+
+The AUC/ECE evidence comes from ``evaluate(holdout)`` — the labeled
+held-out split the retrain produced — scored through both sides' actual
+packed serving programs in bucket-shaped chunks (real serving shapes,
+not an offline-only code path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from mlops_tpu.serve.engine import InferenceEngine
+
+# tpulint Layer-3 manifest: the stats lock is a LEAF — scalar/deque
+# updates only; all scoring, padding, and device fetches happen outside
+# it (TPU403 discipline).
+TPULINT_LOCK_ORDER = {"ShadowEngine": ("_stats_lock",)}
+
+_LATENCY_WINDOW = 512  # mirrored-latency samples retained per side
+
+
+@dataclasses.dataclass
+class ShadowReport:
+    """Everything the promotion gates consume (lifecycle/promote.py)."""
+
+    auc_candidate: float
+    auc_incumbent: float
+    auc_delta: float  # candidate - incumbent (negative = regression)
+    ece_candidate: float
+    ece_incumbent: float
+    p99_candidate_ms: float
+    p99_incumbent_ms: float
+    p50_candidate_ms: float
+    p50_incumbent_ms: float
+    mirrors: int
+    mirror_drops: int
+    mean_abs_pred_delta: float
+    holdout_rows: int
+    warm_mode: str
+    warm_s: float
+
+
+class ShadowEngine:
+    def __init__(
+        self,
+        live: InferenceEngine,
+        candidate_bundle: Any,
+        warmup_workers: int = 0,
+    ):
+        if not getattr(live, "monitor_accumulating", False):
+            raise ValueError(
+                "shadow serving requires a device-accumulating (flax) "
+                "live engine"
+            )
+        self._live = live
+        # Frozen incumbent refs: a later promotion mutates the live
+        # engine's attributes, but THIS candidate must keep being judged
+        # against the incumbent it shadowed.
+        self._inc_variables = live._variables
+        self._inc_monitor = live._monitor
+        self._inc_temperature = live._temperature
+        self.engine = InferenceEngine(
+            candidate_bundle,
+            buckets=tuple(live.buckets),
+            service_name=live.service_name,
+            enable_grouping=live.supports_grouping,
+            compile_cache=live.compile_cache,
+            warmup_workers=warmup_workers,
+        )
+        self.warm_mode = ""
+        self.warm_s = 0.0
+        self._same_arch = True  # set by warm(); picks the incumbent's
+        # scoring program (candidate table when shared, live table when
+        # the architectures diverged — incumbent params cannot run
+        # through a different architecture's compiled program)
+        self._stats_lock = threading.Lock()
+        self._cand_ms: deque = deque(maxlen=_LATENCY_WINDOW)
+        self._inc_ms: deque = deque(maxlen=_LATENCY_WINDOW)
+        self._mirrors = 0
+        self._drops = 0
+        self._pred_delta_sum = 0.0
+        self._pred_delta_rows = 0
+
+    # --------------------------------------------------------------- warm
+    def warm(self) -> None:
+        """AOT-ready the candidate. Identical architecture -> share the
+        live exec table (params are per-call arguments, so the incumbent's
+        executables ARE the candidate's — zero compiles, instant shadow);
+        a changed architecture compiles through the persistent cache via
+        the registered serve entry points, exactly like a cold engine."""
+        from mlops_tpu.compilecache.keys import model_fingerprint
+
+        t0 = time.perf_counter()
+        same_model = model_fingerprint(
+            self.engine.bundle.model_config
+        ) == model_fingerprint(self._live.bundle.model_config)
+        # The monitor state rides the compiled signature too: a candidate
+        # whose K-S reference width diverged (retrain.py matches it, but
+        # hand-built bundles can differ) must compile its own programs.
+        same_monitor = all(
+            tuple(a.shape) == tuple(b.shape)
+            for a, b in zip(
+                self.engine.bundle.monitor.to_arrays().values(),
+                self._live.bundle.monitor.to_arrays().values(),
+            )
+        )
+        self._same_arch = same_model and same_monitor
+        if self._same_arch:
+            with self._live._compile_lock:
+                table = dict(self._live._exec)
+            with self.engine._compile_lock:
+                self.engine._exec.update(table)
+            self.engine.ready = True
+            self.warm_mode = "shared"
+        else:
+            self.engine.warmup()
+            self.warm_mode = "compiled"
+        self.warm_s = round(time.perf_counter() - t0, 3)
+
+    # ------------------------------------------------------------- mirror
+    def mirror(self, cat: np.ndarray, num: np.ndarray) -> None:
+        """Score one mirrored request on both sides; keep only deltas.
+        Runs on the CONTROLLER thread (the tee queue's consumer), never a
+        request thread. All numerics happen outside the stats lock."""
+        t0 = time.perf_counter()
+        cand = np.asarray(
+            self.engine.predict_arrays(cat, num)["predictions"], np.float64
+        )
+        t1 = time.perf_counter()
+        inc = self._score_incumbent(cat, num)
+        t2 = time.perf_counter()
+        delta = float(np.abs(cand - inc).sum())
+        with self._stats_lock:
+            self._cand_ms.append((t1 - t0) * 1e3)
+            self._inc_ms.append((t2 - t1) * 1e3)
+            self._mirrors += 1
+            self._pred_delta_sum += delta
+            self._pred_delta_rows += len(cand)
+
+    def note_drop(self, count: int = 1) -> None:
+        with self._stats_lock:
+            self._drops += count
+
+    @property
+    def mirrors(self) -> int:
+        with self._stats_lock:
+            return self._mirrors
+
+    def _score_incumbent(self, cat: np.ndarray, num: np.ndarray) -> np.ndarray:
+        """Incumbent predictions for the same rows with a throwaway zero
+        accumulator (donation-safe: it is consumed by this one call), so
+        the LIVE monitor aggregate never double-counts mirrored traffic.
+        Same-architecture candidates run the incumbent's params through
+        the SHARED compiled entry (params are arguments); an
+        architecture-change candidate's program cannot accept the
+        incumbent's param pytree, so the incumbent scores through the
+        LIVE engine's own table instead — either way, same shapes, same
+        padding, apples-to-apples."""
+        import jax
+
+        from mlops_tpu.monitor.state import init_accumulator
+        from mlops_tpu.ops.predict import packed_layout
+
+        eng = self.engine if self._same_arch else self._live
+        n = cat.shape[0]
+        bucket = eng._bucket_for(n)
+        rows = bucket if bucket is not None else n
+        pad = rows - n
+        if pad:
+            cat = np.pad(cat, ((0, pad), (0, 0)))
+            num = np.pad(num, ((0, pad), (0, 0)))
+        mask = np.arange(rows) < n
+        key = ("bucket", rows)
+        fn = eng._exec.get(key)
+        if fn is None:
+            fn = eng._compile_novel(key, (cat, num, mask))
+        out, _ = fn(
+            self._inc_variables,
+            self._inc_monitor,
+            jax.device_put(init_accumulator()),
+            self._inc_temperature,
+            cat,
+            num,
+            mask,
+        )
+        arr = np.asarray(out)
+        p, _, _ = packed_layout(rows)
+        return arr[p][:n].astype(np.float64)
+
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self, holdout, holdout_incumbent=None) -> ShadowReport:
+        """Score the labeled holdout through both sides' packed serving
+        programs (bucket-shaped chunks — real request shapes) and fold in
+        the mirrored latency/shift evidence.
+
+        ``holdout_incumbent`` carries the SAME rows encoded with the
+        incumbent's preprocessor (only differs under
+        ``lifecycle.refit_preprocessor``): each side is graded in the
+        encode configuration it actually serves — the incumbent scored on
+        candidate-refit normalization stats would collapse toward 0.5 and
+        bias every gate pro-candidate."""
+        from mlops_tpu.lifecycle.promote import (
+            expected_calibration_error,
+            roc_auc_np,
+        )
+
+        if holdout_incumbent is None:
+            holdout_incumbent = holdout
+        labels = np.asarray(holdout.labels, np.float64)
+        chunk = self.engine.max_bucket
+        cand_probs, inc_probs = [], []
+        for lo in range(0, holdout.n, chunk):
+            cand_probs.append(
+                np.asarray(
+                    self.engine.predict_arrays(
+                        holdout.cat_ids[lo : lo + chunk],
+                        holdout.numeric[lo : lo + chunk],
+                    )["predictions"],
+                    np.float64,
+                )
+            )
+            inc_probs.append(
+                self._score_incumbent(
+                    holdout_incumbent.cat_ids[lo : lo + chunk],
+                    holdout_incumbent.numeric[lo : lo + chunk],
+                )
+            )
+        cand = np.concatenate(cand_probs)
+        inc = np.concatenate(inc_probs)
+        # Latency evidence comes from MIRRORED traffic only: holdout
+        # chunk wall timings are too few to gate on (an offline pass has
+        # 1-5 samples; one scheduler hiccup would flakily fail the p99
+        # gate). With zero mirrors both p99s report 0.0, which
+        # evaluate_gates reads as "no evidence, gate passes".
+        with self._stats_lock:
+            mirror_cand = list(self._cand_ms)
+            mirror_inc = list(self._inc_ms)
+            mirrors, drops = self._mirrors, self._drops
+            shift_sum = self._pred_delta_sum
+            shift_rows = self._pred_delta_rows
+        auc_c = roc_auc_np(cand, labels)
+        auc_i = roc_auc_np(inc, labels)
+        return ShadowReport(
+            auc_candidate=auc_c,
+            auc_incumbent=auc_i,
+            auc_delta=auc_c - auc_i,
+            ece_candidate=expected_calibration_error(cand, labels),
+            ece_incumbent=expected_calibration_error(inc, labels),
+            p99_candidate_ms=_percentile(mirror_cand, 99),
+            p99_incumbent_ms=_percentile(mirror_inc, 99),
+            p50_candidate_ms=_percentile(mirror_cand, 50),
+            p50_incumbent_ms=_percentile(mirror_inc, 50),
+            mirrors=mirrors,
+            mirror_drops=drops,
+            mean_abs_pred_delta=(
+                shift_sum / shift_rows if shift_rows else 0.0
+            ),
+            holdout_rows=int(holdout.n),
+            warm_mode=self.warm_mode,
+            warm_s=self.warm_s,
+        )
+
+
+def _percentile(samples, q: float) -> float:
+    if not samples:
+        return 0.0
+    return float(np.percentile(np.asarray(samples, np.float64), q))

@@ -43,9 +43,11 @@ from mlops_tpu.schema import SCHEMA, records_to_columns
 # acquisition against it, and the runtime sanitizer
 # (analysis/lockcheck.py) asserts it on live thread schedules in the
 # stress tests. ``_compile_lock`` may be held while the others are taken,
-# never the reverse; ``_acc_lock`` and ``_totals_lock`` are leaf locks
-# today (no nesting anywhere) — keep them that way: a blocking XLA compile
-# or device fetch nested under the accumulator lock is exactly the PR 4
+# never the reverse — the lifecycle hot swap (`swap_bundle`/`rollback`)
+# nests ``_acc_lock`` under ``_compile_lock`` in exactly that order, and
+# its critical section is pure ref assignment. ``_acc_lock`` and
+# ``_totals_lock`` stay leaves below that: a blocking XLA compile or
+# device fetch nested under the accumulator lock is exactly the PR 4
 # stall this manifest exists to prevent.
 TPULINT_LOCK_ORDER = {
     "InferenceEngine": ("_compile_lock", "_acc_lock", "_totals_lock")
@@ -124,6 +126,18 @@ class InferenceEngine:
         warmup_workers: int = 0,
     ):
         self.bundle = bundle
+        # Bundle turnover (mlops_tpu/lifecycle/): the generation counts
+        # hot swaps (swap_bundle / rollback), starting at 1 for the
+        # construction-time bundle; `_retired` is the one-deep history a
+        # rollback restores. `_tee` is the lifecycle observation hook
+        # (set_lifecycle_tee): called with each request's PRE-PADDING
+        # encoded arrays on the dispatch path, feeding the sample
+        # reservoir and the shadow mirror — it must never block and never
+        # raise (the tee guards itself; a mirror bug must not 500 live
+        # traffic).
+        self.bundle_generation = 1
+        self._retired: tuple | None = None
+        self._tee = None
         if bundle.flavor == "doc":
             raise ValueError(
                 "doc bundles score record HISTORIES, not single records — "
@@ -311,7 +325,7 @@ class InferenceEngine:
             ),
         }
 
-    def _dispatch_fused(self, key: tuple, jitted, *batch):
+    def _dispatch_fused(self, key: tuple, *batch):
         """Dispatch one fused packed call and thread the monitor
         accumulator through it — the ONE critical section shared by the
         solo and grouped paths.
@@ -321,36 +335,55 @@ class InferenceEngine:
         the table FIRST, outside the accumulator lock, so warmed traffic
         keeps flowing while it compiles.
 
-        The lock covers only the (read acc ref -> dispatch -> swap new
-        ref) window, which is an ASYNC enqueue — concurrent request
-        threads serialize the accumulator chain's ORDER here while the
-        executions overlap on device exactly as before (the chain is a
-        data dependency, not a host wait). Returns the packed output
-        array; the new accumulator stays device-resident."""
-        fn = self._exec.get(key)
-        if fn is None:
-            fn = self._compile_novel(key, jitted, batch)
-        with self._acc_lock:
-            acc = self._acc
-            out, new_acc = fn(
-                self._variables, self._monitor, acc, self._temperature,
-                *batch,
-            )
-            self._acc = new_acc
-        return out
+        The lock covers only the (read exec entry + serving refs ->
+        dispatch -> swap new acc ref) window, which is an ASYNC enqueue —
+        concurrent request threads serialize the accumulator chain's
+        ORDER here while the executions overlap on device exactly as
+        before (the chain is a data dependency, not a host wait).
 
-    def _compile_novel(self, key: tuple, jitted, batch):
+        BIT-STABILITY across hot swaps (lifecycle/promote.py): the exec
+        entry, params, monitor, and temperature are all read under the
+        SAME ``_acc_lock`` hold that `swap_bundle` mutates them under, so
+        a request in flight during a promotion computes its whole answer
+        from exactly one bundle generation — never new params through an
+        old program or vice versa. Returns the packed output array; the
+        new accumulator stays device-resident."""
+        while True:
+            with self._acc_lock:
+                fn = self._exec.get(key)
+                if fn is not None:
+                    acc = self._acc
+                    out, new_acc = fn(
+                        self._variables, self._monitor, acc,
+                        self._temperature, *batch,
+                    )
+                    self._acc = new_acc
+                    return out
+            # Miss: compile outside the accumulator lock, then retry the
+            # consistent-snapshot dispatch (a swap may have replaced the
+            # table meanwhile; the loop re-reads everything together).
+            self._compile_novel(key, batch)
+
+    def _compile_novel(self, key: tuple, batch):
         """AOT-compile a shape warmup missed and cache it in the dispatch
         table. Double-checked under ONE shared lock: concurrent first
         requests for the same shape compile once, and warmed traffic
         never waits here — but concurrent DIFFERENT novel shapes do
         serialize on this lock (novel shapes are rare offline/oversized
-        traffic; per-key locks aren't worth the bookkeeping)."""
+        traffic; per-key locks aren't worth the bookkeeping). The base
+        jitted program is looked up from ``self`` INSIDE the lock so the
+        lowering, the params it lowers against, and the table it installs
+        into all belong to one bundle generation (`swap_bundle` takes
+        this lock first)."""
         from mlops_tpu.monitor.state import abstract_accumulator
 
         with self._compile_lock:
             fn = self._exec.get(key)
             if fn is None:
+                jitted = (
+                    self._predict if key[0] == "bucket"
+                    else self._predict_group
+                )
                 # The sync XLA compile DOES block this lock — that is the
                 # design: _compile_lock exists precisely to serialize novel
                 # compiles away from _acc_lock (where the same compile once
@@ -365,6 +398,86 @@ class InferenceEngine:
                 ).compile()
                 self._exec[key] = fn
         return fn
+
+    # ----------------------------------------------------- bundle turnover
+    def set_lifecycle_tee(self, tee) -> None:
+        """Install (or clear, with None) the lifecycle observation hook:
+        a callable ``tee(cat_ids, numeric)`` invoked with each request's
+        pre-padding encoded arrays on the dispatch path. The tee OWNS its
+        cheapness and safety (bounded non-blocking enqueue, internal
+        try/except) — the engine calls it bare on the hot path."""
+        self._tee = tee
+
+    def swap_bundle(self, candidate: "InferenceEngine") -> int:
+        """Hot-promote a warmed candidate engine's bundle IN PLACE with
+        zero downtime (lifecycle/promote.py): exec table + params +
+        monitor + temperature + base jits ref-swap under the existing
+        ``_compile_lock`` -> ``_acc_lock`` discipline (the declared
+        TPULINT_LOCK_ORDER). Everything swapped is already device-resident
+        on the candidate engine, so the critical section is pure ref
+        assignment — no transfer, no compile, no fetch ever holds these
+        locks (tpulint TPU403 stays clean by construction).
+
+        Requests racing the swap are bit-stable: `_dispatch_fused` reads
+        the same refs under the same ``_acc_lock`` hold, so each
+        response's COMPUTE (program + params + monitor + temperature) is
+        exactly one bundle generation. The host-side encode stage reads
+        ``self.bundle.preprocessor`` before dispatch, outside these
+        locks — identical across generations in the default lifecycle
+        flow (``lifecycle.refit_preprocessor=false``, and forced false on
+        the ring plane, where the fork-time preprocessor is the encode
+        contract), so the one-generation guarantee is unconditional
+        there; with an opted-in refit, a request already past encode when
+        the swap lands scores old-stats-encoded rows against the new
+        generation for that instant. The outgoing state is retained
+        (one-deep) for `rollback`. Returns the new generation."""
+        if not self._accumulate or not candidate._accumulate:
+            raise ValueError(
+                "hot swap requires device-accumulating (flax) engines on "
+                "both sides — the sklearn flavor redeploys instead"
+            )
+        if self.supports_grouping and not candidate.supports_grouping:
+            raise ValueError(
+                "candidate engine lacks the grouped path the live engine "
+                "serves — build it with enable_grouping=True"
+            )
+        with self._compile_lock:
+            with self._acc_lock:
+                self._retired = (
+                    self.bundle, self._variables, self._monitor,
+                    self._temperature, self._exec, self._predict,
+                    self._predict_group,
+                )
+                self.bundle = candidate.bundle
+                self._variables = candidate._variables
+                self._monitor = candidate._monitor
+                self._temperature = candidate._temperature
+                self._exec = candidate._exec
+                self._predict = candidate._predict
+                self._predict_group = candidate._predict_group
+                self.bundle_generation += 1
+        return self.bundle_generation
+
+    def rollback(self) -> int:
+        """Instantly restore the previous bundle (same ref-swap, same
+        locks, same bit-stability). The states EXCHANGE, so a rollback is
+        itself rollback-able (roll forward again in one call). Raises if
+        no swap ever happened."""
+        if self._retired is None:
+            raise ValueError("no retired bundle to roll back to")
+        with self._compile_lock:
+            with self._acc_lock:
+                retired = self._retired
+                self._retired = (
+                    self.bundle, self._variables, self._monitor,
+                    self._temperature, self._exec, self._predict,
+                    self._predict_group,
+                )
+                (self.bundle, self._variables, self._monitor,
+                 self._temperature, self._exec, self._predict,
+                 self._predict_group) = retired
+                self.bundle_generation += 1
+        return self.bundle_generation
 
     def monitor_snapshot(self) -> dict[str, Any]:
         """ONE device->host fetch of the monitor aggregate — the telemetry
@@ -427,6 +540,13 @@ class InferenceEngine:
             "drift_mean": dict(
                 zip(SCHEMA.feature_names, drift_mean.round(6).tolist())
             ),
+            # UNROUNDED cumulative per-feature sums, schema order — the
+            # lifecycle trigger policy differences consecutive snapshots
+            # into windows, and reconstructing the sum from the rounded
+            # means above would accumulate up to 5e-7 * batches of error
+            # (unbounded over a long-lived server). The gauges keep their
+            # rounded display values; windowing reads this.
+            "drift_sum": drift_sum.tolist(),
         }
 
     # -------------------------------------------------------------- predict
@@ -457,6 +577,12 @@ class InferenceEngine:
         n = cat_ids.shape[0]
         if n == 0:
             return None
+        tee = self._tee
+        if tee is not None:
+            # Lifecycle observation (reservoir feed + shadow mirror):
+            # pre-padding arrays, bounded non-blocking enqueue inside the
+            # tee — never a hot-path stall.
+            tee(cat_ids, numeric)
         bucket = self._bucket_for(n)
         if bucket is not None:
             pad = bucket - n
@@ -477,9 +603,7 @@ class InferenceEngine:
         # Keyed by padded row count: equal to the bucket for bucketed
         # requests, and the exact size for oversized ones — so a repeated
         # oversized shape reuses its table entry instead of recompiling.
-        out = self._dispatch_fused(
-            ("bucket", rows), self._predict, cat_ids, numeric, mask
-        )
+        out = self._dispatch_fused(("bucket", rows), cat_ids, numeric, mask)
         return _ArraysHandle(out, n, rows, packed=True)
 
     def fetch_arrays(self, handle: _ArraysHandle) -> dict[str, Any]:
@@ -575,6 +699,10 @@ class InferenceEngine:
         Requires 2..GROUP_SLOT_BUCKETS[-1] requests of 1..GROUP_ROW_BUCKET
         rows each (the callers' coalescing policy guarantees it)."""
         sizes = [cat.shape[0] for cat, _ in parts]
+        tee = self._tee
+        if tee is not None:
+            for part_cat, part_num in parts:
+                tee(part_cat, part_num)
         if not 2 <= len(parts) <= GROUP_SLOT_BUCKETS[-1]:
             raise ValueError(
                 f"grouped dispatch takes 2..{GROUP_SLOT_BUCKETS[-1]} "
@@ -601,9 +729,7 @@ class InferenceEngine:
             num[i, :n] = part_num
             mask[i, :n] = True
 
-        out = self._dispatch_fused(
-            ("group", slots, rows), self._predict_group, cat, num, mask
-        )
+        out = self._dispatch_fused(("group", slots, rows), cat, num, mask)
         handle = _GroupHandle(out=out, sizes=sizes, rows=rows)
         handle.start_copy()
         return handle

@@ -521,6 +521,23 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             "warmup complete; ready %s",
             _LazyJson(getattr(engine, "warmup_stats", {})),
         )
+        if config.lifecycle.enabled:
+            # The closed loop runs ENGINE-SIDE (the only process with
+            # the device, the exec tables, and the compile cache). The
+            # engine tee observes pre-encoded slab rows (copied — slabs
+            # are reused), the ring telemetry loop mirrors the gauge
+            # snapshot into shm for every front end's /metrics, and
+            # promotion swaps in place under the engine's locks — front
+            # ends never notice a bundle turnover. The fork-time
+            # preprocessor is the encode contract here, so the
+            # controller is forced onto the incumbent preprocessor.
+            from mlops_tpu.lifecycle import LifecycleController
+
+            service.lifecycle = LifecycleController(
+                engine, config, force_incumbent_preprocessor=True
+            )
+            service.lifecycle.start()
+            logger.info("lifecycle controller started (engine process)")
 
         # ---- supervise the zygote (it supervises the front ends; this
         # process must never fork again now that jax threads exist) ----
@@ -554,6 +571,8 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             zygote.kill()
             zygote.join(timeout=5)
         if service is not None:
+            if service.lifecycle is not None:
+                service.lifecycle.stop()
             service.stop()
         placeholder.close()
         ring.close()

@@ -65,6 +65,13 @@ import numpy as np
 
 from mlops_tpu.schema import SCHEMA
 from mlops_tpu.serve.metrics import (
+    LIFE_AUC_DELTA,
+    LIFE_GENERATION,
+    LIFE_HAS,
+    LIFE_HAS_DELTA,
+    LIFE_OUTCOMES,
+    LIFE_RESERVOIR,
+    LIFE_TRIGGERS,
     MON_BATCHES,
     MON_FETCHED_AT,
     MON_FETCHES,
@@ -291,6 +298,12 @@ class RequestRing:
             ("mon_vals", np.dtype(np.float64), (8,)),
             ("mon_drift_last", np.dtype(np.float64), (D,)),
             ("mon_drift_mean", np.dtype(np.float64), (D,)),
+            # lifecycle loop state (single writer: the engine process's
+            # controller telemetry — serve/metrics.py LIFE_* indices), so
+            # ANY front end renders the fleet's bundle generation /
+            # trigger / promotion gauges from shm.
+            ("life_vals", np.dtype(np.float64), (8,)),
+            ("life_promos", np.dtype(np.float64), (len(LIFE_OUTCOMES),)),
         ]
         offset = 0
         offsets = {}
@@ -450,6 +463,27 @@ class RequestRing:
         self.mon_vals[MON_FETCHES] += 1
         self.mon_vals[MON_FETCHED_AT] = time.monotonic()
         self.mon_vals[MON_HAS] = 1.0
+
+    def write_lifecycle(self, snapshot: dict[str, Any]) -> None:
+        """Engine-process single writer: install a lifecycle controller
+        snapshot (`lifecycle/controller.py metrics_snapshot`) for the
+        front ends' /metrics renders. Same tearing contract as
+        `write_monitor`: per-field f64 stores are individually atomic and
+        a mid-update mix is gauge-tolerable."""
+        if not snapshot:
+            return
+        self.life_vals[LIFE_GENERATION] = float(snapshot["generation"])
+        self.life_vals[LIFE_TRIGGERS] = float(snapshot["drift_triggers"])
+        delta = snapshot.get("shadow_auc_delta")
+        self.life_vals[LIFE_AUC_DELTA] = 0.0 if delta is None else float(delta)
+        self.life_vals[LIFE_HAS_DELTA] = 0.0 if delta is None else 1.0
+        self.life_vals[LIFE_RESERVOIR] = float(
+            snapshot.get("reservoir_rows") or 0
+        )
+        promotions = snapshot.get("promotions", {})
+        for i, outcome in enumerate(LIFE_OUTCOMES):
+            self.life_promos[i] = float(promotions.get(outcome, 0))
+        self.life_vals[LIFE_HAS] = 1.0
 
     def close(self) -> None:
         self.engine_doorbell.close()
@@ -713,6 +747,12 @@ class RingService:
         self._mon_period = monitor_fetch_every_s
         self._mon_every = monitor_fetch_every_requests
         self._accumulating = bool(getattr(engine, "monitor_accumulating", False))
+        # Optional lifecycle controller (mlops_tpu/lifecycle/), attached
+        # by serve_multi_worker after warmup: the telemetry loop mirrors
+        # its gauge snapshot into shm each tick so every front end can
+        # render the loop state. Engine-process only; front ends never
+        # import the lifecycle package.
+        self.lifecycle: Any = None
         self._requests_since_fetch = 0  # collector-thread private counter;
         # the telemetry thread only READS it (a torn read costs one fetch
         # of cadence, never correctness — the totals live on device)
@@ -743,6 +783,7 @@ class RingService:
                 self.ring.write_monitor(self.engine.monitor_snapshot())
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("final monitor snapshot failed on drain")
+        self._write_lifecycle()
 
     # ------------------------------------------------------------ collect
     def _collect(self) -> None:
@@ -875,6 +916,7 @@ class RingService:
         tick = min(0.25, self._mon_period)
         last_fetch = time.monotonic()
         while not self._stop.wait(tick):
+            self._write_lifecycle()
             due_k = self._mon_every and (
                 self._requests_since_fetch >= self._mon_every
             )
@@ -894,3 +936,17 @@ class RingService:
             # single-process fetch task's done-callback).
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("ring monitor fetch failed; gauges stale")
+
+    def _write_lifecycle(self) -> None:
+        """Mirror the attached controller's gauge snapshot into shm (a
+        host-dict read plus f64 stores — no device work)."""
+        lifecycle = self.lifecycle
+        if lifecycle is None:
+            return
+        try:
+            self.ring.write_lifecycle(lifecycle.metrics_snapshot())
+        # Telemetry breadth contract: a controller mid-transition (or a
+        # snapshot bug) costs one gauge refresh, never the telemetry
+        # thread.
+        except Exception:  # tpulint: disable=TPU201
+            logger.exception("ring lifecycle write failed; gauges stale")

@@ -23,6 +23,21 @@ RING_CLASSES = ("small", "large")  # slot classes (ring depth/shed labels)
 MON_ROWS, MON_OUTLIERS, MON_BATCHES, MON_FETCHES, MON_FETCHED_AT, MON_HAS = (
     range(6)
 )
+# Field indices of the ring's lifecycle block (engine-process single
+# writer; see RequestRing.write_lifecycle). AUC delta rides two fields
+# (value + has-flag) because 0.0 is a legitimate delta, not "unknown".
+(
+    LIFE_GENERATION,
+    LIFE_TRIGGERS,
+    LIFE_AUC_DELTA,
+    LIFE_HAS_DELTA,
+    LIFE_RESERVOIR,
+    LIFE_HAS,
+) = range(6)
+# Promotion outcomes, in their ring-array order (write_lifecycle /
+# render_ring_metrics and the single-process render share this tuple so
+# the label sets can never diverge between telemetry planes).
+LIFE_OUTCOMES = ("promoted", "rejected", "rolled_back")
 
 
 class ServingMetrics:
@@ -42,6 +57,11 @@ class ServingMetrics:
         self.monitor_batches = 0
         self.monitor_fetches = 0
         self.monitor_fetched_at: float | None = None  # time.monotonic()
+        # Lifecycle gauges (mlops_tpu/lifecycle/): None until a controller
+        # installs a snapshot — the series are only exported when the
+        # loop is actually running, so a loop-less deployment's scrape is
+        # byte-identical to pre-lifecycle builds.
+        self.lifecycle: dict | None = None
 
     # Known routes only: arbitrary request paths must not become unbounded
     # (and injectable) Prometheus label values.
@@ -88,6 +108,44 @@ class ServingMetrics:
             self.mean_drift = dict(snapshot["drift_mean"])
             self.monitor_fetches += 1
             self.monitor_fetched_at = time.monotonic()
+
+    def set_lifecycle(self, snapshot: dict) -> None:
+        """Install a lifecycle-controller snapshot
+        (`lifecycle/controller.py metrics_snapshot`) for the next render."""
+        if not snapshot:
+            return
+        with self._lock:
+            self.lifecycle = dict(snapshot)
+
+    @staticmethod
+    def lifecycle_lines(snapshot: dict | None) -> list[str]:
+        """The lifecycle gauge block — ONE definition shared by the
+        single-process render and the ring render's label set, so the two
+        telemetry planes export identical series names."""
+        if not snapshot:
+            return []
+        lines = [
+            "# TYPE mlops_tpu_bundle_generation gauge",
+            f"mlops_tpu_bundle_generation {int(snapshot['generation'])}",
+            "# TYPE mlops_tpu_drift_trigger_total counter",
+            f"mlops_tpu_drift_trigger_total {int(snapshot['drift_triggers'])}",
+        ]
+        delta = snapshot.get("shadow_auc_delta")
+        if delta is not None:
+            lines.append("# TYPE mlops_tpu_shadow_auc_delta gauge")
+            lines.append(f"mlops_tpu_shadow_auc_delta {float(delta):.6f}")
+        lines.append("# TYPE mlops_tpu_promotions_total counter")
+        promotions = snapshot.get("promotions", {})
+        for outcome in LIFE_OUTCOMES:
+            lines.append(
+                f'mlops_tpu_promotions_total{{outcome="{outcome}"}} '
+                f"{int(promotions.get(outcome, 0))}"
+            )
+        rows = snapshot.get("reservoir_rows")
+        if rows is not None:
+            lines.append("# TYPE mlops_tpu_lifecycle_reservoir_rows gauge")
+            lines.append(f"mlops_tpu_lifecycle_reservoir_rows {int(rows)}")
+        return lines
 
     def render(self) -> str:
         """Prometheus text format."""
@@ -141,6 +199,7 @@ class ServingMetrics:
                 lines.append(
                     f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
                 )
+            lines.extend(self.lifecycle_lines(self.lifecycle))
             return "\n".join(lines) + "\n"
 
 
@@ -247,4 +306,26 @@ def render_ring_metrics(ring) -> str:
         age = time.monotonic() - float(ring.mon_vals[MON_FETCHED_AT])
         lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
         lines.append(f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}")
+    if ring.life_vals[LIFE_HAS]:
+        # Lifecycle block, rebuilt as a snapshot dict so the SAME
+        # formatter emits it (identical series names across planes; any
+        # front end renders the engine process's loop state from shm).
+        lines.extend(
+            ServingMetrics.lifecycle_lines(
+                {
+                    "generation": int(ring.life_vals[LIFE_GENERATION]),
+                    "drift_triggers": int(ring.life_vals[LIFE_TRIGGERS]),
+                    "shadow_auc_delta": (
+                        float(ring.life_vals[LIFE_AUC_DELTA])
+                        if ring.life_vals[LIFE_HAS_DELTA]
+                        else None
+                    ),
+                    "promotions": {
+                        outcome: int(ring.life_promos[i])
+                        for i, outcome in enumerate(LIFE_OUTCOMES)
+                    },
+                    "reservoir_rows": int(ring.life_vals[LIFE_RESERVOIR]),
+                }
+            )
+        )
     return "\n".join(lines) + "\n"

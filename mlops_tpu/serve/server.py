@@ -42,9 +42,16 @@ class HttpServer(HttpProtocol):
     protocol in N SO_REUSEPORT processes against the shared-memory ring
     instead."""
 
-    def __init__(self, engine: InferenceEngine, config: ServeConfig):
+    def __init__(
+        self, engine: InferenceEngine, config: ServeConfig, lifecycle=None
+    ):
         super().__init__(config.validate())
         self.engine = engine
+        # Optional lifecycle controller (mlops_tpu/lifecycle/): owned and
+        # started by _serve; the server's only jobs are exposing its
+        # gauges on /metrics scrapes and keeping zero coupling on the
+        # request path (the controller observes through the engine tee).
+        self.lifecycle = lifecycle
         # The request cap can never exceed the largest warmed bucket, or
         # steady-state traffic would hit exact-shape recompiles. Clamps
         # land in LOCALS, never back into the caller's ServeConfig: a
@@ -140,6 +147,11 @@ class HttpServer(HttpProtocol):
                     asyncio.shield(self._spawn_monitor_fetch()),
                     timeout=timeout,
                 )
+        if self.lifecycle is not None:
+            # Pure host-dict read (the controller's leaf lock, no device
+            # work): scrapes always render the loop's current state.
+            with contextlib.suppress(Exception):
+                self.metrics.set_lifecycle(self.lifecycle.metrics_snapshot())
         return 200, self.metrics.render(), "text/plain; version=0.0.4"
 
     def _profile(self, action: str):
@@ -300,8 +312,10 @@ class HttpServer(HttpProtocol):
                 task.cancel()
 
 
-async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
-    server = HttpServer(engine, config)
+async def _serve(
+    engine: InferenceEngine, config: ServeConfig, lifecycle=None
+) -> None:
+    server = HttpServer(engine, config, lifecycle=lifecycle)
     srv = await server.start()
     logger.info(
         "serving %s on %s:%s", config.service_name, config.host, config.port
@@ -323,6 +337,12 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
                 "warmup complete; ready %s",
                 _LazyJson(getattr(engine, "warmup_stats", {})),
             )
+            if lifecycle is not None:
+                # Start the loop only once the live exec table is fully
+                # warmed: candidate shadow warm-sharing snapshots it, and
+                # a pre-warmup trigger would have nothing to mirror into.
+                lifecycle.start()
+                logger.info("lifecycle controller started")
         # Compile failure/OOM: die loudly so the orchestrator restarts the
         # pod instead of a forever-503 zombie. Not swallowed — the error is
         # stored and re-raised by _serve after the server closes.
@@ -367,6 +387,12 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
     finally:
         srv.close()
         server.stop_telemetry()
+        if lifecycle is not None:
+            # Controller drain (joins its worker thread, detaches the
+            # engine tee, snapshots the reservoir) happens in the
+            # executor: stop() joins a thread, which must not block the
+            # event loop mid-drain.
+            await loop.run_in_executor(None, lifecycle.stop)
         await warm_task
         if draining.is_set():
             # Warmup may have finished AFTER the drain flip and
@@ -387,6 +413,10 @@ async def _serve(engine: InferenceEngine, config: ServeConfig) -> None:
         raise SystemExit(f"warmup failed: {warmup_error[0]}")
 
 
-def serve_forever(engine: InferenceEngine, config: ServeConfig) -> None:
-    """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`)."""
-    asyncio.run(_serve(engine, config))
+def serve_forever(
+    engine: InferenceEngine, config: ServeConfig, lifecycle=None
+) -> None:
+    """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`).
+    ``lifecycle`` is an optional `LifecycleController`: started once
+    warmup completes, drained on shutdown, gauges on /metrics."""
+    asyncio.run(_serve(engine, config, lifecycle=lifecycle))

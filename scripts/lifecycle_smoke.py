@@ -1,0 +1,255 @@
+"""CI live-server lifecycle smoke: train -> serve (loop enabled) ->
+inject drifted traffic -> assert auto-retrain fires -> assert gated hot
+swap with ZERO non-200 responses during the window.
+
+The end-to-end proof that the closed loop works as DEPLOYED (real CLI,
+real process, real HTTP), not just under the in-process test harness:
+
+1. synthesize a labeled DRIFTED window (numerics x10, labels preserved)
+   — the out-of-band ground-truth delivery the retrain reads,
+2. train a tiny bundle through the real CLI,
+3. launch `mlops-tpu serve` single-process with ``lifecycle.enabled=true``
+   and tight loop knobs,
+4. hammer /predict continuously from a background thread, counting every
+   non-200 — the bit-stable/zero-downtime assertion rides this counter,
+5. phase 2: the traffic turns DRIFTED (8-row bodies so the K-S window is
+   decisive); poll /metrics until ``mlops_tpu_drift_trigger_total`` >= 1
+   (auto-retrain fired) and then until ``mlops_tpu_bundle_generation``
+   >= 2 with ``mlops_tpu_promotions_total{outcome="promoted"}`` >= 1
+   (shadow-gated hot swap landed),
+6. assert the hammer saw zero non-200s across the whole window —
+   trigger, retrain, mirroring, and the swap included,
+7. SIGTERM and assert a clean drain (exit 0, no leaked tasks).
+
+Run from the repo root: `python scripts/lifecycle_smoke.py` (CI pins
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def metric_value(text: str, name: str, labels: str = "") -> float | None:
+    pattern = re.escape(name + ("{" + labels + "}" if labels else "")) + r" ([-0-9.e+]+)"
+    match = re.search(pattern, text)
+    return float(match.group(1)) if match else None
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="lifecycle-smoke-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    # 1. Labeled drifted window + request bodies (schema imports are
+    # cheap and jax-free via the data layer).
+    sys.path.insert(0, REPO)
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+    from mlops_tpu.schema import SCHEMA
+
+    columns, labels = generate_synthetic(1500, seed=3)
+    drifted = {k: list(v) for k, v in columns.items()}
+    for feat in SCHEMA.numeric:
+        drifted[feat.name] = [v * 10.0 for v in drifted[feat.name]]
+    labeled_csv = f"{tmp}/labeled.csv"
+    write_csv_columns(labeled_csv, drifted, labels)
+
+    def records(cols, n, offset=0):
+        names = [f.name for f in SCHEMA.categorical] + [
+            f.name for f in SCHEMA.numeric
+        ]
+        return [
+            {name: cols[name][offset + i] for name in names} for i in range(n)
+        ]
+
+    normal_body = json.dumps(records(columns, 8)).encode()
+    drifted_body = json.dumps(records(drifted, 8, offset=16)).encode()
+
+    print("# lifecycle-smoke: training tiny bundle", flush=True)
+    train = subprocess.run(
+        [
+            sys.executable, "-m", "mlops_tpu", "train",
+            "data.rows=3000",
+            "model.hidden_dims=32,32", "model.embed_dim=4",
+            "train.steps=100", "train.eval_every=100",
+            "train.batch_size=256",
+            f"registry.root={tmp}/registry", f"registry.run_root={tmp}/runs",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    if train.returncode != 0:
+        print(train.stdout[-2000:], train.stderr[-2000:], sep="\n")
+        raise SystemExit("train failed")
+    bundle = json.loads(train.stdout.strip().splitlines()[-1])["bundle"]
+
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlops_tpu", "serve",
+            "serve.host=127.0.0.1", f"serve.port={port}",
+            f"serve.model_directory={bundle}",
+            "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+            "serve.batch_window_ms=0",  # solo path: deterministic latency
+            "serve.monitor_fetch_every_s=0.5",
+            "lifecycle.enabled=true",
+            f"lifecycle.dir={tmp}/lifecycle",
+            f"lifecycle.labeled_path={labeled_csv}",
+            "lifecycle.retrain_steps=50",
+            "lifecycle.min_labeled_rows=500",
+            "lifecycle.min_window_rows=32",
+            "lifecycle.hysteresis_windows=2",
+            "lifecycle.cooldown_s=2",
+            "lifecycle.tick_s=0.25",
+            "lifecycle.mirror_fraction=1.0",
+            "lifecycle.shadow_min_mirrors=4",
+            "lifecycle.max_ece=0.3",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    log_lines: list[str] = []
+    pump = threading.Thread(
+        target=lambda: log_lines.extend(iter(server.stdout.readline, "")),
+        daemon=True,
+    )
+    pump.start()
+
+    counts = {"ok": 0, "bad": 0}
+    bad_detail: list = []
+    phase = {"drift": False}
+    stop = threading.Event()
+
+    def hammer() -> None:
+        req_url = f"http://127.0.0.1:{port}/predict"
+        while not stop.is_set():
+            body = drifted_body if phase["drift"] else normal_body
+            req = urllib.request.Request(
+                req_url, data=body,
+                headers={"content-type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    status = resp.status
+                    resp.read()
+            except urllib.error.HTTPError as err:
+                status = err.code
+                err.read()
+            except (urllib.error.URLError, OSError) as err:
+                counts["bad"] += 1
+                bad_detail.append(repr(err))
+                continue
+            if status == 200:
+                counts["ok"] += 1
+            else:
+                counts["bad"] += 1
+                bad_detail.append(status)
+
+    try:
+        print("# lifecycle-smoke: waiting for readiness", flush=True)
+        deadline = time.time() + 600
+        ready = False
+        while time.time() < deadline and not ready:
+            if server.poll() is not None:
+                print("\n".join(log_lines[-50:]))
+                raise SystemExit("server died before readiness")
+            try:
+                status, _ = get(f"http://127.0.0.1:{port}/healthz/ready", 5)
+                ready = status == 200
+            except (urllib.error.URLError, OSError, urllib.error.HTTPError):
+                pass
+            if not ready:
+                time.sleep(1.0)
+        if not ready:
+            raise SystemExit("server never became ready")
+
+        client = threading.Thread(target=hammer, daemon=True)
+        client.start()
+        time.sleep(2.0)  # phase 1: normal traffic, no trigger expected
+
+        status, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+        text = body.decode()
+        assert status == 200
+        assert metric_value(text, "mlops_tpu_bundle_generation") == 1.0
+        assert (metric_value(text, "mlops_tpu_drift_trigger_total") or 0) == 0
+
+        print("# lifecycle-smoke: injecting drifted traffic", flush=True)
+        phase["drift"] = True
+
+        def wait_metric(name: str, labels: str, minimum: float, budget: float):
+            deadline = time.time() + budget
+            while time.time() < deadline:
+                if server.poll() is not None:
+                    print("\n".join(log_lines[-80:]))
+                    raise SystemExit("server died mid-loop")
+                _, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+                value = metric_value(body.decode(), name, labels)
+                if value is not None and value >= minimum:
+                    return value
+                time.sleep(0.5)
+            print("\n".join(log_lines[-80:]))
+            raise SystemExit(f"{name}{{{labels}}} never reached {minimum}")
+
+        wait_metric("mlops_tpu_drift_trigger_total", "", 1, 120)
+        print("# lifecycle-smoke: auto-retrain fired", flush=True)
+        wait_metric(
+            "mlops_tpu_promotions_total", 'outcome="promoted"', 1, 300
+        )
+        generation = wait_metric("mlops_tpu_bundle_generation", "", 2, 60)
+        print(
+            f"# lifecycle-smoke: hot swap landed (generation {generation:g})",
+            flush=True,
+        )
+        time.sleep(1.0)  # post-swap traffic on the promoted bundle
+        stop.set()
+        client.join(timeout=60)
+        assert counts["ok"] > 0, "hammer never completed a request"
+        assert counts["bad"] == 0, (
+            f"non-200s during the lifecycle window: {counts['bad']} "
+            f"(first: {bad_detail[:5]}) — the swap was not zero-downtime"
+        )
+        print(
+            f"# lifecycle-smoke: {counts['ok']} requests, zero non-200 "
+            "across trigger/retrain/shadow/swap; draining", flush=True,
+        )
+
+        server.send_signal(signal.SIGTERM)
+        rc = server.wait(timeout=90)
+        pump.join(timeout=10)
+        log = "\n".join(log_lines)
+        assert rc == 0, f"server exited {rc}"
+        assert "Task was destroyed" not in log, log[-2000:]
+        print("# lifecycle-smoke: OK (clean drain)", flush=True)
+        return 0
+    finally:
+        stop.set()
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

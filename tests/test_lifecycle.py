@@ -1,0 +1,552 @@
+"""Lifecycle controller tests: triggers, reservoir, retrain, shadow,
+gated promotion, bit-stable hot swap, rollback, and the gauge renders.
+
+The acceptance contract (ISSUE 8): drift-inject -> auto-retrain ->
+shadow-mirror -> gated hot swap with bit-stable serving during the swap
+(every response attributable to exactly one bundle generation), a
+candidate failing the AUC gate never swapping in, one-call rollback, and
+a drift spike inside the cooldown window not re-triggering retrain.
+"""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.config import Config
+from mlops_tpu.lifecycle import (
+    LifecycleController,
+    SampleReservoir,
+    TriggerPolicy,
+    evaluate_gates,
+    expected_calibration_error,
+    roc_auc_np,
+    run_retrain,
+)
+from mlops_tpu.lifecycle.shadow import ShadowEngine
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.serve.engine import InferenceEngine
+
+# ----------------------------------------------------------------- fixtures
+
+
+def _lifecycle_config(td, labeled_path="") -> Config:
+    config = Config()
+    config.lifecycle.enabled = True
+    config.lifecycle.dir = str(td / "lifecycle")
+    config.lifecycle.labeled_path = str(labeled_path)
+    config.lifecycle.retrain_steps = 50
+    config.lifecycle.min_labeled_rows = 500
+    config.lifecycle.min_window_rows = 32
+    config.lifecycle.hysteresis_windows = 2
+    config.lifecycle.cooldown_s = 0.0
+    config.lifecycle.mirror_fraction = 1.0
+    config.lifecycle.shadow_min_mirrors = 4
+    config.lifecycle.max_ece = 0.3  # tiny fixtures calibrate coarsely
+    return config
+
+
+@pytest.fixture(scope="module")
+def lc(tiny_pipeline, tmp_path_factory):
+    """Shared lifecycle scenery: the tiny incumbent bundle, a labeled
+    DRIFTED window on disk (numerics x10 — K-S drift score ~1), encoded
+    normal + drifted traffic, and one retrained candidate."""
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+
+    td = tmp_path_factory.mktemp("lifecycle")
+    _, result = tiny_pipeline
+    bundle = load_bundle(result.bundle_dir)
+
+    columns, labels = generate_synthetic(1500, seed=3)
+    for feat in SCHEMA.numeric:
+        columns[feat.name] = [v * 10.0 for v in columns[feat.name]]
+    labeled = td / "labeled.csv"
+    write_csv_columns(labeled, columns, labels)
+
+    prep = bundle.preprocessor
+    norm_cols, _ = generate_synthetic(64, seed=9)
+    drift_cols = {k: list(v) for k, v in norm_cols.items()}
+    for feat in SCHEMA.numeric:
+        drift_cols[feat.name] = [v * 10.0 for v in drift_cols[feat.name]]
+
+    config = _lifecycle_config(td, labeled)
+    candidate = run_retrain(bundle, config, generation=2)
+    return {
+        "td": td,
+        "bundle": bundle,
+        "config": config,
+        "normal": prep.encode(norm_cols),
+        "drifted": prep.encode(drift_cols),
+        "candidate": candidate,
+    }
+
+
+def _fresh_engine(lc) -> InferenceEngine:
+    engine = InferenceEngine(
+        lc["bundle"], buckets=(1, 8), enable_grouping=False
+    )
+    engine.warmup()
+    return engine
+
+
+def _feed(engine, ds, batch=8):
+    for lo in range(0, ds.cat_ids.shape[0], batch):
+        engine.predict_arrays(
+            ds.cat_ids[lo : lo + batch], ds.numeric[lo : lo + batch]
+        )
+
+
+# ----------------------------------------------------------------- triggers
+
+
+def _snap(rows, outliers, batches, drift):
+    feats = {name: drift for name in SCHEMA.feature_names}
+    return {
+        "rows": rows,
+        "outliers": outliers,
+        "batches": batches,
+        "drift_last": dict(feats),
+        "drift_mean": dict(feats),
+    }
+
+
+def test_trigger_hysteresis_requires_consecutive_breaches():
+    cfg = Config().lifecycle
+    cfg.min_window_rows = 8
+    cfg.hysteresis_windows = 2
+    cfg.drift_threshold = 0.8
+    cfg.cooldown_s = 100.0
+    policy = TriggerPolicy(cfg)
+    assert not policy.observe(_snap(10, 0, 1, 0.1), 0.0).fired  # baseline
+    # First breached window: hysteresis holds fire.
+    first = policy.observe(_snap(30, 0, 3, 0.95), 1.0)
+    assert not first.fired and first.streak == 1
+    # A CLEAN window resets the streak...
+    calm = policy.observe(_snap(60, 1, 6, 0.55), 2.0)
+    assert not calm.fired and calm.streak == 0
+    # ...so one more breach still does not fire...
+    assert not policy.observe(_snap(90, 1, 9, 0.95), 3.0).fired
+    # ...but the second consecutive one does.
+    fired = policy.observe(_snap(120, 1, 12, 0.95), 4.0)
+    assert fired.fired and "drift" in fired.reason
+
+
+def test_trigger_cooldown_blocks_respike():
+    cfg = Config().lifecycle
+    cfg.min_window_rows = 8
+    cfg.hysteresis_windows = 1
+    cfg.drift_threshold = 0.8
+    cfg.cooldown_s = 100.0
+    policy = TriggerPolicy(cfg)
+    policy.observe(_snap(10, 0, 1, 0.1), 0.0)
+    assert policy.observe(_snap(30, 0, 3, 0.95), 1.0).fired
+    # Drift spike INSIDE the cooldown window: no re-trigger, and the
+    # breach does not even accumulate hysteresis.
+    spike = policy.observe(_snap(60, 0, 6, 0.99), 50.0)
+    assert not spike.fired and spike.in_cooldown and spike.streak == 0
+    # Past the cooldown the policy is armed again.
+    assert policy.observe(_snap(90, 0, 9, 0.99), 101.0).fired
+
+
+def test_trigger_thin_window_preserves_hysteresis_streak():
+    """A window below the evidence floor is NO EVIDENCE, not a clean
+    bill: alternating thin/full windows under sustained drift must still
+    accumulate the streak (a reset here would mask real drift forever)."""
+    cfg = Config().lifecycle
+    cfg.min_window_rows = 100
+    cfg.hysteresis_windows = 2
+    cfg.drift_threshold = 0.8
+    cfg.cooldown_s = 0.0
+    policy = TriggerPolicy(cfg)
+    policy.observe(_snap(10, 0, 1, 0.1), 0.0)
+    first = policy.observe(_snap(210, 0, 3, 0.95), 1.0)  # full, breached
+    assert not first.fired and first.streak == 1
+    thin = policy.observe(_snap(220, 0, 4, 0.95), 2.0)  # 10 rows: thin
+    assert not thin.fired and thin.streak == 1  # streak untouched
+    fired = policy.observe(_snap(430, 0, 7, 0.95), 3.0)  # full, breached
+    assert fired.fired
+
+
+def test_trigger_needs_minimum_window_rows():
+    cfg = Config().lifecycle
+    cfg.min_window_rows = 1000
+    cfg.hysteresis_windows = 1
+    cfg.cooldown_s = 0.0
+    policy = TriggerPolicy(cfg)
+    policy.observe(_snap(10, 0, 1, 0.1), 0.0)
+    assert not policy.observe(_snap(40, 0, 4, 0.99), 1.0).fired
+
+
+def test_trigger_outlier_rate_path():
+    cfg = Config().lifecycle
+    cfg.min_window_rows = 8
+    cfg.hysteresis_windows = 1
+    cfg.outlier_threshold = 0.5
+    cfg.cooldown_s = 0.0
+    policy = TriggerPolicy(cfg)
+    policy.observe(_snap(10, 0, 1, 0.1), 0.0)
+    fired = policy.observe(_snap(30, 15, 3, 0.1), 1.0)
+    assert fired.fired and "outlier" in fired.reason
+
+
+# ---------------------------------------------------------------- reservoir
+
+
+def test_reservoir_bounded_and_persistent(tmp_path):
+    res = SampleReservoir(32, tmp_path, seed=1)
+    rng = np.random.default_rng(0)
+    cat = rng.integers(0, 2, (200, SCHEMA.num_categorical)).astype(np.int32)
+    num = rng.normal(size=(200, SCHEMA.num_numeric)).astype(np.float32)
+    res.add_batch(cat, num)
+    assert res.rows == 32 and res.rows_seen == 200
+    res.save()
+    revived = SampleReservoir(32, tmp_path, seed=1)
+    assert revived.load()
+    assert revived.rows == 32 and revived.rows_seen == 200
+    w_cat, w_num = revived.window()
+    assert w_cat.shape == (32, SCHEMA.num_categorical)
+    assert w_num.dtype == np.float32
+
+
+# ------------------------------------------------------------------ retrain
+
+
+def test_retrain_produces_candidate_bundle_and_checkpoint(lc):
+    result = lc["candidate"]
+    assert result.candidate_dir.is_dir()
+    # The candidate loads as a real bundle with lifecycle provenance tags
+    # and a monitor whose K-S reference width matches the incumbent's
+    # compiled contract (the shared-exec-table invariant).
+    bundle = load_bundle(result.candidate_dir)
+    assert bundle.manifest["tags"]["lifecycle"] == "candidate"
+    assert (
+        bundle.monitor.num_ref_sorted.shape
+        == lc["bundle"].monitor.num_ref_sorted.shape
+    )
+    ckpt_dir = (
+        result.candidate_dir.parent.parent / "checkpoints" / "gen-2-t1"
+    )
+    assert any(ckpt_dir.iterdir()), "retrain must checkpoint"
+    assert result.holdout.n > 0 and result.holdout.labels is not None
+
+
+def test_retrain_attempts_never_resume_rejected_checkpoints(lc):
+    """A second trigger (new attempt) must land in a FRESH checkpoint
+    dir: resuming a rejected attempt's completed checkpoints would
+    restore the final step and return the stale params untouched."""
+    second = run_retrain(
+        lc["bundle"], lc["config"], generation=2, attempt=2
+    )
+    assert second.candidate_dir.name == "gen-2-t2"
+    assert second.candidate_dir != lc["candidate"].candidate_dir
+    ckpts = second.candidate_dir.parent.parent / "checkpoints"
+    assert (ckpts / "gen-2-t2").is_dir()
+
+
+def test_retrain_same_tag_never_resumes_a_completed_attempt(lc):
+    """Colliding attempt tags (process restart, offline CLI rerun) must
+    WIPE a completed prior checkpoint and retrain fresh — a full resume
+    would restore the final step and train zero new steps on however
+    fresh a labeled window (partial checkpoints still resume)."""
+    first = run_retrain(lc["bundle"], lc["config"], generation=2, attempt=4)
+    ckpt_dir = (
+        first.candidate_dir.parent.parent / "checkpoints" / "gen-2-t4"
+    )
+    latest = ckpt_dir / "latest.json"
+    mtime = latest.stat().st_mtime_ns
+    second = run_retrain(lc["bundle"], lc["config"], generation=2, attempt=4)
+    # A completed-resume trains 0 steps and never re-checkpoints; the
+    # wipe forces a fresh run that writes a new final checkpoint.
+    assert latest.stat().st_mtime_ns > mtime
+    assert second.metrics  # a real (re)trained candidate, graded
+
+
+def test_retrain_refit_preprocessor_keeps_incumbent_encoded_holdout(lc):
+    """Under refit_preprocessor the gates must grade each side in the
+    encode configuration IT serves: the holdout ships in both encodings,
+    same rows."""
+    import copy
+
+    config = copy.deepcopy(lc["config"])
+    config.lifecycle.refit_preprocessor = True
+    result = run_retrain(lc["bundle"], config, generation=2, attempt=5)
+    assert result.holdout_incumbent is not result.holdout
+    assert result.holdout_incumbent.n == result.holdout.n
+    np.testing.assert_array_equal(
+        result.holdout_incumbent.labels, result.holdout.labels
+    )  # identical row selection
+    assert not np.allclose(  # different normalization stats
+        result.holdout_incumbent.numeric, result.holdout.numeric
+    )
+    # Without a refit the two references are the same object.
+    assert lc["candidate"].holdout_incumbent is lc["candidate"].holdout
+
+
+def test_retrain_monitor_refits_on_reservoir_window(lc):
+    """The serve-path reservoir IS the monitor's refit source when it
+    carries enough evidence: the candidate's drift reference must
+    describe recent TRAFFIC, not the labeled file."""
+    rng = np.random.default_rng(2)
+    k = 1500
+    window = (
+        rng.integers(0, 2, (k, SCHEMA.num_categorical)).astype(np.int32),
+        rng.normal(7.0, 0.1, (k, SCHEMA.num_numeric)).astype(np.float32),
+    )
+    result = run_retrain(
+        lc["bundle"], lc["config"], generation=2, attempt=3,
+        reservoir_window=window,
+    )
+    ref = np.asarray(result.bundle.monitor.num_ref_sorted)
+    # Reference sample drawn from the N(7, 0.1) reservoir, not the
+    # labeled window (whose numerics are nowhere near a tight 7.0 band).
+    assert ref.shape == lc["bundle"].monitor.num_ref_sorted.shape
+    assert abs(float(ref.mean()) - 7.0) < 0.5
+
+
+# ----------------------------------------------------- shadow + gates + swap
+
+
+def test_shadow_shares_exec_table_for_same_architecture(lc):
+    engine = _fresh_engine(lc)
+    shadow = ShadowEngine(engine, lc["candidate"].bundle)
+    shadow.warm()
+    assert shadow.warm_mode == "shared"
+    assert set(shadow.engine._exec) == set(engine._exec)
+    # Candidate warmup must only ever involve registered cache entries —
+    # the tpulint Layer-2 / warmers lockstep extends to the lifecycle.
+    from mlops_tpu.compilecache.registry import CACHE_ENTRY_IDS
+
+    assert {"serve-predict-packed", "serve-predict-group-packed"} <= set(
+        CACHE_ENTRY_IDS
+    )
+
+
+def test_candidate_failing_auc_gate_never_swaps(lc, monkeypatch):
+    """A wrecked candidate (zeroed params -> AUC 0.5) must be REJECTED by
+    the gates and the live engine must never change generation."""
+    import jax
+
+    import mlops_tpu.lifecycle.controller as controller_mod
+
+    engine = _fresh_engine(lc)
+    good = lc["candidate"]
+    wrecked_bundle = dataclasses.replace(
+        good.bundle,
+        variables=jax.tree_util.tree_map(
+            lambda a: np.zeros_like(np.asarray(a)), good.bundle.variables
+        ),
+    )
+    wrecked = dataclasses.replace(good, bundle=wrecked_bundle)
+    monkeypatch.setattr(
+        controller_mod, "run_retrain", lambda *a, **k: wrecked
+    )
+    clock = {"t": 0.0}
+    config = lc["config"]
+    ctrl = LifecycleController(engine, config, clock=lambda: clock["t"])
+    _feed(engine, lc["normal"])
+    ctrl.run_once()
+    for _ in range(4):
+        _feed(engine, lc["drifted"])
+        clock["t"] += 1.0
+        status = ctrl.run_once()
+        if status["promotions"]["rejected"]:
+            break
+    assert status["drift_triggers"] == 1
+    assert status["promotions"] == {
+        "promoted": 0, "rejected": 1, "rolled_back": 0,
+    }
+    assert engine.bundle_generation == 1  # never swapped in
+    report = status["last_report"]
+    assert report["outcome"] == "rejected"
+    assert any("auc" in reason for reason in report["gates"]["reasons"])
+    # A drift spike inside the post-rejection cooldown must not
+    # re-trigger retrain.
+    config.lifecycle.cooldown_s = 1000.0
+    ctrl.policy.start_cooldown(clock["t"])
+    _feed(engine, lc["drifted"])
+    clock["t"] += 1.0
+    assert ctrl.run_once()["drift_triggers"] == 1
+
+
+def test_hot_swap_is_bit_stable_and_rolls_back(lc):
+    """Concurrent traffic across a promotion: every response must equal
+    the incumbent's or the candidate's reference response EXACTLY (one
+    bundle generation end to end, never a mix), with the lock sanitizer
+    asserting the declared order; rollback restores the incumbent's exact
+    responses in one call."""
+    from mlops_tpu.analysis.lockcheck import instrument_locks
+
+    engine = _fresh_engine(lc)
+    shadow = ShadowEngine(engine, lc["candidate"].bundle)
+    shadow.warm()
+    ds = lc["drifted"]
+    cat, num = ds.cat_ids[:8], ds.numeric[:8]
+    exp_incumbent = engine.predict_arrays(cat, num)
+    exp_candidate = shadow.engine.predict_arrays(cat, num)
+    assert exp_incumbent != exp_candidate  # the swap must be observable
+
+    responses: list = []
+    errors: list = []
+    start = threading.Barrier(4)
+
+    def hammer():
+        try:
+            start.wait()
+            for _ in range(30):
+                responses.append(engine.predict_arrays(cat, num))
+        except Exception as err:  # pragma: no cover - surfaced below
+            errors.append(err)
+
+    with instrument_locks(engine, perturb_seed=7) as sanitizer:
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        start.wait()
+        generation = engine.swap_bundle(shadow.engine)
+        for t in threads:
+            t.join()
+    assert not errors
+    assert not sanitizer.violations, [str(v) for v in sanitizer.violations]
+    assert generation == 2
+    matched_inc = sum(r == exp_incumbent for r in responses)
+    matched_cand = sum(r == exp_candidate for r in responses)
+    assert matched_inc + matched_cand == len(responses), (
+        "a response matched NEITHER bundle generation — the swap mixed "
+        "params/programs across generations"
+    )
+    assert matched_cand > 0  # the swap actually took effect
+    # Post-swap the engine serves the candidate verbatim...
+    assert engine.predict_arrays(cat, num) == exp_candidate
+    # ...and one rollback call restores the incumbent verbatim.
+    assert engine.rollback() == 3
+    assert engine.predict_arrays(cat, num) == exp_incumbent
+    # Rollback is itself reversible (the states exchange).
+    assert engine.rollback() == 4
+    assert engine.predict_arrays(cat, num) == exp_candidate
+
+
+def test_end_to_end_drift_retrain_shadow_promote(lc):
+    """The acceptance loop: drift-inject -> trigger -> auto-retrain ->
+    shadow-mirror -> gates -> hot promotion, all through the controller."""
+    engine = _fresh_engine(lc)
+    clock = {"t": 0.0}
+    ctrl = LifecycleController(
+        engine, lc["config"], clock=lambda: clock["t"]
+    )
+    _feed(engine, lc["normal"])
+    assert ctrl.run_once()["state"] == "idle"  # baseline, no trigger
+    status = None
+    for _ in range(6):
+        _feed(engine, lc["drifted"])
+        clock["t"] += 1.0
+        status = ctrl.run_once()
+        if status["promotions"]["promoted"]:
+            break
+    assert status["drift_triggers"] == 1
+    assert status["promotions"]["promoted"] == 1
+    assert status["generation"] == 2
+    report = status["last_report"]
+    assert report["outcome"] == "promoted"
+    assert report["gates"]["passed"]
+    assert report["mirrors"] >= lc["config"].lifecycle.shadow_min_mirrors
+    assert report["warm_mode"] == "shared"
+    # The retrained candidate must actually fit the drifted window better
+    # than the incumbent on the labeled holdout.
+    assert report["auc_delta"] > 0
+    snap = ctrl.metrics_snapshot()
+    assert snap["reservoir_rows"] > 0
+    assert snap["tee_drops"] == 0
+
+
+# ------------------------------------------------------------------- gates
+
+
+def test_gate_math_auc_ece():
+    labels = np.array([0, 0, 1, 1, 0, 1], np.float64)
+    good = np.array([0.1, 0.2, 0.9, 0.8, 0.3, 0.7])
+    assert roc_auc_np(good, labels) == 1.0
+    assert roc_auc_np(np.full(6, 0.5), labels) == 0.5
+    assert expected_calibration_error(labels.astype(float), labels) == 0.0
+
+    from mlops_tpu.lifecycle.shadow import ShadowReport
+
+    cfg = Config().lifecycle
+    base = dict(
+        auc_candidate=0.8, auc_incumbent=0.8, auc_delta=0.0,
+        ece_candidate=0.02, ece_incumbent=0.02,
+        p99_candidate_ms=1.0, p99_incumbent_ms=1.0,
+        p50_candidate_ms=0.5, p50_incumbent_ms=0.5,
+        mirrors=10, mirror_drops=0, mean_abs_pred_delta=0.0,
+        holdout_rows=100, warm_mode="shared", warm_s=0.0,
+    )
+    assert evaluate_gates(ShadowReport(**base), cfg).passed
+    bad_auc = dict(base, auc_delta=-0.5, auc_candidate=0.3)
+    decision = evaluate_gates(ShadowReport(**bad_auc), cfg)
+    assert not decision.passed and "auc" in decision.reasons[0]
+    bad_p99 = dict(base, p99_candidate_ms=100.0)
+    decision = evaluate_gates(ShadowReport(**bad_p99), cfg)
+    assert not decision.passed and "latency" in decision.reasons[0]
+    bad_ece = dict(base, ece_candidate=0.9)
+    decision = evaluate_gates(ShadowReport(**bad_ece), cfg)
+    assert not decision.passed and "calibration" in decision.reasons[0]
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_lifecycle_gauges_single_process_render():
+    from mlops_tpu.serve.metrics import ServingMetrics
+
+    metrics = ServingMetrics()
+    assert "mlops_tpu_bundle_generation" not in metrics.render()
+    metrics.set_lifecycle(
+        {
+            "generation": 3,
+            "drift_triggers": 2,
+            "shadow_auc_delta": 0.0123,
+            "promotions": {"promoted": 1, "rejected": 1, "rolled_back": 0},
+            "reservoir_rows": 77,
+        }
+    )
+    text = metrics.render()
+    assert "mlops_tpu_bundle_generation 3" in text
+    assert "mlops_tpu_drift_trigger_total 2" in text
+    assert "mlops_tpu_shadow_auc_delta 0.012300" in text
+    assert 'mlops_tpu_promotions_total{outcome="promoted"} 1' in text
+    assert 'mlops_tpu_promotions_total{outcome="rolled_back"} 0' in text
+    assert "mlops_tpu_lifecycle_reservoir_rows 77" in text
+
+
+def test_lifecycle_gauges_ring_render():
+    from mlops_tpu.serve.ipc import RequestRing
+    from mlops_tpu.serve.metrics import render_ring_metrics
+
+    ring = RequestRing(workers=1, slots_small=2, slots_large=1, large_rows=8)
+    try:
+        assert "mlops_tpu_bundle_generation" not in render_ring_metrics(ring)
+        ring.write_lifecycle(
+            {
+                "generation": 2,
+                "drift_triggers": 1,
+                "shadow_auc_delta": None,
+                "promotions": {"promoted": 1, "rejected": 0,
+                               "rolled_back": 1},
+                "reservoir_rows": 5,
+            }
+        )
+        text = render_ring_metrics(ring)
+        assert "mlops_tpu_bundle_generation 2" in text
+        assert "mlops_tpu_drift_trigger_total 1" in text
+        # None delta: the series is withheld, not rendered as 0.
+        assert "mlops_tpu_shadow_auc_delta" not in text
+        assert 'mlops_tpu_promotions_total{outcome="rolled_back"} 1' in text
+        assert "mlops_tpu_lifecycle_reservoir_rows 5" in text
+    finally:
+        ring.close()
+
+
+def test_rollback_without_swap_raises(lc):
+    with pytest.raises(ValueError, match="no retired bundle"):
+        InferenceEngine(lc["bundle"], buckets=(1,)).rollback()
