@@ -287,6 +287,75 @@ def _monitor_stage(engine) -> dict:
     return {"monitor_fetch_per_s": round(reps / dt, 1)}
 
 
+def _faults_stage(engine, record) -> dict:
+    """Robustness evidence (mlops_tpu/faults — ISSUE 9):
+
+    - ``fault_overhead_pct``: hot-path cost of the fault-injection
+      subsystem when it is NOT firing — batch-1 p50 with the module
+      disarmed (the product state) vs armed with a zero-match plan (every
+      ``fire()`` takes its slow path, nothing injects). Expected ~0.
+    - ``degraded_p99_ms``: p99 of requests served through the DEGRADED
+      dispatch path — the target bucket's compile failing (seeded fault
+      at serve.engine.compile) and every request riding the next larger
+      warmed bucket instead of 500ing — plus the counter delta proving
+      the path actually ran. Engine state is restored afterwards.
+    """
+    from mlops_tpu import faults
+
+    def p50_ms(reps: int = 60) -> float:
+        lat = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            engine.predict_records([record])
+            lat.append((time.perf_counter() - t0) * 1e3)
+        lat.sort()
+        return _percentile(lat, 50)
+
+    engine.predict_records([record])  # steady state
+    disarmed = p50_ms()
+    faults.arm(
+        faults.FaultPlan.from_rules(
+            [{"point": "bench.no.such.point", "mode": "raise"}]
+        )
+    )
+    try:
+        armed_off = p50_ms()
+    finally:
+        faults.disarm()
+    out: dict = {
+        "fault_overhead_pct": round(
+            (armed_off / max(disarmed, 1e-9) - 1.0) * 100.0, 2
+        )
+    }
+    if not getattr(engine, "monitor_accumulating", False):
+        return out  # no exec table on the sklearn flavor — no degraded path
+
+    records = [record] * 3  # target bucket 8; degrades to the next warmed
+    with engine._compile_lock:
+        saved = engine._exec.pop(("bucket", 8), None)
+    before = engine.degraded_dispatch_total
+    faults.arm(
+        faults.FaultPlan.from_rules(
+            [{"point": "serve.engine.compile", "mode": "raise"}]
+        )
+    )
+    try:
+        lat = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            engine.predict_records(records)
+            lat.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        faults.disarm()
+        if saved is not None:
+            with engine._compile_lock:
+                engine._exec[("bucket", 8)] = saved
+    lat.sort()
+    out["degraded_p99_ms"] = round(_percentile(lat, 99), 3)
+    out["degraded_dispatch_total"] = engine.degraded_dispatch_total - before
+    return out
+
+
 def _bulk_stage(engine, bundle) -> dict:
     """rows/s at fixed buckets (sequential, one blocking call per batch)
     and pipelined (dispatch all chunks, single batched device_get)."""
@@ -1327,6 +1396,13 @@ def main() -> None:
     batch1 = _batch1_stage(engine, record)
     _note("monitor aggregate stage")
     monitor_stats = _monitor_stage(engine)
+    _note("faults stage (armed-off overhead + degraded dispatch)")
+    try:
+        # Robustness evidence, guarded: chaos instrumentation must never
+        # cost the run its headline numbers.
+        faults_stats = _faults_stage(engine, record)
+    except Exception as err:
+        faults_stats = {"fault_stage_error": f"{type(err).__name__}: {err}"}
     _note("bulk stage")
     bulk = _bulk_stage(engine, bundle)
     _note("stream pipeline stage")
@@ -1388,6 +1464,7 @@ def main() -> None:
                 "lock_wait_ms": batch1["lock_wait_ms"],
                 "breakdown_ms": batch1["breakdown_ms"],
                 **monitor_stats,
+                **faults_stats,
                 **bulk,
                 **roofline,
                 **coldstart,

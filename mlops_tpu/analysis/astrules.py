@@ -36,6 +36,13 @@ TPU203   uncached-hot-path-jit          a ``jax.jit`` site under serve/ or
                                         (compilecache/registry.py) — the
                                         program recompiles on every process
                                         start instead of deserializing
+TPU405   swallowed-exception-in-        a broad ``except`` under serve/ or
+         serving-path                   lifecycle/ whose handler neither
+                                        re-raises, returns a wire-shaped
+                                        error, routes the error to a waiter,
+                                        logs at error level, nor increments
+                                        a metric — a serving failure that
+                                        vanishes without a trace
 ======== ============================== =======================================
 
 Traced-scope detection is heuristic but framework-aware: a function counts
@@ -71,6 +78,16 @@ from mlops_tpu.compilecache.registry import CACHED_JIT_BUILDERS
 # Path segments whose jit sites TPU203 polices: the serving + parallel
 # trees are the per-process hot programs the AOT cache exists to warm.
 _HOT_PATH_SEGMENTS = {"serve", "parallel"}
+
+# Path segments whose broad excepts TPU405 polices: the serving + lifecycle
+# trees, where a swallowed failure means a request or a control-loop
+# transition silently vanishes. Every handler there must ACT: re-raise,
+# return a wire-shaped error, hand the error to a waiter, log it at error
+# level, or count it in a named metric (ISSUE 9 audit contract).
+_SERVING_PATH_SEGMENTS = {"serve", "lifecycle"}
+# Attribute-call names TPU405 accepts as "the failure was recorded": the
+# logging error-level surface plus future/waiter error routing.
+_EXC_ACTION_ATTRS = {"exception", "error", "critical", "set_exception"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -131,6 +148,12 @@ RULES: dict[str, RuleInfo] = {
             "uncached-hot-path-jit",
             Severity.ERROR,
             "hot-path jit not routed through the compile cache",
+        ),
+        RuleInfo(
+            "TPU405",
+            "swallowed-exception-in-serving-path",
+            Severity.ERROR,
+            "serving-path broad except that records nothing",
         ),
     )
 }
@@ -504,7 +527,54 @@ class _RuleVisitor(ast.NodeVisitor):
                 "(XlaRuntimeError, checkify) — catch the specific "
                 "exceptions or justify with a disable comment",
             )
+        # TPU405: on serving paths (serve/, lifecycle/) even a JUSTIFIED
+        # broad except (TPU201-disabled) must visibly ACT on the failure.
+        # Orthogonal to TPU201 by design: the disable that justifies the
+        # breadth does not excuse a handler that records nothing.
+        if (
+            broad
+            and self._on_serving_path()
+            and not reraises
+            and not self._handler_acts(node)
+        ):
+            self._flag(
+                "TPU405",
+                node,
+                "broad except on a serving path swallows the failure "
+                "without a trace — re-raise, return a wire-shaped error, "
+                "route it to a waiter (set_exception), log it via "
+                "logger.exception/error, or increment a named metric",
+            )
         self.generic_visit(node)
+
+    @staticmethod
+    def _handler_acts(node: ast.ExceptHandler) -> bool:
+        """Does the handler body (nested defs excluded — their bodies run
+        later, in another scope) visibly act on the failure? Accepted
+        actions: ``return`` (a wire-shaped error path), an error-level
+        log / waiter-routing call (`_EXC_ACTION_ATTRS`), or an augmented
+        assignment (a metric/drop counter increment). ``raise`` is
+        handled by the caller's re-raise check."""
+        for stmt in node.body:
+            for sub in _scope_nodes([stmt]):
+                if isinstance(sub, ast.Return):
+                    return True
+                if isinstance(sub, ast.AugAssign):
+                    return True
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _EXC_ACTION_ATTRS
+                ):
+                    return True
+        return False
+
+    def _on_serving_path(self) -> bool:
+        import re
+
+        return bool(
+            _SERVING_PATH_SEGMENTS & set(re.split(r"[\\/]+", self.rel_path))
+        )
 
     # ------------------------------------------------------ TPU101/TPU102
     def visit_Call(self, node: ast.Call) -> None:

@@ -49,6 +49,7 @@ from hashlib import sha256
 from pathlib import Path
 from typing import Any, Callable
 
+from mlops_tpu import faults
 from mlops_tpu.compilecache import keys
 from mlops_tpu.utils.timing import StageClock
 
@@ -215,6 +216,10 @@ class CompileCache:
         try:
             with self._clock.stage("deserialize"):
                 raw = path.read_bytes()
+                # Injection point (mlops_tpu/faults): corrupt-on-read —
+                # seeded bit flips here must land in the discard+recompile
+                # path below, never in a served program.
+                raw = faults.corrupt("compilecache.read", raw)
                 header_line, _, blob = raw.partition(b"\n")
                 import json
 
@@ -277,6 +282,11 @@ class CompileCache:
         )
         try:
             tmp.write_bytes(json.dumps(header).encode() + b"\n" + blob)
+            # Injection point (mlops_tpu/faults): a kill here — after the
+            # tmp write, before the atomic rename — is the torn-persist
+            # proof: the artifact path must either not exist or hold a
+            # fully verified prior artifact (chaos smoke asserts it).
+            faults.fire("compilecache.persist.midwrite")
             os.replace(tmp, path)
         except OSError:
             tmp.unlink(missing_ok=True)

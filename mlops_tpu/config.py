@@ -227,9 +227,26 @@ class ServeConfig:
     # predict requests since the last fetch; 0 disables the K-trigger
     request_timeout_s: float = 30.0  # per-request deadline on the predict
     # path: a stalled device (observed live: a remote-attached chip's
-    # tunnel hanging dispatches for 40+ min) 503s requests fast instead
-    # of wedging every in-flight connection until the client gives up.
+    # tunnel hanging dispatches for 40+ min) answers the documented 504
+    # fast instead of wedging every in-flight connection until the
+    # client gives up. Clients can tighten it per request with the
+    # x-request-deadline-ms header (serve/httpcore.py — the budget also
+    # rides into the engine so expired work is shed, never dispatched).
     # 0 disables.
+    drain_deadline_s: float = 30.0  # graceful-drain window: how long a
+    # draining server (single-process) or front-end worker (multi-worker)
+    # waits for busy exchanges and in-flight ring slots to finish before
+    # force-closing connections. Tune DOWN for chaos scenarios that
+    # should converge fast, UP for slow CI boxes; keep it under the pod's
+    # terminationGracePeriodSeconds (the hard stop)
+    zygote_join_deadline_s: float = 35.0  # zygote shutdown: ONE shared
+    # wall-clock budget for joining all front-end children after the
+    # SIGTERM forward (they drain concurrently; stragglers past it are
+    # SIGKILLed). Must cover drain_deadline_s plus respawn slack
+    engine_zygote_join_s: float = 50.0  # engine-process drain: how long
+    # serve_multi_worker waits for the zygote (which is itself joining
+    # children against zygote_join_deadline_s, +5 s kill grace) before
+    # escalating to SIGKILL. Must exceed zygote_join_deadline_s + 5
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable
@@ -259,6 +276,28 @@ class ServeConfig:
             )
         if self.workers < 0:
             problems.append(f"serve.workers={self.workers} must be >= 0")
+        if self.drain_deadline_s <= 0:
+            problems.append(
+                f"serve.drain_deadline_s={self.drain_deadline_s} must be "
+                "> 0 (a zero drain window severs in-flight responses on "
+                "every rollout)"
+            )
+        if self.zygote_join_deadline_s < self.drain_deadline_s:
+            problems.append(
+                f"serve.zygote_join_deadline_s={self.zygote_join_deadline_s}"
+                f" must cover serve.drain_deadline_s={self.drain_deadline_s}"
+                " (the zygote joins children that are themselves draining "
+                "for the full drain window)"
+            )
+        if self.engine_zygote_join_s < self.zygote_join_deadline_s + 5:
+            problems.append(
+                f"serve.engine_zygote_join_s={self.engine_zygote_join_s} "
+                "must exceed serve.zygote_join_deadline_s + 5 "
+                f"(= {self.zygote_join_deadline_s + 5:g}: the zygote's "
+                "child-join budget plus its SIGKILL grace — a shorter "
+                "engine wait SIGKILLs a zygote that is still joining "
+                "cleanly)"
+            )
         if self.workers > 1:
             if self.ring_slots_small < 1 or self.ring_slots_large < 1:
                 problems.append(
@@ -387,6 +426,15 @@ class LifecycleConfig:
     # the incumbent's on the same mirrored/holdout shapes
     auto_promote: bool = True  # False stops after the gate report (the
     # human-in-the-loop mode; promote later via the registry CLI)
+    # ---------------------------------------------------- circuit breaker
+    breaker_failures: int = 3  # consecutive retrain/shadow/evaluate
+    # FAILURES (not gate rejections — those are the loop working) that
+    # open the circuit breaker: while open, triggers neither fire nor
+    # accumulate hysteresis, so a persistently broken retrain path
+    # (corrupt labeled file, full disk, compile regression) cools down
+    # instead of hot-looping retrain attempts against live serving
+    breaker_cooldown_s: float = 1800.0  # how long the breaker stays open
+    # before the loop re-arms (half-open: the next trigger is the probe)
 
     def validate(self) -> "LifecycleConfig":
         problems: list[str] = []
@@ -453,6 +501,16 @@ class LifecycleConfig:
             problems.append(
                 f"lifecycle.shadow_max_s={self.shadow_max_s} must be > 0 "
                 "(the shadow phase needs a bounded evaluation deadline)"
+            )
+        if self.breaker_failures < 1:
+            problems.append(
+                f"lifecycle.breaker_failures={self.breaker_failures} must "
+                "be >= 1 (0 would open the breaker on no evidence)"
+            )
+        if self.breaker_cooldown_s < 0:
+            problems.append(
+                f"lifecycle.breaker_cooldown_s={self.breaker_cooldown_s} "
+                "must be >= 0"
             )
         if problems:
             raise LifecycleConfigError("; ".join(problems))

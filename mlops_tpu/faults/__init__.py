@@ -1,0 +1,56 @@
+"""Deterministic fault injection (`faults.fire` / `faults.corrupt`).
+
+The canonical import is the package itself::
+
+    from mlops_tpu import faults
+    ...
+    faults.fire("serve.engine.dispatch")
+
+See `mlops_tpu/faults/injector.py` for the plan format and semantics,
+and docs/operations.md ("Failure domains & degraded modes") for the
+operator view. Registered injection points live in `POINTS` so the
+chaos tooling and docs can enumerate them.
+"""
+
+from mlops_tpu.faults.injector import (  # noqa: F401
+    ENV_VAR,
+    FAULT_MODES,
+    FaultInjected,
+    FaultPlan,
+    FaultRule,
+    active_plan,
+    arm,
+    armed,
+    corrupt,
+    disarm,
+    fire,
+    load_plan,
+)
+
+# Every named injection point compiled into the codebase, with the fault
+# modes that make sense there. Purely documentary (fire()/corrupt() take
+# any name), but the chaos smoke and the runbook enumerate THIS table —
+# keep it in sync when adding points.
+POINTS: dict[str, str] = {
+    "serve.engine.dispatch": "before every solo device dispatch "
+    "(raise = device error -> 500; delay = engine stall -> deadline 504)",
+    "serve.engine.dispatch_group": "before every grouped device dispatch "
+    "(same modes; covers the micro-batcher and the shm ring plane)",
+    "serve.engine.compile": "inside the novel-shape AOT compile "
+    "(raise = compile/cache failure -> degraded next-bucket dispatch)",
+    "serve.frontend.predict": "front-end predict entry on the ring plane "
+    "(kill = worker crash mid-request; the zygote respawn path)",
+    "compilecache.read": "artifact bytes on cache read "
+    "(corrupt = bit flips -> checksum discard + recompile)",
+    "compilecache.persist.midwrite": "between the cache artifact's tmp "
+    "write and its rename (kill = torn persist; no partial artifact may "
+    "survive)",
+    "lifecycle.reservoir.midwrite": "between the reservoir snapshot's tmp "
+    "write and its rename (kill = torn reservoir save)",
+    "io.atomic_write.midwrite": "inside utils.io.atomic_write between "
+    "write and rename (kill = torn checkpoint/registry write)",
+    "lifecycle.retrain": "entry of the controller's retrain transition "
+    "(raise = repeated retrain failure -> circuit breaker)",
+    "lifecycle.shadow.evaluate": "entry of the shadow gate evaluation "
+    "(raise = repeated evaluation failure -> circuit breaker)",
+}

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Any
 
+from mlops_tpu import faults
 from mlops_tpu.config import Config
 from mlops_tpu.lifecycle.retrain import (
     LifecycleError,
@@ -93,6 +94,17 @@ class LifecycleController:
         self._drift_triggers = 0
         self._promotions = {"promoted": 0, "rejected": 0, "rolled_back": 0}
         self._shadow_auc_delta: float | None = None
+        # Circuit breaker: consecutive UNEXPECTED retrain/shadow/evaluate
+        # failures (named LifecycleError skips don't count — those are
+        # the loop declining work, already cooldown-throttled) open the
+        # breaker for lifecycle.breaker_cooldown_s: triggers neither fire
+        # nor accumulate while open, so a persistently broken retrain
+        # path cools down instead of hot-looping attempts against live
+        # serving. Exported as mlops_tpu_lifecycle_breaker_open /
+        # _breaker_trips_total.
+        self._consecutive_failures = 0
+        self._breaker_open_until = float("-inf")
+        self._breaker_trips = 0
         self._tee_drops = 0
         self._last_report: dict | None = None
         self._last_error = ""
@@ -156,6 +168,7 @@ class LifecycleController:
                     self._state = "idle"
                     self._shadow = None
                     self._holdout = None
+                self._note_failure(self._clock())
                 self.policy.start_cooldown(self._clock())
 
     # ------------------------------------------------------------- run_once
@@ -210,9 +223,49 @@ class LifecycleController:
         ) & 0x7FFFFFFF
         return (self._mirror_rng_state / 0x80000000) < frac
 
+    # ------------------------------------------------------ circuit breaker
+    def breaker_open(self, now: float | None = None) -> bool:
+        now = self._clock() if now is None else now
+        with self._lock:
+            return now < self._breaker_open_until
+
+    def _note_failure(self, now: float) -> None:
+        """One unexpected retrain/shadow/evaluate failure toward the
+        breaker threshold; opening resets the streak (the post-cooldown
+        loop gets a fresh ``breaker_failures`` budget — half-open)."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures < self.lifecycle.breaker_failures:
+                return
+            self._consecutive_failures = 0
+            self._breaker_trips += 1
+            self._breaker_open_until = (
+                now + self.lifecycle.breaker_cooldown_s
+            )
+            trips, cooldown = (
+                self._breaker_trips, self.lifecycle.breaker_cooldown_s,
+            )
+        logger.error(
+            "lifecycle circuit breaker OPEN (trip %d): %d consecutive "
+            "failures; triggers suspended for %.0fs",
+            trips, self.lifecycle.breaker_failures, cooldown,
+        )
+
+    def _note_cycle_complete(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+
     # ----------------------------------------------------------- idle step
     def _step_idle(self, now: float) -> None:
         snapshot = self.engine.monitor_snapshot()
+        if self.breaker_open(now):
+            # Open breaker: the snapshot still advances the differencing
+            # baseline (windows stay continuous) through the side-effect-
+            # free consume() — observe() here would accumulate hysteresis
+            # and arm hidden trigger cooldowns, delaying the documented
+            # half-open probe past the breaker window.
+            self.policy.consume(snapshot)
+            return
         decision = self.policy.observe(snapshot, now)
         if not decision.fired:
             return
@@ -222,6 +275,10 @@ class LifecycleController:
             self._last_error = ""
         logger.info("lifecycle trigger fired: %s", decision.reason)
         try:
+            # Injection point (mlops_tpu/faults): a raise here is the
+            # repeated-retrain-failure scenario the circuit breaker
+            # exists for (chaos smoke + tests/test_lifecycle.py).
+            faults.fire("lifecycle.retrain")
             result = run_retrain(
                 self.engine.bundle,
                 self.config,
@@ -258,6 +315,7 @@ class LifecycleController:
             with self._lock:
                 self._state = "idle"
                 self._last_error = f"{type(err).__name__}: {err}"
+            self._note_failure(now)
             self.policy.start_cooldown(now)
             return
         logger.info(
@@ -286,6 +344,9 @@ class LifecycleController:
         if not (enough or timed_out):
             return
         try:
+            # Injection point (mlops_tpu/faults): repeated evaluation
+            # failure — the shadow half of the circuit-breaker scenario.
+            faults.fire("lifecycle.shadow.evaluate")
             report = shadow.evaluate(*self._holdout)
         # An evaluation that cannot complete (device error mid-holdout)
         # would otherwise retry-fail every tick forever: discard the
@@ -297,6 +358,7 @@ class LifecycleController:
                 self._shadow = None
                 self._holdout = None
                 self._state = "idle"
+            self._note_failure(now)
             self.policy.start_cooldown(now)
             return
         decision = evaluate_gates(report, self.lifecycle)
@@ -331,6 +393,9 @@ class LifecycleController:
             self._shadow = None
             self._holdout = None
             self._state = "idle"
+        # A completed cycle — promoted OR gate-rejected — is the loop
+        # WORKING; only failures feed the breaker streak.
+        self._note_cycle_complete()
         self.policy.start_cooldown(now)
 
     # ------------------------------------------------------------- rollback
@@ -345,6 +410,7 @@ class LifecycleController:
 
     # -------------------------------------------------------------- status
     def status(self) -> dict:
+        now = self._clock()
         with self._lock:
             return {
                 "state": self._state,
@@ -354,6 +420,9 @@ class LifecycleController:
                 "shadow_auc_delta": self._shadow_auc_delta,
                 "reservoir_rows": None,  # filled below, outside the lock
                 "tee_drops": self._tee_drops,
+                "breaker_open": now < self._breaker_open_until,
+                "breaker_trips": self._breaker_trips,
+                "consecutive_failures": self._consecutive_failures,
                 "last_error": self._last_error,
                 "last_report": self._last_report,
             }

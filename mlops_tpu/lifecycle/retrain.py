@@ -38,6 +38,7 @@ from typing import Any
 
 import numpy as np
 
+from mlops_tpu import faults
 from mlops_tpu.config import Config, TrainConfig
 from mlops_tpu.schema import SCHEMA
 
@@ -138,6 +139,11 @@ class SampleReservoir:
         try:
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, **payload)
+            # Injection point (mlops_tpu/faults): kill between the tmp
+            # write and the rename — a torn reservoir save must leave
+            # either no snapshot or the previous intact one, never a
+            # half-written npz a restart would trust.
+            faults.fire("lifecycle.reservoir.midwrite")
             os.replace(tmp, self.path)
         except BaseException:
             with contextlib.suppress(OSError):

@@ -66,6 +66,17 @@ class TriggerPolicy:
     def in_cooldown(self, now: float) -> bool:
         return now < self._cooldown_until
 
+    def consume(self, snapshot: dict) -> None:
+        """Fold a snapshot into the differencing baseline WITHOUT any
+        evaluation side effects — no firing, no hysteresis accumulation,
+        no cooldown arming. The lifecycle circuit breaker consumes
+        windows this way while open: the window sequence stays
+        continuous (the first post-close observation differences against
+        fresh state, not the pre-open past), but an open breaker can
+        never mutate the trigger machinery's state."""
+        if snapshot:
+            self._prev = _cumulative_view(snapshot)
+
     # ------------------------------------------------------------ observe
     def observe(self, snapshot: dict, now: float) -> TriggerDecision:
         """Fold one cumulative snapshot; decide whether to fire."""

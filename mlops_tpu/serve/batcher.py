@@ -26,7 +26,11 @@ from mlops_tpu.serve.engine import InferenceEngine
 # service (serve/ipc.py RingService) applies the SAME small-request
 # grouping rule engine-side, so one process or N, identical requests
 # ride identical compiled shapes.
-from mlops_tpu.serve.wire import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+from mlops_tpu.serve.wire import (
+    GROUP_ROW_BUCKET,
+    GROUP_SLOT_BUCKETS,
+    DeadlineExceeded,
+)
 
 # Declared order for the two-phase rings, OUTERMOST FIRST (tpulint Layer 3
 # manifest — analysis/concurrency.py / lockcheck.py): the fetch ring is
@@ -76,7 +80,10 @@ class MicroBatcher:
         # A group can never exceed the largest warmed slot bucket — beyond
         # it predict_group would have no compiled shape to run.
         self.max_group = min(max_group, GROUP_SLOT_BUCKETS[-1])
-        self._pending: list[tuple[list[dict], asyncio.Future]] = []
+        # (records, future, absolute loop-clock deadline or None)
+        self._pending: list[
+            tuple[list[dict], asyncio.Future, float | None]
+        ] = []
         self._drain_task: asyncio.Task | None = None
         self._full = asyncio.Event()  # set when a full group is waiting
         self._inflight = asyncio.Semaphore(max_inflight)
@@ -105,8 +112,18 @@ class MicroBatcher:
     def enabled(self) -> bool:
         return self.engine.supports_grouping and self.window_s > 0
 
-    async def predict(self, records: list[dict[str, Any]]) -> dict[str, Any]:
-        """Entry point for the request handler."""
+    async def predict(
+        self,
+        records: list[dict[str, Any]],
+        deadline: float | None = None,
+    ) -> dict[str, Any]:
+        """Entry point for the request handler. ``deadline`` (absolute
+        loop-clock time, from the request's ``x-request-deadline-ms``
+        budget) rides with the queued entry: the drain loop's claim-time
+        purge completes an already-expired entry with
+        ``DeadlineExceeded`` INSTEAD of dispatching it — dead work is
+        shed engine-side, before it costs a device dispatch, not just
+        abandoned by the waiting handler."""
         loop = asyncio.get_running_loop()
         if (
             not self.enabled
@@ -157,7 +174,7 @@ class MicroBatcher:
             return await asyncio.shield(fut)
 
         future: asyncio.Future = loop.create_future()
-        self._pending.append((records, future))
+        self._pending.append((records, future, deadline))
         if len(self._pending) >= self.max_group:
             self._full.set()  # close the window early
         if self._drain_task is None or self._drain_task.done():
@@ -178,14 +195,27 @@ class MicroBatcher:
             # on the dispatch itself, so up to max_inflight groups ride
             # overlapping device round trips.
             await self._inflight.acquire()
-            # Abandoned entries (the server's request deadline cancels the
-            # caller's future, e.g. during a device stall) are dropped at
-            # claim time: without this, a long stall with ongoing traffic
-            # grows _pending unboundedly and a recovering device would
-            # burn through a dead backlog before serving live requests.
-            self._pending = [
-                entry for entry in self._pending if not entry[1].done()
-            ]
+            # Claim-time purge, two kinds of dead entry: ABANDONED ones
+            # (the server's request deadline cancelled the caller's
+            # future, e.g. during a device stall) are dropped — without
+            # this, a long stall with ongoing traffic grows _pending
+            # unboundedly and a recovering device would burn through a
+            # dead backlog before serving live requests. EXPIRED ones
+            # (deadline budget spent waiting in this queue) are completed
+            # with DeadlineExceeded so the handler answers 504 NOW and
+            # the entry never costs a dispatch — the engine-side
+            # dead-work shed.
+            now = asyncio.get_running_loop().time()
+            live = []
+            for entry in self._pending:
+                _, future, entry_deadline = entry
+                if future.done():
+                    continue
+                if entry_deadline is not None and now >= entry_deadline:
+                    future.set_exception(DeadlineExceeded())
+                    continue
+                live.append(entry)
+            self._pending = live
             batch = self._pending[: self.max_group]
             del self._pending[: self.max_group]
             if not batch:
@@ -201,10 +231,10 @@ class MicroBatcher:
         # own; their futures don't need the drain loop.
 
     async def _dispatch(
-        self, batch: list[tuple[list[dict], asyncio.Future]]
+        self, batch: list[tuple[list[dict], asyncio.Future, float | None]]
     ) -> None:
         loop = asyncio.get_running_loop()
-        requests = [records for records, _ in batch]
+        requests = [records for records, _, _ in batch]
         # Two-phase path when the engine supports it: dispatch (encode +
         # device enqueue + async D2H start) holds the inflight slot, the
         # blocking fetch rides the fetch ring — overlapping the next
@@ -241,11 +271,11 @@ class MicroBatcher:
         # encode bug) is re-routed onto every waiter's future, where the
         # request handler surfaces it as a 500.
         except Exception as err:  # tpulint: disable=TPU201
-            for _, future in batch:
+            for _, future, _ in batch:
                 if not future.done():
                     future.set_exception(err)
         else:
-            for (_, future), response in zip(batch, responses):
+            for (_, future, _), response in zip(batch, responses):
                 if not future.done():
                     future.set_result(response)
         finally:

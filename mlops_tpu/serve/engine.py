@@ -21,12 +21,14 @@ TPU serving mechanics (SURVEY.md SS7 "hard parts" — batch-1 latency):
 from __future__ import annotations
 
 import bisect
+import logging
 import threading
 from typing import Any
 
 import jax
 import numpy as np
 
+from mlops_tpu import faults
 from mlops_tpu.bundle.bundle import Bundle
 from mlops_tpu.ops.predict import (
     _acc_donation,
@@ -53,6 +55,8 @@ TPULINT_LOCK_ORDER = {
     "InferenceEngine": ("_compile_lock", "_acc_lock", "_totals_lock")
 }
 
+logger = logging.getLogger("mlops_tpu.serve")
+
 
 def _start_copy(tree: Any) -> None:
     """Begin the device->host copy of every array in ``tree`` WITHOUT
@@ -68,6 +72,19 @@ def _start_copy(tree: Any) -> None:
             pass
 
     jax.tree_util.tree_map(one, tree)
+
+
+def _pad_rows(
+    cat: np.ndarray, num: np.ndarray, n: int, rows: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad ``n`` encoded rows up to ``rows`` with a validity mask — the
+    one padding rule the target-bucket and degraded-bucket dispatches
+    share (identical masking = identical statistics either way)."""
+    pad = rows - n
+    if pad:
+        cat = np.pad(cat, ((0, pad), (0, 0)))
+        num = np.pad(num, ((0, pad), (0, 0)))
+    return cat, num, np.arange(rows) < n
 
 
 class _ArraysHandle:
@@ -225,6 +242,11 @@ class InferenceEngine:
                 "drift_last": np.zeros(d, np.float64),
             }
             self._totals_lock = threading.Lock()
+            # Degraded-mode dispatch counter (`_dispatch_padded` /
+            # `dispatch_group_arrays`): requests served through a
+            # larger-than-target warmed shape after a compile/cache
+            # failure — exported as mlops_tpu_degraded_dispatch_total.
+            self._degraded = 0
         self.ready = False
 
     @property
@@ -236,6 +258,20 @@ class InferenceEngine:
         """True when the fused programs fold the monitor aggregate on
         device (`monitor_snapshot` is then the telemetry read path)."""
         return self._accumulate
+
+    @property
+    def degraded_dispatch_total(self) -> int:
+        """Requests served through a degraded (larger-than-target warmed)
+        shape after a compile/cache failure — the telemetry read for the
+        mlops_tpu_degraded_dispatch_total counter."""
+        if not self._accumulate:
+            return 0
+        with self._totals_lock:
+            return self._degraded
+
+    def _count_degraded(self) -> None:
+        with self._totals_lock:
+            self._degraded += 1
 
     # ------------------------------------------------------------- warmup
     def warmup(self) -> None:
@@ -377,6 +413,10 @@ class InferenceEngine:
         this lock first)."""
         from mlops_tpu.monitor.state import abstract_accumulator
 
+        # Injection point (mlops_tpu/faults): a raise here models a
+        # runtime compile/cache failure — callers degrade to the next
+        # larger warmed shape instead of 500ing (`_dispatch_padded`).
+        faults.fire("serve.engine.compile")
         with self._compile_lock:
             fn = self._exec.get(key)
             if fn is None:
@@ -583,28 +623,62 @@ class InferenceEngine:
             # pre-padding arrays, bounded non-blocking enqueue inside the
             # tee — never a hot-path stall.
             tee(cat_ids, numeric)
+        # Injection point (mlops_tpu/faults): raise = device error (the
+        # caller's 500 contract); delay = engine stall (the deadline 504
+        # contract). Fired pre-padding, outside every lock.
+        faults.fire("serve.engine.dispatch")
         bucket = self._bucket_for(n)
-        if bucket is not None:
-            pad = bucket - n
-            if pad:
-                cat_ids = np.pad(cat_ids, ((0, pad), (0, 0)))
-                numeric = np.pad(numeric, ((0, pad), (0, 0)))
-            mask = np.arange(bucket) < n
-        else:
-            # Oversized request: run at exact shape (compiles once per novel
-            # size — rare; offline batch scoring uses this path).
-            mask = np.ones((n,), bool)
         rows = bucket if bucket is not None else n
         if not self._accumulate:
             # sklearn hybrid: host classifier + device monitors, the seed's
             # dict output (no packed program exists for a non-XLA model).
+            cat_ids, numeric, mask = _pad_rows(cat_ids, numeric, n, rows)
             out = self._predict(cat_ids, numeric, mask)
             return _ArraysHandle(out, n, rows, packed=False)
-        # Keyed by padded row count: equal to the bucket for bucketed
-        # requests, and the exact size for oversized ones — so a repeated
-        # oversized shape reuses its table entry instead of recompiling.
-        out = self._dispatch_fused(("bucket", rows), cat_ids, numeric, mask)
+        out, rows = self._dispatch_padded(cat_ids, numeric, n, rows)
         return _ArraysHandle(out, n, rows, packed=True)
+
+    def _dispatch_padded(self, cat_ids, numeric, n: int, rows: int):
+        """Pad to ``rows`` and dispatch the fused packed program, keyed by
+        the padded row count (equal to the bucket for bucketed requests,
+        the exact size for oversized ones — so a repeated oversized shape
+        reuses its table entry instead of recompiling).
+
+        DEGRADED MODE: a failure for an unwarmed target shape (compile
+        error, corrupt-cache load — the `serve.engine.compile` fault
+        class) retries through the NEXT LARGER warmed bucket instead of
+        500ing: padding is masked out of every statistic, so the degraded
+        response is bit-identical to the target-bucket response — the
+        request pays extra padded compute, never an outage. Counted in
+        ``degraded_dispatch_total``; with no larger warmed bucket the
+        original failure propagates (the caller's 500 contract). Returns
+        ``(packed_out, rows_used)``."""
+        try:
+            cat, num, mask = _pad_rows(cat_ids, numeric, n, rows)
+            return self._dispatch_fused(("bucket", rows), cat, num, mask), rows
+        except Exception:
+            fallback = self._degraded_rows(rows)
+            if fallback is None:
+                raise
+            logger.warning(
+                "dispatch at %d rows failed; degrading to warmed bucket %d",
+                rows, fallback, exc_info=True,
+            )
+            cat, num, mask = _pad_rows(cat_ids, numeric, n, fallback)
+            out = self._dispatch_fused(("bucket", fallback), cat, num, mask)
+            self._count_degraded()
+            return out, fallback
+
+    def _degraded_rows(self, rows: int) -> int | None:
+        """Smallest WARMED bucket strictly larger than ``rows`` (the
+        degraded-dispatch target), or None when nothing larger is warmed."""
+        with self._compile_lock:
+            larger = [
+                key[1]
+                for key in self._exec
+                if key[0] == "bucket" and key[1] > rows
+            ]
+        return min(larger, default=None)
 
     def fetch_arrays(self, handle: _ArraysHandle) -> dict[str, Any]:
         """Block on the host copy and slice the packed buffer into the
@@ -713,6 +787,10 @@ class InferenceEngine:
                 f"grouped requests must have 1..{GROUP_ROW_BUCKET} records, "
                 f"got sizes {sizes}"
             )
+        # Injection point (mlops_tpu/faults): the grouped twin of
+        # serve.engine.dispatch — covers the micro-batcher and the shm
+        # ring plane's coalesced jobs.
+        faults.fire("serve.engine.dispatch_group")
         slots = GROUP_SLOT_BUCKETS[
             bisect.bisect_left(GROUP_SLOT_BUCKETS, len(parts))
         ]
@@ -720,6 +798,40 @@ class InferenceEngine:
         # [slots, 1] shape family — no row padding, ~8x less compute per
         # dispatch on serial backends.
         rows = GROUP_ROW_BUCKETS[0] if max(sizes) == 1 else GROUP_ROW_BUCKET
+        try:
+            out = self._dispatch_group_at(parts, sizes, slots, rows)
+        except Exception:
+            # DEGRADED MODE, grouped flavor: a compile/cache failure for
+            # this group geometry retries through the smallest warmed
+            # geometry that FITS (slot padding is masked out of every
+            # statistic, so responses stay bit-identical) instead of
+            # failing the whole coalesced job.
+            fallback = self._degraded_group_shape(
+                len(parts), max(sizes), (slots, rows)
+            )
+            if fallback is None:
+                raise
+            logger.warning(
+                "grouped dispatch at (%d, %d) failed; degrading to warmed "
+                "geometry (%d, %d)", slots, rows, *fallback, exc_info=True,
+            )
+            out = self._dispatch_group_at(parts, sizes, *fallback)
+            self._count_degraded()
+            slots, rows = fallback
+        handle = _GroupHandle(out=out, sizes=sizes, rows=rows)
+        handle.start_copy()
+        return handle
+
+    def _dispatch_group_at(
+        self,
+        parts: list[tuple[np.ndarray, np.ndarray]],
+        sizes: list[int],
+        slots: int,
+        rows: int,
+    ):
+        """Scatter the pre-encoded parts into one [slots, rows, ...] stack
+        and fire the fused grouped dispatch — shared by the target-shape
+        and degraded-shape paths (one scatter rule = identical masking)."""
         cat = np.zeros((slots, rows, SCHEMA.num_categorical), np.int32)
         num = np.zeros((slots, rows, SCHEMA.num_numeric), np.float32)
         mask = np.zeros((slots, rows), bool)
@@ -728,11 +840,24 @@ class InferenceEngine:
             cat[i, :n] = part_cat
             num[i, :n] = part_num
             mask[i, :n] = True
+        return self._dispatch_fused(("group", slots, rows), cat, num, mask)
 
-        out = self._dispatch_fused(("group", slots, rows), cat, num, mask)
-        handle = _GroupHandle(out=out, sizes=sizes, rows=rows)
-        handle.start_copy()
-        return handle
+    def _degraded_group_shape(
+        self, n_parts: int, max_rows: int, failed: tuple[int, int]
+    ) -> tuple[int, int] | None:
+        """Smallest-area WARMED group geometry that fits ``n_parts``
+        requests of up to ``max_rows`` rows, excluding the shape that just
+        failed; None when nothing warmed fits."""
+        with self._compile_lock:
+            fits = [
+                (key[1], key[2])
+                for key in self._exec
+                if key[0] == "group"
+                and key[1] >= n_parts
+                and key[2] >= max_rows
+                and (key[1], key[2]) != failed
+            ]
+        return min(fits, key=lambda sr: sr[0] * sr[1], default=None)
 
     def fetch_group(self, handle: _GroupHandle) -> list[dict[str, Any]]:
         """Block on the packed group buffer (ONE D2H transfer for the whole

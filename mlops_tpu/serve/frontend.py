@@ -51,11 +51,17 @@ from typing import Any
 
 import numpy as np
 
+from mlops_tpu import faults
 from mlops_tpu.config import Config, ServeConfig
-from mlops_tpu.serve.httpcore import HttpProtocol, _LazyJson
+from mlops_tpu.serve.httpcore import HttpProtocol, _LazyJson, deadline_response
 from mlops_tpu.serve.ipc import RequestRing, RingClient, RingService, ShmWorkerMetrics
 from mlops_tpu.serve.metrics import render_ring_metrics
-from mlops_tpu.serve.wire import empty_response, format_response
+from mlops_tpu.serve.wire import (
+    RESP_EXPIRED,
+    RESP_OK,
+    empty_response,
+    format_response,
+)
 
 logger = logging.getLogger("mlops_tpu.serve")
 
@@ -125,14 +131,28 @@ class FrontendServer(HttpProtocol):
             "text/plain; version=0.0.4",
         )
 
-    async def _score(self, record_dicts: list[dict], request_id: str):
+    async def _score(
+        self,
+        record_dicts: list[dict],
+        request_id: str,
+        deadline: float | None = None,
+    ):
         """The ring-backed scoring hook under the shared `_predict` shell
         (serve/httpcore.py): admission first, then encode, then the slot
-        round trip."""
+        round trip. The deadline budget (``x-request-deadline-ms``)
+        decrements across every stage: checked before the encode pool is
+        touched, stamped into the slot header so the ENGINE can complete
+        an expired descriptor without dispatching, and bounding the
+        completion wait — each stage answers the documented 504 rather
+        than doing work the client stopped waiting for."""
         if not record_dicts:
             return empty_response()
         from mlops_tpu.schema import records_to_columns
 
+        # Injection point (mlops_tpu/faults): kill = a front-end worker
+        # crash mid-request — the zygote-respawn + slot-quarantine path
+        # the chaos smoke drives.
+        faults.fire("serve.frontend.predict")
         n = len(record_dicts)
         # ADMISSION BEFORE ENCODE: a to-be-shed request must cost nothing
         # — the row count is known from the validated records, so the
@@ -159,6 +179,14 @@ class FrontendServer(HttpProtocol):
         submitted = False
         try:
             loop = asyncio.get_running_loop()
+            if deadline is not None and loop.time() >= deadline:
+                # Budget spent before the encode pool was touched (slot
+                # waits, slow header/body): release the claim unused and
+                # shed the dead work — the cheap 504.
+                self.client.release(slot)
+                slot = None
+                self.metrics.count_deadline_expired()
+                return deadline_response()
             # Encode BEFORE enqueue (the tentpole's division of labor):
             # the engine process receives ready-to-scatter arrays and
             # spends its cycles on device dispatch only. The native
@@ -170,12 +198,21 @@ class FrontendServer(HttpProtocol):
                     records_to_columns(record_dicts)
                 ),
             )
-            future = self.client.submit(slot, ds.cat_ids, ds.numeric)
+            # The slot header carries the absolute deadline (the loop
+            # clock IS time.monotonic, which the engine process shares):
+            # a descriptor that expires while queued in the ring comes
+            # back RESP_EXPIRED without ever dispatching.
+            future = self.client.submit(
+                slot, ds.cat_ids, ds.numeric, deadline=deadline
+            )
             submitted = True
-            timeout = self.config.request_timeout_s
+            timeout = self.config.request_timeout_s or None
+            if deadline is not None:
+                remaining = deadline - loop.time()
+                timeout = min(timeout or remaining, remaining)
             try:
-                if timeout:
-                    status = await asyncio.wait_for(future, timeout)
+                if timeout is not None:
+                    status = await asyncio.wait_for(future, max(timeout, 0.0))
                 else:
                     status = await future
             except asyncio.TimeoutError:
@@ -187,15 +224,16 @@ class FrontendServer(HttpProtocol):
                 )
                 self.client.abandon(slot)
                 slot = None
-                return (
-                    503,
-                    {
-                        "detail": f"prediction exceeded the "
-                        f"{timeout:g}s deadline"
-                    },
-                    "application/json",
+                return deadline_response(
+                    f"prediction exceeded the {timeout:g}s deadline"
                 )
-            if status != 0:
+            if status == RESP_EXPIRED:
+                # The engine shed the dead work (already counted engine-
+                # side); the completion is the proof the slab is quiescent.
+                self.client.release(slot)
+                slot = None
+                return deadline_response()
+            if status != RESP_OK:
                 # The engine process logged the traceback; the wire
                 # contract matches the single-process 500.
                 self.client.release(slot)
@@ -313,9 +351,9 @@ async def _run_frontend(
     watchdog = asyncio.create_task(_watch_plane())
     await draining.wait()
     # Busy exchanges get a bounded window to finish their responses and
-    # in-flight ring slots to land (the kubelet's grace period is the
-    # hard stop).
-    deadline = loop.time() + 30.0
+    # in-flight ring slots to land (serve.drain_deadline_s; the kubelet's
+    # grace period is the hard stop).
+    deadline = loop.time() + config.drain_deadline_s
     while (server._busy or server.client.pending_count()) and (
         loop.time() < deadline
     ):
@@ -391,8 +429,9 @@ def _zygote_main(
                 os.kill(proc.pid, signal.SIGTERM)
     # One shared wall-clock budget for ALL joins (the children drain
     # concurrently — per-child timeouts would compound when several are
-    # stuck), then SIGKILL the stragglers: they already ignored SIGTERM.
-    deadline = time.monotonic() + 35
+    # stuck; serve.zygote_join_deadline_s), then SIGKILL the stragglers:
+    # they already ignored SIGTERM.
+    deadline = time.monotonic() + config.zygote_join_deadline_s
     for proc in procs:
         proc.join(timeout=max(0.0, deadline - time.monotonic()))
     for proc in procs:
@@ -562,11 +601,13 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             with contextlib.suppress(ProcessLookupError):
                 os.kill(zygote.pid, signal.SIGTERM)
         # The zygote forwards SIGTERM, joins every front end against one
-        # shared 35 s deadline (+5 s kill grace), then exits — give it
-        # that window plus slack. A zygote still alive after that already
-        # ignored one SIGTERM (its handler only sets a flag the join
-        # loops don't consult), so escalate straight to SIGKILL.
-        zygote.join(timeout=50)
+        # shared serve.zygote_join_deadline_s budget (+5 s kill grace),
+        # then exits — give it that window plus slack
+        # (serve.engine_zygote_join_s; validate() pins the ordering). A
+        # zygote still alive after that already ignored one SIGTERM (its
+        # handler only sets a flag the join loops don't consult), so
+        # escalate straight to SIGKILL.
+        zygote.join(timeout=serve_cfg.engine_zygote_join_s)
         if zygote.is_alive():  # pragma: no cover - stuck zygote
             zygote.kill()
             zygote.join(timeout=5)

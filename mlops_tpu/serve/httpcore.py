@@ -51,13 +51,21 @@ class _LazyJson:
 _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
             409: "Conflict", 413: "Payload Too Large",
             422: "Unprocessable Entity", 500: "Internal Server Error",
-            503: "Service Unavailable"}
+            503: "Service Unavailable", 504: "Gateway Timeout"}
 # (status, content_type) -> precomputed immutable head prefix. Statuses and
 # content types form a tiny closed set, so the f-string formatting + encode
 # of the static head runs once per pair instead of once per response.
 _HEAD_PREFIXES: dict[tuple[int, str], bytes] = {}
 _KEEP_ALIVE_TAIL = b"connection: keep-alive\r\n\r\n"
 _CLOSE_TAIL = b"connection: close\r\n\r\n"
+
+
+def deadline_response(detail: str = "request deadline exceeded") -> tuple:
+    """THE deadline-exceeded wire shape, shared by every plane: a
+    documented ``504`` (distinct from the shed path's 503+Retry-After —
+    a 504'd request may or may not have been scored; a shed 503 never
+    was, and only the 503 invites a retry)."""
+    return 504, {"detail": detail}, "application/json"
 
 
 def _head_prefix(status: int, content_type: str) -> bytes:
@@ -122,15 +130,28 @@ class HttpProtocol:
         self._busy: set[asyncio.StreamWriter] = set()
 
     # ------------------------------------------------------ subclass hooks
-    async def _predict(self, body: bytes, request_id: str | None = None):
+    async def _predict(
+        self,
+        body: bytes,
+        request_id: str | None = None,
+        deadline: float | None = None,
+    ):
         """The reference's `predict()` endpoint (`app/main.py:42-86`):
         validate -> log InferenceData -> score -> log ModelOutput ->
-        respond. The SHELL — validation, the 422/413 contracts, and the
-        two-event structured logging — is shared verbatim by every plane;
-        subclasses provide only `_score` (engine call or ring round
-        trip), which returns the response dict, or a pre-built
+        respond. The SHELL — validation, the 422/413/504 contracts, and
+        the two-event structured logging — is shared verbatim by every
+        plane; subclasses provide only `_score` (engine call or ring
+        round trip), which returns the response dict, or a pre-built
         (status, payload, content_type[, headers]) tuple for its error
-        paths (deadline 503, shed 503, failure 500)."""
+        paths (deadline 504, shed 503, failure 500).
+
+        ``deadline`` is the request's absolute deadline on the event
+        loop's clock (parsed from ``x-request-deadline-ms`` at admission
+        — `_request_deadline`), decremented implicitly as the request
+        moves through validation -> encode -> ring wait -> dispatch:
+        every stage that is about to start expensive work checks the
+        REMAINING budget and answers the documented ``504`` instead of
+        doing dead work the client will never read."""
         try:
             records = self._applicant_list.validate_json(body)
         except pydantic.ValidationError as err:
@@ -148,6 +169,12 @@ class HttpProtocol:
                 },
                 "application/json",
             )
+        if deadline is not None and asyncio.get_running_loop().time() >= deadline:
+            # Already expired at admission (a slow body read, a queued
+            # accept): no encode, no slot, no dispatch — the cheapest
+            # possible dead-work shed.
+            self._count_deadline_expired()
+            return deadline_response()
         request_id = request_id or uuid.uuid4().hex
         record_dicts = [r.model_dump() for r in records]
         # Two layers keep log formatting off the hot path: isEnabledFor
@@ -166,7 +193,7 @@ class HttpProtocol:
                     }
                 ),
             )
-        response = await self._score(record_dicts, request_id)
+        response = await self._score(record_dicts, request_id, deadline)
         if isinstance(response, tuple):
             return response  # subclass error path, already wire-shaped
         if logger.isEnabledFor(logging.INFO):
@@ -183,8 +210,20 @@ class HttpProtocol:
             )
         return 200, response, "application/json"
 
-    async def _score(self, record_dicts: list[dict], request_id: str):
+    async def _score(
+        self,
+        record_dicts: list[dict],
+        request_id: str,
+        deadline: float | None = None,
+    ):
         raise NotImplementedError
+
+    def _count_deadline_expired(self) -> None:
+        """Record one dead-work shed (a request answered 504 WITHOUT its
+        work running) on whatever metrics sink the subclass installed."""
+        count = getattr(self.metrics, "count_deadline_expired", None)
+        if count is not None:
+            count()
 
     def _ready(self) -> bool:
         raise NotImplementedError
@@ -254,6 +293,11 @@ class HttpProtocol:
                         keep_alive=False,
                     )
                     break
+                # Deadline budget admission: stamped when the HEAD is in
+                # hand — a slow (or slowloris) body spends the client's
+                # budget, so the expiry check after the body read sheds
+                # it without any downstream work.
+                deadline = self._request_deadline(headers)
                 body = b""
                 # RFC 9110: Content-Length is 1*DIGIT. Bare int() also
                 # accepts '+5', '-1', '1_0', and unicode digits — parser
@@ -294,7 +338,7 @@ class HttpProtocol:
                     # optional 4th element of extra header lines (the shed
                     # path's Retry-After).
                     result = await self._route(
-                        method, route_path, body, request_id
+                        method, route_path, body, request_id, deadline
                     )
                     status, payload, content_type = result[:3]
                     extra_headers = result[3] if len(result) > 3 else None
@@ -324,6 +368,20 @@ class HttpProtocol:
                 pass
 
     _REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+    def _request_deadline(self, headers: dict) -> float | None:
+        """Absolute event-loop deadline from a well-formed
+        ``x-request-deadline-ms`` header (positive ASCII digits), or None.
+        Malformed values are IGNORED, not 400'd — the header is an
+        optional optimization hint (dead-work shedding), and a client
+        bug in a hint must not turn scored traffic into errors. The
+        loop clock is ``time.monotonic`` on every supported platform, so
+        the multi-worker plane's engine process can compare the same
+        value (serve/ipc.py slot deadlines)."""
+        raw = headers.get("x-request-deadline-ms", "")
+        if raw and raw.isascii() and raw.isdigit() and int(raw) > 0:
+            return asyncio.get_running_loop().time() + int(raw) / 1e3
+        return None
 
     def _request_id(self, headers: dict) -> str:
         """Honor a well-formed inbound ``x-request-id`` (so the caller's
@@ -370,10 +428,15 @@ class HttpProtocol:
 
     # -------------------------------------------------------------- routing
     async def _route(
-        self, method: str, path: str, body: bytes, request_id: str | None = None
+        self,
+        method: str,
+        path: str,
+        body: bytes,
+        request_id: str | None = None,
+        deadline: float | None = None,
     ):
         if path == "/predict" and method == "POST":
-            return await self._predict(body, request_id)
+            return await self._predict(body, request_id, deadline)
         if path.startswith("/debug/profile/") and method == "POST":
             return self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":

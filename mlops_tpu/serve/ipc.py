@@ -66,6 +66,8 @@ import numpy as np
 from mlops_tpu.schema import SCHEMA
 from mlops_tpu.serve.metrics import (
     LIFE_AUC_DELTA,
+    LIFE_BREAKER_OPEN,
+    LIFE_BREAKER_TRIPS,
     LIFE_GENERATION,
     LIFE_HAS,
     LIFE_HAS_DELTA,
@@ -79,9 +81,17 @@ from mlops_tpu.serve.metrics import (
     MON_OUTLIERS,
     MON_ROWS,
     RING_STATUSES,
+    ROB_DEGRADED,
+    ROB_EXPIRED_ENGINE,
     ServingMetrics,
 )
-from mlops_tpu.serve.wire import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+from mlops_tpu.serve.wire import (
+    GROUP_ROW_BUCKET,
+    GROUP_SLOT_BUCKETS,
+    RESP_ERROR,
+    RESP_EXPIRED,
+    RESP_OK,
+)
 
 logger = logging.getLogger("mlops_tpu.serve")
 
@@ -268,6 +278,12 @@ class RequestRing:
             ("slot_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("slot_n", np.dtype(np.uint32), (self.n_slots,)),
             ("slot_busy", np.dtype(np.uint32), (self.n_slots,)),
+            # Absolute request deadline (time.monotonic seconds — the same
+            # CLOCK_MONOTONIC the front ends' event loops read, so values
+            # compare across processes on one host; 0 = no deadline). The
+            # engine checks it BEFORE dispatching and completes expired
+            # descriptors RESP_EXPIRED without touching the device.
+            ("slot_deadline", np.dtype(np.float64), (self.n_slots,)),
             ("resp_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("resp_status", np.dtype(np.uint32), (self.n_slots,)),
             # request slabs (front end writes, engine reads)
@@ -294,6 +310,16 @@ class RequestRing:
             ("lat_n", np.dtype(np.uint64), (workers,)),
             ("shed", np.dtype(np.uint64), (workers, 2)),
             ("inflight", np.dtype(np.uint64), (workers, 2)),
+            # dead-work sheds counted FRONT-END side (admission/budget
+            # checks answering 504 before a slot submits) — single writer
+            # per worker, like the shed counters
+            ("expired", np.dtype(np.uint64), (workers,)),
+            # robustness counters with ENGINE-PROCESS writers (pool
+            # threads under RingService._mon_lock): ROB_EXPIRED_ENGINE =
+            # descriptors completed RESP_EXPIRED without a dispatch,
+            # ROB_DEGRADED = the engine's degraded-dispatch total
+            # (mirrored by the telemetry loop)
+            ("rob_vals", np.dtype(np.float64), (2,)),
             # monitor aggregate (single writer: the engine process)
             ("mon_vals", np.dtype(np.float64), (8,)),
             ("mon_drift_last", np.dtype(np.float64), (D,)),
@@ -480,6 +506,12 @@ class RequestRing:
         self.life_vals[LIFE_RESERVOIR] = float(
             snapshot.get("reservoir_rows") or 0
         )
+        self.life_vals[LIFE_BREAKER_OPEN] = (
+            1.0 if snapshot.get("breaker_open") else 0.0
+        )
+        self.life_vals[LIFE_BREAKER_TRIPS] = float(
+            snapshot.get("breaker_trips", 0)
+        )
         promotions = snapshot.get("promotions", {})
         for i, outcome in enumerate(LIFE_OUTCOMES):
             self.life_promos[i] = float(promotions.get(outcome, 0))
@@ -515,6 +547,11 @@ class ShmWorkerMetrics:
             if latency_ms <= edge:
                 ring.lat_counts[w, i] += 1
                 break
+
+    def count_deadline_expired(self) -> None:
+        """Front-end-side dead-work shed (admission/budget 504 before any
+        slot submitted) — single-writer cell, same discipline as shed."""
+        self._ring.expired[self._worker] += 1
 
 
 class RingClient:
@@ -596,10 +633,19 @@ class RingClient:
         cls = SMALL if n_rows <= self.ring.small_rows else LARGE
         self.ring.shed[self.worker, cls] += 1
 
-    def submit(self, slot: int, cat: np.ndarray, num: np.ndarray):
+    def submit(
+        self,
+        slot: int,
+        cat: np.ndarray,
+        num: np.ndarray,
+        deadline: float | None = None,
+    ):
         """Write the encoded arrays into the slot's slab and enqueue it.
         Returns the asyncio future the completion resolves (with the
-        engine's response status)."""
+        engine's response status). ``deadline`` — absolute
+        ``time.monotonic`` seconds (the event loop's clock) — rides in
+        the slot header so the engine can complete an already-expired
+        descriptor as RESP_EXPIRED instead of dispatching dead work."""
         import asyncio
 
         n = cat.shape[0]
@@ -608,6 +654,7 @@ class RingClient:
         slab_cat[:n] = cat
         slab_num[:n] = num
         ring.slot_n[slot] = n
+        ring.slot_deadline[slot] = deadline if deadline is not None else 0.0
         gen = (int(ring.slot_gen[slot]) + 1) & 0xFFFFFFFF
         ring.slot_gen[slot] = gen
         # Busy BEFORE the descriptor push: if this process dies anywhere
@@ -784,6 +831,7 @@ class RingService:
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("final monitor snapshot failed on drain")
         self._write_lifecycle()
+        self._write_robustness()
 
     # ------------------------------------------------------------ collect
     def _collect(self) -> None:
@@ -819,17 +867,38 @@ class RingService:
     def _run_job(self, job: list[tuple[int, int]]) -> None:
         ring = self.ring
         try:
-            try:
-                raws = self._score(job)
-                status = 0
-            # The breadth is the contract: ANY scoring failure (device
-            # error, geometry bug) must become a status-1 completion on
-            # every waiting slot — a dropped descriptor would strand the
-            # front end's future until its deadline.
-            except Exception:  # tpulint: disable=TPU201
-                logger.exception("ring dispatch failed (%d slots)", len(job))
-                raws, status = None, 1
-            for i, (slot, gen) in enumerate(job):
+            # Dead-work shedding (ISSUE 9): a descriptor whose deadline
+            # budget (slot header, stamped by the front end at submit)
+            # ran out while it queued is completed RESP_EXPIRED WITHOUT
+            # dispatching — under overload the device's cycles go to
+            # requests whose clients are still listening. The engine
+            # still answers every accepted descriptor, expired included.
+            now = time.monotonic()
+            live: list[tuple[int, int]] = []
+            expired: list[tuple[int, int]] = []
+            for slot, gen in job:
+                slot_deadline = float(ring.slot_deadline[slot])
+                if slot_deadline and now >= slot_deadline:
+                    expired.append((slot, gen))
+                else:
+                    live.append((slot, gen))
+            if expired:
+                with self._mon_lock:
+                    ring.rob_vals[ROB_EXPIRED_ENGINE] += len(expired)
+            raws, status = None, RESP_OK
+            if live:
+                try:
+                    raws = self._score(live)
+                # The breadth is the contract: ANY scoring failure (device
+                # error, geometry bug) must become an error completion on
+                # every waiting slot — a dropped descriptor would strand
+                # the front end's future until its deadline.
+                except Exception:  # tpulint: disable=TPU201
+                    logger.exception(
+                        "ring dispatch failed (%d slots)", len(live)
+                    )
+                    raws, status = None, RESP_ERROR
+            for i, (slot, gen) in enumerate(live):
                 # Stale-generation write guard: if the slot has moved on
                 # (its front end crashed and the respawned incarnation
                 # bumped the generation), REFUSE to touch the slab — with
@@ -838,13 +907,16 @@ class RingService:
                 # writes correct even if a future client mismanages the
                 # free list. The completion still goes out: it is what
                 # releases the quarantined slot.
-                if status == 0 and int(ring.slot_gen[slot]) == gen:
+                if status == RESP_OK and int(ring.slot_gen[slot]) == gen:
                     pred, out, drift = raws[i]
                     resp_pred, resp_out, resp_drift = ring.response_views(slot)
                     resp_pred[:] = pred
                     resp_out[:] = out
                     resp_drift[:] = drift
                 ring.resp_status[slot] = status
+                ring.resp_gen[slot] = gen
+            for slot, gen in expired:
+                ring.resp_status[slot] = RESP_EXPIRED
                 ring.resp_gen[slot] = gen
             # The doorbell count IS the owner's consumption credit: ring
             # AFTER the pushes with how many landed, per owner.
@@ -917,6 +989,7 @@ class RingService:
         last_fetch = time.monotonic()
         while not self._stop.wait(tick):
             self._write_lifecycle()
+            self._write_robustness()
             due_k = self._mon_every and (
                 self._requests_since_fetch >= self._mon_every
             )
@@ -936,6 +1009,14 @@ class RingService:
             # single-process fetch task's done-callback).
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("ring monitor fetch failed; gauges stale")
+
+    def _write_robustness(self) -> None:
+        """Mirror the engine's degraded-dispatch total into shm (a host
+        int read + one f64 store, no device work) so every front end's
+        /metrics renders it."""
+        degraded = getattr(self.engine, "degraded_dispatch_total", 0)
+        with self._mon_lock:
+            self.ring.rob_vals[ROB_DEGRADED] = float(degraded)
 
     def _write_lifecycle(self) -> None:
         """Mirror the attached controller's gauge snapshot into shm (a

@@ -15,8 +15,10 @@ from collections import defaultdict
 # ---- multi-worker exposition constants (shared with serve/ipc.py) ----
 # Closed status set for the per-worker shared-memory request matrices
 # (the protocol layer's reason set); anything else lands in the
-# catch-all column rendered as status="other".
-RING_STATUSES = (200, 400, 404, 409, 413, 422, 500, 503)
+# catch-all column rendered as status="other". 504 is the deadline
+# contract (distinct from shed's 503+Retry-After — docs/operations.md
+# "Failure domains & degraded modes").
+RING_STATUSES = (200, 400, 404, 409, 413, 422, 500, 503, 504)
 RING_CLASSES = ("small", "large")  # slot classes (ring depth/shed labels)
 # Field indices of the ring's monitor-aggregate block (engine-process
 # single writer; see RequestRing.write_monitor).
@@ -33,7 +35,14 @@ MON_ROWS, MON_OUTLIERS, MON_BATCHES, MON_FETCHES, MON_FETCHED_AT, MON_HAS = (
     LIFE_HAS_DELTA,
     LIFE_RESERVOIR,
     LIFE_HAS,
-) = range(6)
+    LIFE_BREAKER_OPEN,
+    LIFE_BREAKER_TRIPS,
+) = range(8)
+# Field indices of the ring's robustness block (engine-process writers
+# under RingService._mon_lock; see RequestRing rob_vals): engine-side
+# deadline expiries (descriptors completed RESP_EXPIRED without a
+# dispatch) and degraded-shape dispatches.
+ROB_EXPIRED_ENGINE, ROB_DEGRADED = range(2)
 # Promotion outcomes, in their ring-array order (write_lifecycle /
 # render_ring_metrics and the single-process render share this tuple so
 # the label sets can never diverge between telemetry planes).
@@ -57,6 +66,12 @@ class ServingMetrics:
         self.monitor_batches = 0
         self.monitor_fetches = 0
         self.monitor_fetched_at: float | None = None  # time.monotonic()
+        # Robustness counters (ISSUE 9): dead-work sheds (requests
+        # answered 504 WITHOUT their work running — the admission check
+        # and the batcher's claim-time purge) and degraded-shape
+        # dispatches (mirrored from the engine's counter per scrape).
+        self.deadline_expired = 0
+        self.degraded_dispatches = 0
         # Lifecycle gauges (mlops_tpu/lifecycle/): None until a controller
         # installs a snapshot — the series are only exported when the
         # loop is actually running, so a loop-less deployment's scrape is
@@ -117,6 +132,31 @@ class ServingMetrics:
         with self._lock:
             self.lifecycle = dict(snapshot)
 
+    def count_deadline_expired(self) -> None:
+        """One dead-work shed: a request answered the documented 504
+        WITHOUT its work dispatching (admission check, batcher purge)."""
+        with self._lock:
+            self.deadline_expired += 1
+
+    def set_degraded(self, total: int) -> None:
+        """Mirror the engine's degraded-dispatch counter (an absolute
+        total — `InferenceEngine.degraded_dispatch_total`)."""
+        with self._lock:
+            self.degraded_dispatches = int(total)
+
+    @staticmethod
+    def robustness_lines(deadline_expired: int, degraded: int) -> list[str]:
+        """The robustness counter block — ONE definition shared by the
+        single-process render and the ring render, so both telemetry
+        planes export identical series names. Always emitted (a zero
+        baseline is what makes chaos-smoke monotonicity checkable)."""
+        return [
+            "# TYPE mlops_tpu_deadline_expired_total counter",
+            f"mlops_tpu_deadline_expired_total {int(deadline_expired)}",
+            "# TYPE mlops_tpu_degraded_dispatch_total counter",
+            f"mlops_tpu_degraded_dispatch_total {int(degraded)}",
+        ]
+
     @staticmethod
     def lifecycle_lines(snapshot: dict | None) -> list[str]:
         """The lifecycle gauge block — ONE definition shared by the
@@ -145,6 +185,22 @@ class ServingMetrics:
         if rows is not None:
             lines.append("# TYPE mlops_tpu_lifecycle_reservoir_rows gauge")
             lines.append(f"mlops_tpu_lifecycle_reservoir_rows {int(rows)}")
+        if "breaker_open" in snapshot:
+            # Circuit breaker (lifecycle/controller.py): open = repeated
+            # retrain/shadow failures tripped the loop into a cooldown
+            # instead of hot-looping; trips count the openings.
+            lines.append("# TYPE mlops_tpu_lifecycle_breaker_open gauge")
+            lines.append(
+                "mlops_tpu_lifecycle_breaker_open "
+                f"{1 if snapshot['breaker_open'] else 0}"
+            )
+            lines.append(
+                "# TYPE mlops_tpu_lifecycle_breaker_trips_total counter"
+            )
+            lines.append(
+                "mlops_tpu_lifecycle_breaker_trips_total "
+                f"{int(snapshot.get('breaker_trips', 0))}"
+            )
         return lines
 
     def render(self) -> str:
@@ -199,6 +255,11 @@ class ServingMetrics:
                 lines.append(
                     f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
                 )
+            lines.extend(
+                self.robustness_lines(
+                    self.deadline_expired, self.degraded_dispatches
+                )
+            )
             lines.extend(self.lifecycle_lines(self.lifecycle))
             return "\n".join(lines) + "\n"
 
@@ -306,6 +367,15 @@ def render_ring_metrics(ring) -> str:
         age = time.monotonic() - float(ring.mon_vals[MON_FETCHED_AT])
         lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
         lines.append(f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}")
+    # Robustness counters, same series names as the single-process plane:
+    # front-end dead-work sheds (per-worker single-writer cells) plus the
+    # engine-side expired completions and degraded dispatches.
+    lines.extend(
+        ServingMetrics.robustness_lines(
+            int(ring.expired.sum()) + int(ring.rob_vals[ROB_EXPIRED_ENGINE]),
+            int(ring.rob_vals[ROB_DEGRADED]),
+        )
+    )
     if ring.life_vals[LIFE_HAS]:
         # Lifecycle block, rebuilt as a snapshot dict so the SAME
         # formatter emits it (identical series names across planes; any
@@ -325,6 +395,8 @@ def render_ring_metrics(ring) -> str:
                         for i, outcome in enumerate(LIFE_OUTCOMES)
                     },
                     "reservoir_rows": int(ring.life_vals[LIFE_RESERVOIR]),
+                    "breaker_open": bool(ring.life_vals[LIFE_BREAKER_OPEN]),
+                    "breaker_trips": int(ring.life_vals[LIFE_BREAKER_TRIPS]),
                 }
             )
         )

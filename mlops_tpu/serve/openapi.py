@@ -45,6 +45,42 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                 "post": {
                     "summary": "Score loan applicants",
                     "operationId": "predict",
+                    "parameters": [
+                        {
+                            "name": "x-request-deadline-ms",
+                            "in": "header",
+                            "required": False,
+                            "schema": {"type": "integer", "minimum": 1},
+                            "description": (
+                                "Optional per-request deadline budget in "
+                                "milliseconds, measured from request "
+                                "admission. The budget decrements across "
+                                "admission -> encode -> queue wait -> "
+                                "dispatch; any stage finding it spent "
+                                "answers 504 WITHOUT doing the remaining "
+                                "work (dead-work shedding), and it "
+                                "tightens serve.request_timeout_s for "
+                                "this request. Malformed values are "
+                                "ignored (the header is a hint, not a "
+                                "contract)."
+                            ),
+                        },
+                        {
+                            "name": "x-request-id",
+                            "in": "header",
+                            "required": False,
+                            "schema": {
+                                "type": "string",
+                                "pattern": "^[A-Za-z0-9_-]{1,64}$",
+                            },
+                            "description": (
+                                "Caller trace id, echoed on the response "
+                                "and in both structured log events; "
+                                "minted server-side when absent or "
+                                "malformed."
+                            ),
+                        },
+                    ],
                     "requestBody": {
                         "required": True,
                         "content": {
@@ -62,24 +98,40 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                         "413": {"description": "Batch exceeds the serving cap"},
                         "503": {
                             "description": (
-                                "Load shed or deadline. Overload: the "
-                                "admission queue for the request's bucket "
-                                "class is full; the response carries a "
-                                "Retry-After header (seconds) and the "
-                                "request was NOT scored — retry after the "
-                                "advertised delay. Deadline: the predict "
-                                "exceeded serve.request_timeout_s "
-                                "(no Retry-After header)."
+                                "Load shed (overload): the admission "
+                                "queue for the request's bucket class is "
+                                "full; the response carries a Retry-After "
+                                "header (seconds) and the request was NOT "
+                                "scored — retry after the advertised "
+                                "delay. Deadline exhaustion is a 504, "
+                                "never a 503."
                             ),
                             "headers": {
                                 "Retry-After": {
                                     "description": (
                                         "Seconds to wait before retrying "
-                                        "(present only on overload sheds)"
+                                        "(always present on sheds)"
                                     ),
                                     "schema": {"type": "integer"},
                                 }
                             },
+                        },
+                        "504": {
+                            "description": (
+                                "Deadline exceeded: the request's "
+                                "x-request-deadline-ms budget (or "
+                                "serve.request_timeout_s) ran out. "
+                                "Distinct from the shed 503: a 504'd "
+                                "request MAY have been partially or "
+                                "fully scored (the response was simply "
+                                "late), so blind retries are not "
+                                "idempotency-safe for side-effectful "
+                                "callers; no Retry-After is advertised. "
+                                "Requests whose budget expired before "
+                                "dispatch are shed without device work "
+                                "and counted in "
+                                "mlops_tpu_deadline_expired_total."
+                            )
                         },
                     },
                 }

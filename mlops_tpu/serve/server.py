@@ -29,8 +29,10 @@ from mlops_tpu.serve.httpcore import (  # noqa: F401  (re-exports)
     _DOCS_HTML,
     _LazyJson,
     _dumps,
+    deadline_response,
 )
 from mlops_tpu.serve.metrics import ServingMetrics
+from mlops_tpu.serve.wire import DeadlineExceeded
 
 logger = logging.getLogger("mlops_tpu.serve")
 
@@ -152,6 +154,12 @@ class HttpServer(HttpProtocol):
             # work): scrapes always render the loop's current state.
             with contextlib.suppress(Exception):
                 self.metrics.set_lifecycle(self.lifecycle.metrics_snapshot())
+        # Robustness counters (host-side reads, no device work): degraded
+        # dispatches live on the engine (`_dispatch_padded`), deadline
+        # sheds accumulate in the metrics object itself.
+        self.metrics.set_degraded(
+            getattr(self.engine, "degraded_dispatch_total", 0)
+        )
         return 200, self.metrics.render(), "text/plain; version=0.0.4"
 
     def _profile(self, action: str):
@@ -183,7 +191,12 @@ class HttpServer(HttpProtocol):
             return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
         return 404, {"detail": "not found"}, "application/json"
 
-    async def _score(self, record_dicts: list[dict], request_id: str):
+    async def _score(
+        self,
+        record_dicts: list[dict],
+        request_id: str,
+        deadline: float | None = None,
+    ):
         """The single-process scoring hook under the shared `_predict`
         shell (serve/httpcore.py): micro-batcher -> engine, with the
         deadline and failure contracts."""
@@ -193,28 +206,35 @@ class HttpServer(HttpProtocol):
             # The deadline exists for a STALLED DEVICE (observed live: a
             # remote-attached chip's tunnel hanging dispatches 40+ min):
             # without it every in-flight request wedges until the client
-            # gives up, while liveness stays green.
-            call = self.batcher.predict(record_dicts)
-            if self.config.request_timeout_s:
-                response = await asyncio.wait_for(
-                    call, self.config.request_timeout_s
-                )
+            # gives up, while liveness stays green. A client deadline
+            # budget (x-request-deadline-ms) tightens the server-wide
+            # timeout per request AND rides into the batcher so an
+            # already-expired entry is purged engine-side instead of
+            # dispatched (dead-work shedding under overload).
+            timeout = self.config.request_timeout_s or None
+            if deadline is not None:
+                remaining = deadline - asyncio.get_running_loop().time()
+                timeout = min(timeout or remaining, remaining)
+            call = self.batcher.predict(record_dicts, deadline=deadline)
+            if timeout is not None:
+                response = await asyncio.wait_for(call, max(timeout, 0.0))
             else:
                 response = await call
+        except DeadlineExceeded:
+            # Engine-side shed: the batcher's claim-time purge found the
+            # budget already spent and never dispatched — count the dead
+            # work it avoided; the wire answer is the same documented 504.
+            self.metrics.count_deadline_expired()
+            return deadline_response()
         except asyncio.TimeoutError:
             logger.error(
                 "prediction deadline (%.1fs) exceeded request_id=%s — "
                 "device stall?",
-                self.config.request_timeout_s,
+                timeout,
                 request_id,
             )
-            return (
-                503,
-                {
-                    "detail": f"prediction exceeded the "
-                    f"{self.config.request_timeout_s:g}s deadline"
-                },
-                "application/json",
+            return deadline_response(
+                f"prediction exceeded the {timeout:g}s deadline"
             )
         # Top-of-handler boundary: ANY prediction failure (device error
         # included) must become a logged 500, not a dropped connection —
@@ -399,9 +419,10 @@ async def _serve(
             # re-advertised readiness; a draining pod is never ready.
             engine.ready = False
             # Busy exchanges get a bounded window to write their
-            # responses (the kubelet's terminationGracePeriodSeconds is
-            # the hard stop); whatever remains is then force-closed.
-            deadline = loop.time() + 30.0
+            # responses (serve.drain_deadline_s; the kubelet's
+            # terminationGracePeriodSeconds is the hard stop); whatever
+            # remains is then force-closed.
+            deadline = loop.time() + config.drain_deadline_s
             while server._busy and loop.time() < deadline:
                 await asyncio.sleep(0.05)
             for w in list(server._connections):

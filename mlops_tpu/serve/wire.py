@@ -34,6 +34,23 @@ GROUP_SLOT_BUCKETS = (2, 4, 8, 16, 32, 64)
 GROUP_ROW_BUCKETS = (1, 8)
 GROUP_ROW_BUCKET = GROUP_ROW_BUCKETS[-1]
 
+# Ring completion statuses (serve/ipc.py resp_status): the engine answers
+# every accepted descriptor with exactly one of these. EXPIRED is the
+# dead-work-shedding path — a descriptor whose deadline budget ran out
+# before dispatch is completed WITHOUT touching the device, and the front
+# end answers 504 (docs/operations.md "Failure domains & degraded modes").
+RESP_OK, RESP_ERROR, RESP_EXPIRED = 0, 1, 2
+
+
+class DeadlineExceeded(Exception):
+    """A request's deadline budget (``x-request-deadline-ms``, or
+    ``serve.request_timeout_s``) ran out before its work dispatched —
+    raised engine-side (the micro-batcher's claim-time purge) so the
+    handler answers the documented 504 without the device ever seeing
+    the dead request. Jax-free by design: both planes' HTTP layers and
+    the batcher share it without an engine import."""
+
+
 
 def format_response(
     predictions: np.ndarray, outliers: np.ndarray, drift: np.ndarray

@@ -6,6 +6,8 @@ import os
 import tempfile
 from pathlib import Path
 
+from mlops_tpu import faults
+
 
 def atomic_write(path: str | Path, data: bytes) -> None:
     """Write via temp file + rename so a crash never leaves a torn file, and
@@ -15,6 +17,11 @@ def atomic_write(path: str | Path, data: bytes) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(data)
+        # Injection point (mlops_tpu/faults): kill between write and
+        # rename — the torn-write proof for every atomic_write consumer
+        # (train checkpoints, registry records): the target path must
+        # never hold a partial payload.
+        faults.fire("io.atomic_write.midwrite")
         os.replace(tmp, path)
     except BaseException:
         try:

@@ -1,0 +1,512 @@
+"""CI chaos smoke: the live serve plane under seeded fault injection.
+
+The deployment-path proof for ISSUE 9 (faultline): train a tiny bundle,
+launch the REAL `mlops-tpu serve --workers 2` plane with a seeded fault
+plan armed through `MLOPS_TPU_FAULTS` (every process — engine, zygote,
+front ends — arms at import), and drive the failure scenarios end to
+end:
+
+1. engine stall  — a seeded delay fault on `serve.engine.dispatch*`:
+   requests carrying `x-request-deadline-ms` answer the documented 504
+   inside their budget; nothing hangs.
+2. slow client   — a byte-dribbling request must not wedge concurrent
+   traffic (and completes 200 itself).
+3. overload      — a connection burst against a deliberately tiny ring:
+   every response is in the contract set (sheds answer 503+Retry-After).
+4. worker kill   — SIGKILL a front end mid-traffic: the zygote respawns
+   it and the plane keeps serving (slot quarantine drains).
+5. mid-write kills (subprocesses) — SIGKILL between tmp-write and rename
+   on the compile-cache persist, the reservoir snapshot, and
+   `utils.io.atomic_write`: no torn file ever lands.
+6. cache corruption — seeded bit flips at `compilecache.read`: counted
+   discard + recompile, correct outputs, self-healed store.
+
+Global assertions: every /predict status is in {200, 413, 422, 503, 504},
+at least one 504 was produced by the stall scenario, no request hangs
+(every client call is deadline-bounded), /metrics counters are MONOTONE
+across scrapes, and SIGTERM drains the plane cleanly (exit 0, no leaked
+tasks) under the chaos-tuned drain knobs.
+
+Run from the repo root: `python scripts/chaos_smoke.py` (CI pins
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RECORD = {"credit_limit": 12000, "age": 34}
+ALLOWED_STATUSES = {200, 413, 422, 503, 504}
+
+CHAOS_PLAN = """\
+seed = 42
+
+# Engine stall: seeded delays on the engine dispatch points. Probability
+# is per-hit Bernoulli on a deterministic hash, so a fixed request count
+# replays a fixed stall schedule.
+[[fault]]
+point = "serve.engine.dispatch*"
+mode = "delay"
+delay_s = 1.2
+probability = 0.15
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def get(url: str, timeout: float = 15.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def raw_predict(port, body: bytes, headers=None, timeout=20.0):
+    """One blocking /predict exchange, deadline-bounded (a hang fails the
+    smoke via the socket timeout, never via CI's job timeout)."""
+    head = [
+        "POST /predict HTTP/1.1", "host: chaos",
+        "content-type: application/json",
+        f"content-length: {len(body)}",
+    ]
+    for name, value in (headers or {}).items():
+        head.append(f"{name}: {value}")
+    head.append("connection: close")
+    payload = ("\r\n".join(head) + "\r\n\r\n").encode() + body
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(payload)
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    head_bytes, _, body_bytes = data.partition(b"\r\n\r\n")
+    return int(head_bytes.split(b" ")[1]), head_bytes, body_bytes
+
+
+def parse_counters(text: str) -> dict[str, float]:
+    """Every `*_total` counter sample keyed by its full series name+labels
+    — the monotonicity contract is per series."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "_total" not in line:
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def run_subprocess_scenario(name: str, script: str, env=None, expect_kill=False):
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **(env or {})},
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"{name}: expected SIGKILL, got {proc.returncode}\n"
+            f"{proc.stdout[-1000:]}\n{proc.stderr[-1000:]}"
+        )
+    else:
+        assert proc.returncode == 0, (
+            f"{name}: exit {proc.returncode}\n"
+            f"{proc.stdout[-1000:]}\n{proc.stderr[-2000:]}"
+        )
+    print(f"# chaos-smoke: scenario OK — {name}", flush=True)
+    return proc
+
+
+# ------------------------------------------------- mid-write kill scripts
+_RESERVOIR_KILL = """
+import numpy as np
+from mlops_tpu import faults
+from mlops_tpu.lifecycle.retrain import SampleReservoir
+from mlops_tpu.schema import SCHEMA
+faults.arm(faults.FaultPlan.from_rules(
+    [{"point": "lifecycle.reservoir.midwrite", "mode": "kill"}]))
+res = SampleReservoir(16, {state!r})
+res.add_batch(np.ones((4, SCHEMA.num_categorical), np.int32),
+              np.ones((4, SCHEMA.num_numeric), np.float32))
+res.save()
+raise SystemExit("kill fault did not fire")
+"""
+
+_ATOMIC_KILL = """
+from mlops_tpu import faults
+from mlops_tpu.utils.io import atomic_write
+atomic_write({target!r}, b"GOOD" * 1024)
+faults.arm(faults.FaultPlan.from_rules(
+    [{"point": "io.atomic_write.midwrite", "mode": "kill"}]))
+atomic_write({target!r}, b"TORN" * 4096)
+raise SystemExit("kill fault did not fire")
+"""
+
+_CACHE_KILL = """
+import jax, jax.numpy as jnp
+from mlops_tpu import faults
+from mlops_tpu.compilecache.cache import (
+    CacheJob, CompileCache, serialization_available)
+if not serialization_available():
+    print("NO-SERIALIZATION"); raise SystemExit(0)
+faults.arm(faults.FaultPlan.from_rules(
+    [{"point": "compilecache.persist.midwrite", "mode": "kill"}]))
+CompileCache({cache!r}).load_or_compile(CacheJob(
+    entry_id="chaos", jitted=jax.jit(lambda x: x + 1.0),
+    abstract_args=(jax.ShapeDtypeStruct((4,), jnp.float32),)))
+raise SystemExit("kill fault did not fire")
+"""
+
+_CACHE_CORRUPT = """
+import numpy as np, jax, jax.numpy as jnp
+from mlops_tpu import faults
+from mlops_tpu.compilecache.cache import (
+    CacheJob, CompileCache, serialization_available)
+if not serialization_available():
+    print("NO-SERIALIZATION"); raise SystemExit(0)
+job = CacheJob(entry_id="chaos", jitted=jax.jit(lambda x: x * 3.0),
+               abstract_args=(jax.ShapeDtypeStruct((4,), jnp.float32),))
+CompileCache({cache!r}).load_or_compile(job)  # persist a good artifact
+faults.arm(faults.FaultPlan.from_rules(
+    [{"point": "compilecache.read", "mode": "corrupt", "flip_bits": 8}]))
+cache = CompileCache({cache!r})
+fn = cache.load_or_compile(job)  # corrupt read -> discard -> recompile
+faults.disarm()
+stats = cache.stats()
+assert stats["discards"] == 1 and stats["misses"] == 1, stats
+np.testing.assert_allclose(
+    np.asarray(fn(jnp.arange(4, dtype=jnp.float32))),
+    np.arange(4, dtype=np.float32) * 3.0)
+healed = CompileCache({cache!r})
+healed.load_or_compile(job)
+assert healed.stats()["hits"] == 1, healed.stats()  # store self-healed
+print("CORRUPTION-HANDLED")
+"""
+
+
+def midwrite_and_corruption_scenarios(tmp: str) -> None:
+    state = os.path.join(tmp, "reservoir-state")
+    run_subprocess_scenario(
+        "reservoir mid-write kill",
+        _RESERVOIR_KILL.replace("{state!r}", repr(state)),
+        expect_kill=True,
+    )
+    assert not os.path.exists(os.path.join(state, "reservoir.npz")), (
+        "torn reservoir snapshot landed at the target path"
+    )
+
+    target = os.path.join(tmp, "ckpt.bin")
+    run_subprocess_scenario(
+        "atomic_write mid-write kill",
+        _ATOMIC_KILL.replace("{target!r}", repr(target)),
+        expect_kill=True,
+    )
+    with open(target, "rb") as f:
+        assert f.read() == b"GOOD" * 1024, "torn atomic_write payload"
+
+    cache_dir = os.path.join(tmp, "chaos-cache")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _CACHE_KILL.replace("{cache!r}", repr(cache_dir))],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    if "NO-SERIALIZATION" in proc.stdout:
+        print("# chaos-smoke: cache scenarios skipped (no serialization)",
+              flush=True)
+        return
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stderr[-1000:]
+    )
+    leftovers = [
+        os.path.join(dirpath, f)
+        for dirpath, _, files in os.walk(cache_dir)
+        for f in files if f.endswith(".jaxexe")
+    ]
+    assert leftovers == [], f"torn cache artifact landed: {leftovers}"
+    print("# chaos-smoke: scenario OK — cache persist mid-write kill",
+          flush=True)
+
+    corrupt = run_subprocess_scenario(
+        "cache corruption on read",
+        _CACHE_CORRUPT.replace("{cache!r}", repr(cache_dir)),
+    )
+    assert "CORRUPTION-HANDLED" in corrupt.stdout
+
+
+# ------------------------------------------------------ live-plane chaos
+def live_plane_scenarios(tmp: str, bundle: str) -> None:
+    plan_path = os.path.join(tmp, "chaos.toml")
+    with open(plan_path, "w") as f:
+        f.write(CHAOS_PLAN)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["MLOPS_TPU_FAULTS"] = plan_path
+
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlops_tpu", "serve", "--workers", "2",
+            "serve.host=127.0.0.1", f"serve.port={port}",
+            f"serve.model_directory={bundle}",
+            "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+            # Tiny admission so the overload burst actually sheds, and the
+            # chaos-tuned drain knobs (the ex-hard-coded 30/35/50) so the
+            # drain assertion exercises their wiring.
+            "serve.ring_slots_small=4", "serve.ring_slots_large=1",
+            "serve.request_timeout_s=6",
+            "serve.drain_deadline_s=8", "serve.zygote_join_deadline_s=10",
+            "serve.engine_zygote_join_s=16",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    log_lines: list[str] = []
+    pump = threading.Thread(
+        target=lambda: log_lines.extend(iter(server.stdout.readline, "")),
+        daemon=True,
+    )
+    pump.start()
+    statuses: list[int] = []
+    statuses_lock = threading.Lock()
+    body = json.dumps([RECORD]).encode()
+
+    def record_status(status: int) -> None:
+        with statuses_lock:
+            statuses.append(status)
+
+    try:
+        print("# chaos-smoke: waiting for readiness (faults armed)",
+              flush=True)
+        deadline = time.time() + 600
+        ready = False
+        while time.time() < deadline and not ready:
+            if server.poll() is not None:
+                print("\n".join(log_lines[-60:]))
+                raise SystemExit("server died before readiness")
+            try:
+                status, _ = get(f"http://127.0.0.1:{port}/healthz/ready", 5)
+                ready = status == 200
+            except (urllib.error.URLError, OSError, urllib.error.HTTPError):
+                pass
+            if not ready:
+                time.sleep(1.0)
+        assert ready, "server never became ready under the armed plan"
+        assert any("fault injection ARMED" in line for line in log_lines), (
+            "the env plan did not arm in the serve processes"
+        )
+
+        # ---- scenario: engine stall -> deadline 504s, no hangs --------
+        def budgeted_client(n: int) -> None:
+            for _ in range(n):
+                status, _, _ = raw_predict(
+                    port, body, headers={"x-request-deadline-ms": "400"},
+                )
+                record_status(status)
+
+        threads = [
+            threading.Thread(target=budgeted_client, args=(20,))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "stalled client hung"
+        with statuses_lock:
+            got_504 = statuses.count(504)
+        assert got_504 >= 1, (
+            f"seeded stalls produced no 504 in {len(statuses)} requests"
+        )
+        print(f"# chaos-smoke: engine stall OK ({got_504} deadline 504s "
+              f"in {len(statuses)} budgeted requests)", flush=True)
+
+        # ---- wire-contract probes ------------------------------------
+        status, _, _ = raw_predict(port, json.dumps([RECORD] * 9).encode())
+        record_status(status)
+        assert status == 413, status
+        status, _, _ = raw_predict(port, json.dumps([{"age": "x"}]).encode())
+        record_status(status)
+        assert status == 422, status
+
+        # ---- scenario: slow client does not wedge the plane ----------
+        slow_done: dict = {}
+
+        def slow_client() -> None:
+            payload = (
+                f"POST /predict HTTP/1.1\r\nhost: slow\r\n"
+                f"content-type: application/json\r\n"
+                f"content-length: {len(body)}\r\n"
+                f"connection: close\r\n\r\n"
+            ).encode() + body
+            with socket.create_connection(
+                ("127.0.0.1", port), timeout=30
+            ) as s:
+                s.settimeout(30)
+                for i in range(0, len(payload), 40):
+                    s.sendall(payload[i : i + 40])
+                    time.sleep(0.05)
+                data = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+            slow_done["status"] = int(data.split(b" ")[1])
+
+        dribbler = threading.Thread(target=slow_client)
+        dribbler.start()
+        fast_during_slow = []
+        for _ in range(6):
+            status, _, _ = raw_predict(port, body)
+            record_status(status)
+            fast_during_slow.append(status)
+        dribbler.join(timeout=60)
+        assert not dribbler.is_alive(), "slow client hung the smoke"
+        record_status(slow_done["status"])
+        assert slow_done["status"] in ALLOWED_STATUSES
+        assert any(s == 200 for s in fast_during_slow), (
+            "no fast request served while the slow client dribbled"
+        )
+        print("# chaos-smoke: slow client OK (plane served "
+              f"{fast_during_slow.count(200)}/6 during the dribble)",
+              flush=True)
+
+        # ---- metrics scrape #1 (monotonicity baseline) ---------------
+        status, text = get(f"http://127.0.0.1:{port}/metrics", 30)
+        assert status == 200
+        first = parse_counters(text.decode())
+        assert any("mlops_tpu_deadline_expired_total" in k for k in first)
+        assert any("mlops_tpu_degraded_dispatch_total" in k for k in first)
+
+        # ---- scenario: overload burst against the tiny ring ----------
+        def burst_client() -> None:
+            try:
+                status, _, _ = raw_predict(port, body, timeout=30)
+                record_status(status)
+            except OSError:
+                pass  # connection refused under burst = backpressure, fine
+
+        burst = [threading.Thread(target=burst_client) for _ in range(40)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in burst), "burst client hung"
+        print("# chaos-smoke: overload burst OK", flush=True)
+
+        # ---- scenario: worker kill -> zygote respawn -----------------
+        spawn_line = next(line for line in log_lines if "spawned" in line)
+        pids = [
+            int(p) for p in
+            re.findall(r"\d+", spawn_line.split("(pids", 1)[1])
+        ]
+        os.kill(pids[0], signal.SIGKILL)
+        deadline = time.time() + 30
+        while time.time() < deadline and not any(
+            "respawning" in line for line in log_lines
+        ):
+            time.sleep(0.2)
+        assert any("respawning" in line for line in log_lines), (
+            "zygote never respawned the SIGKILLed front end"
+        )
+        deadline = time.time() + 30
+        served = False
+        while time.time() < deadline and not served:
+            try:
+                status, _, _ = raw_predict(port, body)
+                record_status(status)
+                served = status == 200
+            except OSError:
+                time.sleep(0.2)
+        assert served, "plane stopped serving after the worker kill"
+        print("# chaos-smoke: worker kill OK (respawned, still serving)",
+              flush=True)
+
+        # ---- metrics scrape #2: counters are monotone ----------------
+        status, text = get(f"http://127.0.0.1:{port}/metrics", 30)
+        assert status == 200
+        second = parse_counters(text.decode())
+        regressions = {
+            k: (first[k], second[k])
+            for k in first
+            if k in second and second[k] < first[k]
+        }
+        assert not regressions, f"non-monotone counters: {regressions}"
+
+        # ---- the global status contract ------------------------------
+        with statuses_lock:
+            illegal = sorted({s for s in statuses if s not in ALLOWED_STATUSES})
+            tally = {s: statuses.count(s) for s in sorted(set(statuses))}
+        assert not illegal, f"statuses outside the contract set: {illegal}"
+        print(f"# chaos-smoke: status tally {tally}", flush=True)
+
+        # ---- clean drain under the chaos-tuned knobs -----------------
+        server.send_signal(signal.SIGTERM)
+        rc = server.wait(timeout=60)
+        pump.join(timeout=10)
+        log = "\n".join(log_lines)
+        assert rc == 0, f"server exited {rc}\n{log[-2000:]}"
+        assert "drained" in log, log[-2000:]
+        assert "Task was destroyed" not in log, log[-2000:]
+        print("# chaos-smoke: drain OK (exit 0 under chaos drain knobs)",
+              flush=True)
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="chaos-smoke-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    print("# chaos-smoke: mid-write kill + corruption scenarios", flush=True)
+    midwrite_and_corruption_scenarios(tmp)
+
+    print("# chaos-smoke: training tiny bundle", flush=True)
+    train = subprocess.run(
+        [
+            sys.executable, "-m", "mlops_tpu", "train",
+            "data.rows=3000",
+            "model.hidden_dims=32,32", "model.embed_dim=4",
+            "train.steps=100", "train.eval_every=100",
+            "train.batch_size=256",
+            f"registry.root={tmp}/registry", f"registry.run_root={tmp}/runs",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    if train.returncode != 0:
+        print(train.stdout[-2000:], train.stderr[-2000:], sep="\n")
+        raise SystemExit("train failed")
+    bundle = json.loads(train.stdout.strip().splitlines()[-1])["bundle"]
+    print(f"# chaos-smoke: bundle at {bundle}", flush=True)
+
+    live_plane_scenarios(tmp, bundle)
+    print("# chaos-smoke: OK (all seeded scenarios green)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Planted TPU405 violations: serving-path broad excepts that swallow the
+failure without a trace. ANALYZED, never imported (tests/test_analysis.py).
+
+The TPU201 disables are part of the plant: TPU405 is orthogonal — a
+justified breadth disable does not excuse a handler that records
+nothing, which is exactly what these handlers do.
+"""
+
+import logging
+
+logger = logging.getLogger("fixture")
+
+COUNTS = {"drops": 0}
+
+
+def risky() -> None:
+    raise RuntimeError("boom")
+
+
+def swallowed_pass():
+    try:
+        risky()
+    except Exception:  # tpulint: disable=TPU201  # PLANT: TPU405
+        pass
+
+
+def swallowed_info_log():
+    try:
+        risky()
+    # logger.info is not an action: deployments silence INFO, so the
+    # serving failure still vanishes.
+    except Exception:  # tpulint: disable=TPU201  # PLANT: TPU405
+        logger.info("oops")
+
+
+def swallowed_plain_assign():
+    try:
+        risky()
+    except Exception:  # tpulint: disable=TPU201  # PLANT: TPU405
+        last = "failed"  # noqa: F841 — a local nobody reads is no record
+
+
+# ---- compliant handlers (no findings beyond the plants above) ----------
+def acts_reraise():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def acts_logs_exception():
+    try:
+        risky()
+    except Exception:  # tpulint: disable=TPU201
+        logger.exception("recorded")
+
+
+def acts_returns_wire_error():
+    try:
+        risky()
+    except Exception:  # tpulint: disable=TPU201
+        return 500, {"detail": "failed"}, "application/json"
+
+
+def acts_counts_metric():
+    try:
+        risky()
+    except Exception:  # tpulint: disable=TPU201
+        COUNTS["drops"] += 1
+
+
+def acts_routes_to_waiter(future):
+    try:
+        risky()
+    except Exception as err:  # tpulint: disable=TPU201
+        future.set_exception(err)

@@ -89,3 +89,38 @@ def test_shipped_config_files_load_and_are_consistent():
             assert config.model.dropout == 0.0, path.name
             assert config.train.batch_size % m == 0, path.name
             assert (config.train.batch_size // m) % 2 == 0, path.name
+
+
+def test_serve_drain_deadline_knobs_validate():
+    """The hoisted drain/zygote deadlines (ISSUE 9: ex-hard-coded 30/35/50
+    in serve/frontend.py) must reject inconsistent orderings by name."""
+    from mlops_tpu.config import ServeConfig, ServeConfigError
+
+    ServeConfig().validate()  # shipped defaults are consistent
+    ServeConfig(
+        drain_deadline_s=5.0,
+        zygote_join_deadline_s=8.0,
+        engine_zygote_join_s=15.0,
+    ).validate()  # a fast chaos-scenario tuning is accepted
+    import pytest as _pytest
+
+    with _pytest.raises(ServeConfigError, match="drain_deadline_s"):
+        ServeConfig(drain_deadline_s=0.0).validate()
+    with _pytest.raises(ServeConfigError, match="zygote_join_deadline_s"):
+        ServeConfig(
+            drain_deadline_s=30.0, zygote_join_deadline_s=10.0
+        ).validate()
+    with _pytest.raises(ServeConfigError, match="engine_zygote_join_s"):
+        ServeConfig(engine_zygote_join_s=36.0).validate()
+
+
+def test_lifecycle_breaker_knobs_validate():
+    from mlops_tpu.config import LifecycleConfig, LifecycleConfigError
+
+    LifecycleConfig().validate()
+    import pytest as _pytest
+
+    with _pytest.raises(LifecycleConfigError, match="breaker_failures"):
+        LifecycleConfig(breaker_failures=0).validate()
+    with _pytest.raises(LifecycleConfigError, match="breaker_cooldown_s"):
+        LifecycleConfig(breaker_cooldown_s=-1.0).validate()

@@ -722,3 +722,52 @@ def test_serveconfig_rejects_inconsistent_geometry_with_named_errors():
         cfg.validate()
     # a valid config chains
     assert ServeConfig(workers=2).validate().workers == 2
+
+
+def test_engine_stall_answers_504_within_the_deadline_budget(
+    engine, prep_path
+):
+    """Ring-plane deadline contract (ISSUE 9): with the engine stalled (a
+    seeded delay fault at serve.engine.dispatch), a request carrying
+    x-request-deadline-ms answers the documented 504 within its budget —
+    not 503, no Retry-After, no hang — and the plane keeps serving once
+    the stall clears (the zombie slot drains via the completion)."""
+    from mlops_tpu import faults
+
+    with multi_worker_plane(engine, prep_path, workers=1) as (
+        port, ring, procs, service,
+    ):
+        rec = [{"credit_limit": 9000, "age": 31}]
+        status, _, _ = predict(port, rec)
+        assert status == 200
+        # Arm AFTER the fork: only this (engine-side) process sees the
+        # plan, exactly like an engine-process chaos run.
+        faults.arm(faults.FaultPlan.from_rules([{
+            "point": "serve.engine.dispatch",
+            "mode": "delay", "delay_s": 2.0, "max_fires": 1,
+        }]))
+        try:
+            t0 = time.time()
+            status, headers, body = http_exchange(
+                port, "POST", "/predict", rec,
+                headers={"x-request-deadline-ms": "300"},
+            )
+            elapsed = time.time() - t0
+        finally:
+            faults.disarm()
+        assert status == 504, (status, body)
+        assert "retry-after" not in headers  # 504 is not the shed contract
+        assert elapsed < 1.5  # the 300 ms budget governed
+        # Stall cleared: the same plane serves again (zombie slot drained
+        # by the engine's late completion).
+        deadline = time.time() + 15
+        served = False
+        while time.time() < deadline and not served:
+            status, _, _ = predict(port, rec)
+            served = status == 200
+        assert served
+        # /metrics exports the robustness counters from any worker.
+        status, _, body = http_exchange(port, "GET", "/metrics")
+        assert status == 200
+        assert b"mlops_tpu_deadline_expired_total" in body
+        assert b"mlops_tpu_degraded_dispatch_total" in body

@@ -550,3 +550,105 @@ def test_lifecycle_gauges_ring_render():
 def test_rollback_without_swap_raises(lc):
     with pytest.raises(ValueError, match="no retired bundle"):
         InferenceEngine(lc["bundle"], buckets=(1,)).rollback()
+
+
+def test_circuit_breaker_opens_on_repeated_retrain_failures(lc, tmp_path):
+    """Repeated UNEXPECTED retrain failures (injected at the
+    lifecycle.retrain fault point) open the circuit breaker: triggers
+    stop firing for breaker_cooldown_s instead of hot-looping retrain
+    attempts, the trips counter and gauges move, and the loop re-arms
+    after the cooldown (ISSUE 9)."""
+    from mlops_tpu import faults
+    from mlops_tpu.serve.metrics import ServingMetrics
+
+    engine = _fresh_engine(lc)
+    config = Config()
+    config.lifecycle.enabled = True
+    config.lifecycle.dir = str(tmp_path / "state")
+    config.lifecycle.labeled_path = str(lc["td"] / "labeled.csv")
+    config.lifecycle.min_window_rows = 32
+    config.lifecycle.hysteresis_windows = 1
+    config.lifecycle.cooldown_s = 0.0
+    config.lifecycle.breaker_failures = 2
+    config.lifecycle.breaker_cooldown_s = 100.0
+    clock = {"t": 0.0}
+    ctrl = LifecycleController(engine, config, clock=lambda: clock["t"])
+    faults.arm(faults.FaultPlan.from_rules(
+        [{"point": "lifecycle.retrain", "mode": "raise",
+          "message": "injected retrain failure"}]
+    ))
+    try:
+        _feed(engine, lc["normal"])
+        ctrl.run_once()  # baseline snapshot
+        # Two failing triggers open the breaker.
+        for expected_triggers in (1, 2):
+            _feed(engine, lc["drifted"])
+            clock["t"] += 1.0
+            status = ctrl.run_once()
+            assert status["drift_triggers"] == expected_triggers
+            assert status["state"] == "idle"  # never stranded mid-retrain
+            assert "injected retrain failure" in status["last_error"]
+        assert status["breaker_open"] is True
+        assert status["breaker_trips"] == 1
+        # Open breaker: drift spikes neither fire nor retrain — and the
+        # trigger machinery is never even EVALUATED (observe() would
+        # accumulate hysteresis and arm hidden cooldowns that delay the
+        # half-open probe; the controller must use the side-effect-free
+        # consume() instead).
+        real_observe, observed = ctrl.policy.observe, []
+        ctrl.policy.observe = lambda *a, **k: (
+            observed.append(1), real_observe(*a, **k)
+        )[1]
+        for _ in range(3):
+            _feed(engine, lc["drifted"])
+            clock["t"] += 1.0
+            status = ctrl.run_once()
+        ctrl.policy.observe = real_observe
+        assert observed == []
+        assert status["drift_triggers"] == 2  # unchanged while open
+        assert status["breaker_open"] is True
+        # The gauges render in both telemetry planes' shared formatter.
+        lines = "\n".join(
+            ServingMetrics.lifecycle_lines(ctrl.metrics_snapshot())
+        )
+        assert "mlops_tpu_lifecycle_breaker_open 1" in lines
+        assert "mlops_tpu_lifecycle_breaker_trips_total 1" in lines
+        # Past the cooldown the loop re-arms (half-open): the next breach
+        # triggers again, and one more failure does NOT instantly re-trip
+        # (the streak restarted at zero when the breaker opened).
+        clock["t"] += 101.0
+        _feed(engine, lc["drifted"])
+        clock["t"] += 1.0
+        status = ctrl.run_once()
+        assert status["breaker_open"] is False
+        assert status["drift_triggers"] == 3
+        assert status["breaker_trips"] == 1
+        assert status["consecutive_failures"] == 1
+    finally:
+        faults.disarm()
+        ctrl.engine.set_lifecycle_tee(None)
+
+
+def test_trigger_policy_consume_has_no_side_effects():
+    """`consume()` advances the differencing baseline only: no firing,
+    no streak, no cooldown — the open-breaker window feed."""
+    from mlops_tpu.config import LifecycleConfig
+
+    policy = TriggerPolicy(LifecycleConfig(
+        hysteresis_windows=1, min_window_rows=1, cooldown_s=300.0
+    ))
+
+    def snap(rows, drift):
+        return {
+            "rows": rows, "outliers": 0.0, "batches": rows,
+            "drift_mean": {"f": drift}, "drift_sum": [drift * rows],
+        }
+
+    policy.consume(snap(100, 0.0))  # baseline
+    policy.consume(snap(200, 0.95 * 2))  # a breach-sized window, consumed
+    assert policy._streak == 0
+    assert not policy.in_cooldown(0.0)
+    # The next OBSERVED window differences against the consumed baseline
+    # (continuous), and a breach there fires normally.
+    decision = policy.observe(snap(300, 0.95 * 3 + 0.98 * 1), now=1.0)
+    assert decision.fired, decision

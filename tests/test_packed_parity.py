@@ -414,3 +414,15 @@ def test_bench_breakdown_and_monitor_keys(engine, sample_request):
     )
     monitor = bench._monitor_stage(engine)
     assert monitor["monitor_fetch_per_s"] > 0
+    # Robustness keys (ISSUE 9): armed-off overhead ~0 (generous noise
+    # bound — the pin is the KEY and its order of magnitude, not the
+    # scheduler), and the degraded path measurably served requests
+    # through the next warmed bucket, with the engine restored after.
+    faults_stats = bench._faults_stage(engine, sample_request[0])
+    assert -50.0 < faults_stats["fault_overhead_pct"] < 50.0
+    assert faults_stats["degraded_p99_ms"] > 0
+    assert faults_stats["degraded_dispatch_total"] == 50
+    from mlops_tpu import faults as faults_mod
+
+    assert not faults_mod.armed()  # the stage disarms on every path
+    assert ("bucket", 8) in engine._exec  # the popped entry was restored

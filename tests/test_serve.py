@@ -7,6 +7,7 @@ validated) into payload-asserting golden tests.
 
 import asyncio
 import json
+import time
 
 import numpy as np
 import pytest
@@ -399,14 +400,16 @@ def test_inbound_request_id_is_honored_and_echoed(engine, sample_request):
         assert len(echoed) == 32 and all(c in "0123456789abcdef" for c in echoed)
 
 
-def test_request_deadline_503s_on_stalled_device(engine, sample_request):
-    """A wedged predict path (stalled device) must 503 within the deadline
-    instead of hanging every in-flight connection (observed live: a
-    tunnel-attached chip stalling dispatches for 40+ minutes)."""
+def test_request_deadline_504s_on_stalled_device(engine, sample_request):
+    """A wedged predict path (stalled device) must answer the documented
+    504 within the deadline instead of hanging every in-flight connection
+    (observed live: a tunnel-attached chip stalling dispatches for 40+
+    minutes). 504, not 503: deadline is distinct from the shed path,
+    which alone carries Retry-After (ISSUE 9)."""
     config = ServeConfig(host="127.0.0.1", port=0, request_timeout_s=0.3)
     server = HttpServer(engine, config)
 
-    async def hang_forever(records):
+    async def hang_forever(records, deadline=None):
         await asyncio.sleep(3600)
 
     server.batcher.predict = hang_forever  # simulate the stall
@@ -433,5 +436,175 @@ def test_request_deadline_503s_on_stalled_device(engine, sample_request):
         return int(head.split(b" ")[1]), json.loads(body)
 
     status, payload = asyncio.run(run())
-    assert status == 503
+    assert status == 504
     assert "deadline" in payload["detail"]
+
+
+def test_deadline_header_sheds_dead_work_before_the_engine(
+    engine, sample_request
+):
+    """An already-expired x-request-deadline-ms budget answers the
+    documented 504 WITHOUT the engine (or batcher) ever being touched —
+    the dead-work shed — and the shed is counted in
+    mlops_tpu_deadline_expired_total (ISSUE 9)."""
+    config = ServeConfig(host="127.0.0.1", port=0)
+    server = HttpServer(engine, config)
+    touched = []
+
+    async def must_not_run(records, deadline=None):
+        touched.append(records)
+        return {}
+
+    server.batcher.predict = must_not_run
+
+    async def run():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            data = json.dumps(sample_request).encode()
+            writer.write(
+                (
+                    f"POST /predict HTTP/1.1\r\nhost: t\r\n"
+                    f"content-length: {len(data)}\r\n"
+                    # 1 ms budget, then stall the body so it is spent
+                    # before the request completes admission.
+                    f"x-request-deadline-ms: 1\r\nconnection: close\r\n\r\n"
+                ).encode()
+            )
+            await writer.drain()
+            await asyncio.sleep(0.05)  # budget expires while body pends
+            writer.write(data)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout=5)
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), json.loads(body)
+
+    status, payload = asyncio.run(run())
+    assert status == 504
+    assert "deadline" in payload["detail"]
+    assert touched == []  # the engine path never ran — dead work shed
+    assert server.metrics.deadline_expired == 1
+    assert "mlops_tpu_deadline_expired_total 1" in server.metrics.render()
+
+
+def test_deadline_header_tightens_the_server_timeout(engine, sample_request):
+    """A live (not yet expired) budget bounds the wait on a stalled
+    engine: the 504 lands within the header budget even though
+    serve.request_timeout_s is far larger."""
+    config = ServeConfig(host="127.0.0.1", port=0, request_timeout_s=30.0)
+    server = HttpServer(engine, config)
+
+    async def hang_forever(records, deadline=None):
+        await asyncio.sleep(3600)
+
+    server.batcher.predict = hang_forever
+
+    async def run():
+        srv = await server.start()
+        port = srv.sockets[0].getsockname()[1]
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            data = json.dumps(sample_request).encode()
+            writer.write(
+                (
+                    f"POST /predict HTTP/1.1\r\nhost: t\r\n"
+                    f"content-length: {len(data)}\r\n"
+                    f"x-request-deadline-ms: 200\r\nconnection: close\r\n\r\n"
+                ).encode()
+                + data
+            )
+            await writer.drain()
+            t0 = time.perf_counter()
+            raw = await asyncio.wait_for(reader.read(), timeout=5)
+            elapsed = time.perf_counter() - t0
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), elapsed
+
+    status, elapsed = asyncio.run(run())
+    assert status == 504
+    assert elapsed < 2.0  # the 200 ms budget governed, not the 30 s knob
+
+
+def test_batcher_purges_expired_entries_engine_side(engine, sample_request):
+    """The micro-batcher's claim-time purge completes an expired entry
+    with DeadlineExceeded INSTEAD of dispatching it (dead-work shedding):
+    the handler answers 504 and the engine never sees the request."""
+    import concurrent.futures
+
+    from mlops_tpu.serve.batcher import MicroBatcher
+    from mlops_tpu.serve.wire import DeadlineExceeded
+
+    dispatched = []
+
+    class Recorder:
+        supports_grouping = True
+
+        def predict_records(self, records):
+            dispatched.append(records)
+            return {"predictions": [0.0]}
+
+        def predict_group(self, requests):
+            dispatched.extend(requests)
+            return [{"predictions": [0.0]} for _ in requests]
+
+    async def run():
+        loop = asyncio.get_running_loop()
+        pool = concurrent.futures.ThreadPoolExecutor(2)
+        batcher = MicroBatcher(Recorder(), pool, window_ms=20.0, max_group=8)
+        # Seed the queue so the entry below is NOT idle-fast-pathed.
+        warm = asyncio.ensure_future(batcher.predict(sample_request))
+        await asyncio.sleep(0)
+        expired = asyncio.ensure_future(
+            batcher.predict(sample_request, deadline=loop.time() - 0.001)
+        )
+        results = await asyncio.gather(warm, expired, return_exceptions=True)
+        pool.shutdown(wait=True)
+        return results
+
+    warm_result, expired_result = asyncio.run(run())
+    assert isinstance(warm_result, dict)  # the live entry still served
+    assert isinstance(expired_result, DeadlineExceeded)
+    # Exactly one request reached the engine: the expired one was purged.
+    assert len(dispatched) == 1
+
+
+def test_degraded_dispatch_falls_back_to_next_warmed_bucket(
+    engine, sample_request
+):
+    """A compile/cache failure for an unwarmed bucket (injected at
+    serve.engine.compile) degrades to the next-larger WARMED bucket with
+    a bit-identical response and a degraded_dispatch_total increment —
+    never a 500 (ISSUE 9 degraded-mode contract)."""
+    from mlops_tpu import faults
+
+    record = sample_request[0]
+    records = [dict(record) for _ in range(3)]
+    baseline = engine.predict_records(records)
+    before = engine.degraded_dispatch_total
+    # Make bucket 8 (the 3-row target) unwarmed, and fail its compile.
+    with engine._compile_lock:
+        saved = engine._exec.pop(("bucket", 8))
+    try:
+        faults.arm(
+            faults.FaultPlan.from_rules(
+                [{"point": "serve.engine.compile", "mode": "raise"}]
+            )
+        )
+        degraded = engine.predict_records(records)
+    finally:
+        faults.disarm()
+        with engine._compile_lock:
+            engine._exec[("bucket", 8)] = saved
+    assert degraded == baseline  # masked padding = identical statistics
+    assert engine.degraded_dispatch_total == before + 1
+    # With the fault disarmed and the entry restored, the target bucket
+    # serves again without touching the degraded path.
+    assert engine.predict_records(records) == baseline
+    assert engine.degraded_dispatch_total == before + 1
