@@ -188,6 +188,18 @@ def _percentile(sorted_ms: list[float], q: float) -> float:
     return percentile(sorted_ms, q)
 
 
+def _p50_ms(fn, reps: int = 60) -> float:
+    """Median wall of ``reps`` calls of ``fn`` — the armed-vs-disarmed
+    overhead measurement shared by the faults and trace stages."""
+    lat = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    return _percentile(lat, 50)
+
+
 _T0 = time.perf_counter()
 
 
@@ -302,24 +314,15 @@ def _faults_stage(engine, record) -> dict:
     """
     from mlops_tpu import faults
 
-    def p50_ms(reps: int = 60) -> float:
-        lat = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            engine.predict_records([record])
-            lat.append((time.perf_counter() - t0) * 1e3)
-        lat.sort()
-        return _percentile(lat, 50)
-
     engine.predict_records([record])  # steady state
-    disarmed = p50_ms()
+    disarmed = _p50_ms(lambda: engine.predict_records([record]))
     faults.arm(
         faults.FaultPlan.from_rules(
             [{"point": "bench.no.such.point", "mode": "raise"}]
         )
     )
     try:
-        armed_off = p50_ms()
+        armed_off = _p50_ms(lambda: engine.predict_records([record]))
     finally:
         faults.disarm()
     out: dict = {
@@ -353,6 +356,83 @@ def _faults_stage(engine, record) -> dict:
     lat.sort()
     out["degraded_p99_ms"] = round(_percentile(lat, 99), 3)
     out["degraded_dispatch_total"] = engine.degraded_dispatch_total - before
+    return out
+
+
+def _trace_stage(engine, record) -> dict:
+    """tracewire evidence (mlops_tpu/trace — ISSUE 10):
+
+    - ``trace_overhead_pct``: batch-1 p50 with tracing DISARMED (the
+      product default — every hook is an is-None check) vs ARMED (span
+      per request + shape-stat fold + recorder enqueue). Acceptance:
+      <= 2 armed, ~0 disarmed (the disarmed number IS the baseline every
+      other stage measured).
+    - ``padding_waste_pct`` / ``useful_rows_per_s``: the goodput keys
+      from a SKEWED synthetic trace — request sizes drawn log-uniform
+      across the bucket grid, so every bucket pads — computed by the
+      same ShapeStats the /metrics histograms export. This is ROADMAP
+      item 4's autotuner input: the waste an optimized bucket set would
+      reclaim.
+
+    Engine trace state restored afterwards (shape_stats back to None).
+    """
+    import tempfile
+
+    import numpy as np
+
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.trace import ShapeStats, Span, TraceRecorder
+
+    engine.predict_records([record])  # steady state
+    disarmed = _p50_ms(lambda: engine.predict_records([record]))
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        recorder = TraceRecorder(f"{td}/spans.jsonl", capacity=8192)
+        engine.set_shape_stats(ShapeStats())
+        try:
+
+            def traced():
+                span = Span("bench", plane="bench")
+                engine.predict_records([record], span=span)
+                span.stamp("respond")
+                recorder.record(span.finish(200))
+
+            armed = _p50_ms(traced)
+        finally:
+            engine.set_shape_stats(None)
+            recorder.close()
+    out["trace_overhead_pct"] = round(
+        (armed / max(disarmed, 1e-9) - 1.0) * 100.0, 2
+    )
+
+    # Skewed synthetic shape trace -> goodput keys.
+    rng = np.random.default_rng(7)
+    sizes = np.unique(
+        np.rint(np.exp(rng.uniform(0.0, np.log(200.0), 60))).astype(int)
+    )
+    # Stay inside the warmed bucket grid: an oversized request would
+    # exact-shape-compile (a novel program per size), measuring XLA
+    # compilation instead of padding waste.
+    sizes = sizes[sizes <= getattr(engine, "max_bucket", sizes.max())]
+    stats = ShapeStats()
+    engine.set_shape_stats(stats)
+    try:
+        requested = 0
+        t0 = time.perf_counter()
+        for n in sizes:
+            cat = rng.integers(0, 2, (int(n), SCHEMA.num_categorical)).astype(
+                np.int32
+            )
+            num = rng.normal(size=(int(n), SCHEMA.num_numeric)).astype(
+                np.float32
+            )
+            engine.predict_arrays(cat, num)
+            requested += int(n)
+        elapsed = time.perf_counter() - t0
+    finally:
+        engine.set_shape_stats(None)
+    out["padding_waste_pct"] = stats.padding_waste_pct()
+    out["useful_rows_per_s"] = round(requested / max(elapsed, 1e-9), 1)
     return out
 
 
@@ -1403,6 +1483,13 @@ def main() -> None:
         faults_stats = _faults_stage(engine, record)
     except Exception as err:
         faults_stats = {"fault_stage_error": f"{type(err).__name__}: {err}"}
+    _note("trace stage (tracewire overhead + shape goodput)")
+    try:
+        # Observability evidence, guarded like faults: tracing
+        # instrumentation must never cost the run its headline numbers.
+        faults_stats.update(_trace_stage(engine, record))
+    except Exception as err:
+        faults_stats["trace_stage_error"] = f"{type(err).__name__}: {err}"
     _note("bulk stage")
     bulk = _bulk_stage(engine, bundle)
     _note("stream pipeline stage")

@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "for every registered entry point — bake it into the "
                    "serving image so restarts deserialize instead of "
                    "recompiling"),
+        ("trace-report", "aggregate a traced server's span JSONL "
+                         "(trace.dir): p50/p99 per stage per compiled "
+                         "entry — where each request spent its latency"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument(

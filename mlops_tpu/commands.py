@@ -388,17 +388,40 @@ def _score_batch(config) -> int:
         from mlops_tpu.compilecache.cache import from_config
         from mlops_tpu.data.stream import score_csv_stream
 
+        recorder = None
+        stage_sink = None
+        if config.trace.enabled:
+            # tracewire: pipeline stage timings land in the same span
+            # JSONL stream the servers write (kind="stage" records,
+            # docs/observability.md) — the bulk path's half of the
+            # queryable-log story.
+            from pathlib import Path
+
+            from mlops_tpu.trace import TraceRecorder
+
+            config.trace.validate()
+            recorder = TraceRecorder(
+                Path(config.trace.dir) / "spans-bulk.jsonl",
+                capacity=config.trace.ring_capacity,
+                flush_interval_s=config.trace.flush_interval_s,
+            )
+            stage_sink = recorder.stage_sink("score-stream")
         mesh = make_mesh(jax.device_count()) if jax.device_count() > 1 else None
-        stats = score_csv_stream(
-            bundle,
-            config.data.train_path,
-            out_path=config.score.output_path or None,
-            chunk_rows=config.score.chunk_rows,
-            mesh=mesh,
-            exact=True if config.score.exact else None,
-            pipeline_depth=config.score.pipeline_depth,
-            compile_cache=from_config(config),
-        )
+        try:
+            stats = score_csv_stream(
+                bundle,
+                config.data.train_path,
+                out_path=config.score.output_path or None,
+                chunk_rows=config.score.chunk_rows,
+                mesh=mesh,
+                exact=True if config.score.exact else None,
+                pipeline_depth=config.score.pipeline_depth,
+                compile_cache=from_config(config),
+                stage_sink=stage_sink,
+            )
+        finally:
+            if recorder is not None:
+                recorder.close()
         print(json.dumps(stats))
         return 0
     if config.data.train_path:
@@ -499,9 +522,11 @@ def _serve(config) -> int:
     config.serve.service_name = os.environ.get(
         "SERVICE_NAME", config.serve.service_name
     )
-    # Inconsistent worker/ring geometry fails the rollout HERE with the
-    # constraint named (ServeConfigError), before anything binds or warms.
+    # Inconsistent worker/ring geometry (or trace knobs) fails the
+    # rollout HERE with the constraint named, before anything binds or
+    # warms.
     config.serve.validate()
+    config.trace.validate()
     if config.serve.workers > 1:
         # Multi-worker plane: N SO_REUSEPORT front-end processes feeding
         # this process's engine over the shared-memory ring
@@ -532,7 +557,9 @@ def _serve(config) -> int:
         from mlops_tpu.lifecycle import LifecycleController
 
         lifecycle = LifecycleController(engine, config)
-    serve_forever(engine, config.serve, lifecycle=lifecycle)
+    serve_forever(
+        engine, config.serve, lifecycle=lifecycle, trace=config.trace
+    )
     return 0
 
 
@@ -643,6 +670,24 @@ def _lifecycle(config) -> int:
     return 0 if decision.passed else 3
 
 
+def _trace_report(config) -> int:
+    """Aggregate a traced server's span JSONL (`mlops-tpu trace-report
+    [trace.dir=<dir>]`): p50/p99 per stage per compiled entry — the local
+    twin of the reference repo's Kusto latency queries, answering the
+    question its logs never could (where did THIS latency go). Prints the
+    human table on stderr and the JSON report on stdout (the CLI's
+    one-JSON-line discipline). Exit 2 when the dir holds no spans."""
+    import sys
+
+    from mlops_tpu.trace import format_report, load_spans, stage_report
+
+    spans = load_spans(config.trace.dir)
+    report = stage_report(spans)
+    print(format_report(report), file=sys.stderr)
+    print(json.dumps(report))
+    return 0 if spans else 2
+
+
 def _analyze(config) -> int:
     """Handler-table entry for parser/handler sync (tests/test_cli.py);
     ``run()`` intercepts `analyze` before config loading, so this shim only
@@ -669,4 +714,5 @@ _HANDLERS = {
     "serve": _serve,
     "lifecycle": _lifecycle,
     "warmup": _warmup,
+    "trace-report": _trace_report,
 }

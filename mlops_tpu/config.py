@@ -517,6 +517,50 @@ class LifecycleConfig:
         return self
 
 
+class TraceConfigError(ValueError):
+    """An inconsistent tracing geometry, named at startup (the
+    ``ServeConfigError`` discipline applied to the tracewire knobs)."""
+
+
+@dataclasses.dataclass
+class TraceConfig:
+    """tracewire (`mlops_tpu/trace/`): end-to-end request tracing +
+    shape/goodput telemetry on both serving planes. Disabled by default —
+    disarmed, the hot path pays one ``is None`` check per request (bench
+    pins ``trace_overhead_pct`` ~0 disarmed, <= 2 armed)."""
+
+    enabled: bool = False
+    dir: str = "traces"  # span JSONL root: the single-process server
+    # writes spans.jsonl, each multi-worker front end spans-w{N}.jsonl;
+    # `mlops-tpu trace-report trace.dir=<dir>` aggregates them
+    ring_capacity: int = 4096  # bounded span buffer per process; a full
+    # buffer DROPS (counted in mlops_tpu_trace_dropped_total) instead of
+    # ever back-pressuring the request path
+    flush_interval_s: float = 0.5  # background writer cadence; the drain
+    # path flushes everything regardless, so this only bounds how long a
+    # span sits in memory while the server runs
+
+    def validate(self) -> "TraceConfig":
+        problems: list[str] = []
+        if self.ring_capacity < 1:
+            problems.append(
+                f"trace.ring_capacity={self.ring_capacity} must be >= 1"
+            )
+        if self.flush_interval_s <= 0:
+            problems.append(
+                f"trace.flush_interval_s={self.flush_interval_s} must be "
+                "> 0 (a zero interval busy-loops the writer thread)"
+            )
+        if self.enabled and not self.dir:
+            problems.append(
+                "trace.enabled=true requires trace.dir (the span JSONL "
+                "root)"
+            )
+        if problems:
+            raise TraceConfigError("; ".join(problems))
+        return self
+
+
 @dataclasses.dataclass
 class CacheConfig:
     """Persistent AOT executable cache (`mlops_tpu/compilecache/`)."""
@@ -550,6 +594,7 @@ class Config:
     lifecycle: LifecycleConfig = dataclasses.field(
         default_factory=LifecycleConfig
     )
+    trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
 

@@ -136,12 +136,15 @@ def run_pipeline(
     depth: int = 4,
     source_name: str = "read",
     sink_name: str = "write",
+    stage_sink: Callable | None = None,
 ) -> PipelineStats:
     """Stream ``source`` through ``stages`` into ``sink`` (see module
     docstring for the execution model). Returns per-stage timing stats;
-    re-raises the original exception if any stage fails."""
+    re-raises the original exception if any stage fails. ``stage_sink``
+    (tracewire — `trace/recorder.TraceRecorder.stage_sink`) additionally
+    streams every completed stage execution into the span JSONL."""
     depth = max(1, int(depth))
-    clock = StageClock()
+    clock = StageClock(sink=stage_sink)
     start = time.perf_counter()
     if depth <= 1:
         items = _run_serial(source, stages, sink, clock, source_name, sink_name)

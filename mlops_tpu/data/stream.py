@@ -381,6 +381,7 @@ def score_csv_stream(
     pipeline_depth: int = 2,
     native: bool | None = None,
     compile_cache=None,
+    stage_sink=None,
 ) -> dict[str, float]:
     """Stream-score a CSV/Parquet of any size through the bundle's fused
     predict.
@@ -401,6 +402,11 @@ def score_csv_stream(
     into place only on success, so a mid-stream exception (which drains
     the pipeline and propagates) never leaves a partial file behind
     looking like a finished run.
+
+    ``stage_sink`` (tracewire): a `TraceRecorder.stage_sink` callable —
+    every stage execution additionally lands as a kind="stage" record in
+    the span JSONL (`mlops-tpu score-batch score.streaming=true
+    trace.enabled=true`).
     """
     import contextlib
 
@@ -559,6 +565,7 @@ def score_csv_stream(
                 ],
                 write_chunk,
                 depth=pipeline_depth,
+                stage_sink=stage_sink,
             )
         if tmp_path is not None:
             tmp_path.replace(out_path)

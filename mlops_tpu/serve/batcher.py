@@ -80,9 +80,10 @@ class MicroBatcher:
         # A group can never exceed the largest warmed slot bucket — beyond
         # it predict_group would have no compiled shape to run.
         self.max_group = min(max_group, GROUP_SLOT_BUCKETS[-1])
-        # (records, future, absolute loop-clock deadline or None)
+        # (records, future, absolute loop-clock deadline or None,
+        #  tracewire span or None)
         self._pending: list[
-            tuple[list[dict], asyncio.Future, float | None]
+            tuple[list[dict], asyncio.Future, float | None, Any]
         ] = []
         self._drain_task: asyncio.Task | None = None
         self._full = asyncio.Event()  # set when a full group is waiting
@@ -116,6 +117,7 @@ class MicroBatcher:
         self,
         records: list[dict[str, Any]],
         deadline: float | None = None,
+        span: Any = None,
     ) -> dict[str, Any]:
         """Entry point for the request handler. ``deadline`` (absolute
         loop-clock time, from the request's ``x-request-deadline-ms``
@@ -123,14 +125,23 @@ class MicroBatcher:
         purge completes an already-expired entry with
         ``DeadlineExceeded`` INSTEAD of dispatching it — dead work is
         shed engine-side, before it costs a device dispatch, not just
-        abandoned by the waiting handler."""
+        abandoned by the waiting handler. ``span`` (tracewire) rides the
+        same way and gets the queue/dispatch/fetch stage stamps; None
+        (the default, tracing disarmed) costs one branch per path."""
         loop = asyncio.get_running_loop()
         if (
             not self.enabled
             or not (1 <= len(records) <= GROUP_ROW_BUCKET)
         ):
+            if span is None:
+                return await loop.run_in_executor(
+                    self._executor, self.engine.predict_records, records
+                )
+            # Span threading needs the keyword form; stub engines (tests,
+            # sklearn shims) only see it with tracing armed.
             return await loop.run_in_executor(
-                self._executor, self.engine.predict_records, records
+                self._executor,
+                lambda: self.engine.predict_records(records, span=span),
             )
 
         # Idle fast-path: a request arriving with nothing queued, nothing
@@ -157,9 +168,15 @@ class MicroBatcher:
             # the fast-path for the next victim — re-creating the
             # unbounded-dead-backlog failure the counter exists to stop.
             self._solo_inflight += 1
-            fut = loop.run_in_executor(
-                self._executor, self.engine.predict_records, records
-            )
+            if span is None:
+                fut = loop.run_in_executor(
+                    self._executor, self.engine.predict_records, records
+                )
+            else:
+                fut = loop.run_in_executor(
+                    self._executor,
+                    lambda: self.engine.predict_records(records, span=span),
+                )
 
             def _done(f: asyncio.Future) -> None:
                 self._solo_inflight -= 1
@@ -174,7 +191,7 @@ class MicroBatcher:
             return await asyncio.shield(fut)
 
         future: asyncio.Future = loop.create_future()
-        self._pending.append((records, future, deadline))
+        self._pending.append((records, future, deadline, span))
         if len(self._pending) >= self.max_group:
             self._full.set()  # close the window early
         if self._drain_task is None or self._drain_task.done():
@@ -208,7 +225,7 @@ class MicroBatcher:
             now = asyncio.get_running_loop().time()
             live = []
             for entry in self._pending:
-                _, future, entry_deadline = entry
+                _, future, entry_deadline, _ = entry
                 if future.done():
                     continue
                 if entry_deadline is not None and now >= entry_deadline:
@@ -231,10 +248,18 @@ class MicroBatcher:
         # own; their futures don't need the drain loop.
 
     async def _dispatch(
-        self, batch: list[tuple[list[dict], asyncio.Future, float | None]]
+        self,
+        batch: list[tuple[list[dict], asyncio.Future, float | None, Any]],
     ) -> None:
         loop = asyncio.get_running_loop()
-        requests = [records for records, _, _ in batch]
+        requests = [records for records, _, _, _ in batch]
+        spans = [span for _, _, _, span in batch]
+        if any(span is not None for span in spans):
+            # Queue stage ends at claim: the window wait + any
+            # inflight-bound wait the entry paid before this task ran.
+            for span in spans:
+                if span is not None:
+                    span.stamp("queue")
         # Two-phase path when the engine supports it: dispatch (encode +
         # device enqueue + async D2H start) holds the inflight slot, the
         # blocking fetch rides the fetch ring — overlapping the next
@@ -253,6 +278,13 @@ class MicroBatcher:
                 handle = await loop.run_in_executor(
                     self._executor, dispatch, requests
                 )
+                for span in spans:
+                    if span is not None:
+                        # Encode rides inside dispatch_group on this plane
+                        # (the engine's flat-encode optimization), so the
+                        # dispatch stage covers encode + device enqueue.
+                        span.stamp("dispatch")
+                        span.entry = getattr(handle, "entry", None)
                 # Claim the fetch ring BEFORE releasing the dispatch slot:
                 # released first, a lagging fetch path would let the drain
                 # loop keep dispatching while handles (each pinning live
@@ -267,15 +299,18 @@ class MicroBatcher:
                     responses = await loop.run_in_executor(
                         self._executor, fetch, handle
                     )
+                for span in spans:
+                    if span is not None:
+                        span.stamp("device_fetch")
         # Not swallowed: whatever the dispatch raised (device error,
         # encode bug) is re-routed onto every waiter's future, where the
         # request handler surfaces it as a 500.
         except Exception as err:  # tpulint: disable=TPU201
-            for _, future, _ in batch:
+            for _, future, _, _ in batch:
                 if not future.done():
                     future.set_exception(err)
         else:
-            for (_, future, _), response in zip(batch, responses):
+            for (_, future, _, _), response in zip(batch, responses):
                 if not future.done():
                     future.set_result(response)
         finally:

@@ -106,13 +106,20 @@ class _ArraysHandle:
 class _GroupHandle:
     """In-flight grouped dispatch (or the degenerate solo-path result)."""
 
-    __slots__ = ("out", "sizes", "rows", "responses")
+    __slots__ = ("out", "sizes", "rows", "responses", "slots", "entry")
 
-    def __init__(self, out=None, sizes=None, rows=0, responses=None):
+    def __init__(self, out=None, sizes=None, rows=0, responses=None,
+                 slots=0):
         self.out = out
         self.sizes = sizes
         self.rows = rows
         self.responses = responses  # set = degenerate path, already done
+        self.slots = slots  # slot-bucket geometry actually dispatched
+        # tracewire compiled-entry key, derived ONCE from the ints the
+        # engine chose (degraded fallback included) — consumers carry the
+        # ints (serve/ipc.py) or this string (the batcher's span entry),
+        # never re-parse it.
+        self.entry = f"group_{slots}x{rows}" if slots else None
 
     def start_copy(self) -> None:
         if self.out is not None:
@@ -155,6 +162,11 @@ class InferenceEngine:
         self.bundle_generation = 1
         self._retired: tuple | None = None
         self._tee = None
+        # tracewire shape telemetry (mlops_tpu/trace/shapes.py), armed by
+        # `set_shape_stats` when trace.enabled: every dispatch records
+        # (compiled entry, requested rows, padded rows). Disarmed = None =
+        # one branch on the hot path (the faultline overhead discipline).
+        self.shape_stats = None
         if bundle.flavor == "doc":
             raise ValueError(
                 "doc bundles score record HISTORIES, not single records — "
@@ -439,6 +451,13 @@ class InferenceEngine:
                 self._exec[key] = fn
         return fn
 
+    def set_shape_stats(self, stats) -> None:
+        """Install (or clear, with None) the tracewire shape recorder: a
+        `trace/shapes.ShapeStats` fed (entry, requested_rows, padded_rows)
+        per dispatch. The recorder owns its cheapness (a leaf-lock counter
+        add); the engine calls it bare on the dispatch path."""
+        self.shape_stats = stats
+
     # ----------------------------------------------------- bundle turnover
     def set_lifecycle_tee(self, tee) -> None:
         """Install (or clear, with None) the lifecycle observation hook:
@@ -590,22 +609,35 @@ class InferenceEngine:
         }
 
     # -------------------------------------------------------------- predict
-    def predict_records(self, records: list[dict[str, Any]]) -> dict[str, Any]:
-        """Validated records -> reference response dict (`app/model.py:64-70`)."""
+    def predict_records(
+        self, records: list[dict[str, Any]], span=None
+    ) -> dict[str, Any]:
+        """Validated records -> reference response dict (`app/model.py:64-70`).
+        ``span`` (tracewire, `trace/span.Span`) gets the engine-side stage
+        stamps — encode / dispatch / device_fetch — when tracing is armed;
+        None (the default) costs two branches."""
         columns = records_to_columns(records)
         ds = self.bundle.preprocessor.encode(columns)
-        return self.predict_arrays(ds.cat_ids, ds.numeric)
+        if span is not None:
+            span.stamp("encode")
+        return self.predict_arrays(ds.cat_ids, ds.numeric, span=span)
 
     def predict_arrays(
-        self, cat_ids: np.ndarray, numeric: np.ndarray
+        self, cat_ids: np.ndarray, numeric: np.ndarray, span=None
     ) -> dict[str, Any]:
         handle = self.dispatch_arrays(cat_ids, numeric)
         if handle is None:
             # Empty request: nothing to score, no drift signal (an empty
             # batch must not poison the drift gauges with statistic=1).
             return empty_response()
+        if span is not None:
+            span.stamp("dispatch")
+            span.entry = f"bucket_{handle.rows}"
         handle.start_copy()
-        return self.fetch_arrays(handle)
+        response = self.fetch_arrays(handle)
+        if span is not None:
+            span.stamp("device_fetch")
+        return response
 
     def dispatch_arrays(
         self, cat_ids: np.ndarray, numeric: np.ndarray
@@ -634,8 +666,17 @@ class InferenceEngine:
             # dict output (no packed program exists for a non-XLA model).
             cat_ids, numeric, mask = _pad_rows(cat_ids, numeric, n, rows)
             out = self._predict(cat_ids, numeric, mask)
+            stats = self.shape_stats
+            if stats is not None:
+                stats.observe(f"bucket_{rows}", n, rows)
             return _ArraysHandle(out, n, rows, packed=False)
         out, rows = self._dispatch_padded(cat_ids, numeric, n, rows)
+        stats = self.shape_stats
+        if stats is not None:
+            # rows is the shape that actually SERVED (the degraded
+            # fallback bucket when the target failed) — the histogram must
+            # describe the compute paid, not the compute intended.
+            stats.observe(f"bucket_{rows}", n, rows)
         return _ArraysHandle(out, n, rows, packed=True)
 
     def _dispatch_padded(self, cat_ids, numeric, n: int, rows: int):
@@ -818,7 +859,13 @@ class InferenceEngine:
             out = self._dispatch_group_at(parts, sizes, *fallback)
             self._count_degraded()
             slots, rows = fallback
-        handle = _GroupHandle(out=out, sizes=sizes, rows=rows)
+        stats = self.shape_stats
+        if stats is not None:
+            # Geometry occupancy: requested = the rows clients asked for,
+            # padded = the full slots x rows grid the program computed
+            # (slot padding AND row padding both count as waste).
+            stats.observe(f"group_{slots}x{rows}", sum(sizes), slots * rows)
+        handle = _GroupHandle(out=out, sizes=sizes, rows=rows, slots=slots)
         handle.start_copy()
         return handle
 

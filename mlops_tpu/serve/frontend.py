@@ -65,6 +65,12 @@ from mlops_tpu.serve.wire import (
 
 logger = logging.getLogger("mlops_tpu.serve")
 
+# How long a front end waits for the engine collector to acknowledge a
+# forwarded /debug/profile request before cancelling it and answering
+# 504. Covers any healthy collector iteration (its idle select tick is
+# 1 s) with a wide margin; an operator debug endpoint, not a config knob.
+_PROFILE_ACK_S = 10.0
+
 
 def reuseport_socket(host: str, port: int) -> socket.socket:
     """A bound (not listening) TCP socket with SO_REUSEPORT: every front
@@ -95,6 +101,7 @@ class FrontendServer(HttpProtocol):
         ring: RequestRing,
         worker_id: int,
         preprocessor: Any,
+        trace: Any = None,
     ) -> None:
         super().__init__(config)
         self.ring = ring
@@ -102,6 +109,26 @@ class FrontendServer(HttpProtocol):
         self.preprocessor = preprocessor
         self.client = RingClient(ring, worker_id)
         self.metrics = ShmWorkerMetrics(ring, worker_id)
+        self.trace_plane = "ring"
+        self.trace_worker = worker_id
+        if trace is not None and trace.enabled:
+            # tracewire: this worker's spans -> its own JSONL (per-worker
+            # files need no cross-process append coordination); drops
+            # land in the worker's shm cell so any scrape sees the fleet
+            # total. The engine half-stamps stitch in via `_score`.
+            from pathlib import Path
+
+            from mlops_tpu.trace import TraceRecorder
+
+            def _count_drops(n: int) -> None:
+                ring.trace_dropped[worker_id] += n
+
+            self.tracer = TraceRecorder(
+                Path(trace.dir) / f"spans-w{worker_id}.jsonl",
+                capacity=trace.ring_capacity,
+                flush_interval_s=trace.flush_interval_s,
+                on_drop=_count_drops,
+            )
         # The ring's large slabs are sized by the parent to the (possibly
         # bucket-clamped) request cap; the slab capacity is the contract.
         self.max_batch = min(config.max_batch, ring.large_rows)
@@ -136,6 +163,7 @@ class FrontendServer(HttpProtocol):
         record_dicts: list[dict],
         request_id: str,
         deadline: float | None = None,
+        span=None,
     ):
         """The ring-backed scoring hook under the shared `_predict` shell
         (serve/httpcore.py): admission first, then encode, then the slot
@@ -198,6 +226,8 @@ class FrontendServer(HttpProtocol):
                     records_to_columns(record_dicts)
                 ),
             )
+            if span is not None:
+                span.stamp("encode")
             # The slot header carries the absolute deadline (the loop
             # clock IS time.monotonic, which the engine process shares):
             # a descriptor that expires while queued in the ring comes
@@ -239,6 +269,8 @@ class FrontendServer(HttpProtocol):
                 self.client.release(slot)
                 slot = None
                 return 500, {"detail": "prediction failed"}, "application/json"
+            if span is not None:
+                self._stitch_engine_half(span, slot)
             pred, out, drift = self.client.response_arrays(slot)
             # format_response materializes Python floats, so the slab is
             # quiescent before release.
@@ -259,6 +291,73 @@ class FrontendServer(HttpProtocol):
                 else:
                     self.client.release(slot)
             return 500, {"detail": "prediction failed"}, "application/json"
+
+    def _stitch_engine_half(self, span, slot: int) -> None:
+        """Fold the engine process's half-span (the four CLOCK_MONOTONIC
+        stamps + compiled-entry encoding it wrote into the slot header —
+        serve/ipc.py ``resp_trace``) into this request's span: one
+        stitched record whose stages are monotone and non-overlapping by
+        the span's clamping rule. Read between completion and release —
+        the same ownership window as the response slab."""
+        stamps = self.ring.resp_trace[slot]
+        collect, jobstart, dispatched, fetched = (
+            float(stamps[0]), float(stamps[1]),
+            float(stamps[2]), float(stamps[3]),
+        )
+        if not (collect and jobstart and dispatched and fetched):
+            return  # engine ran untraced (armed mid-flight); keep ours
+        span.stamp_at("ring_wait", collect)
+        span.stamp_at("engine_queue", jobstart)
+        span.stamp_at("dispatch", dispatched)
+        span.stamp_at("device_fetch", fetched)
+        kind, geom = int(stamps[4]), int(stamps[5])
+        if kind == 1:
+            span.entry = f"bucket_{geom}"
+        elif kind == 2:
+            span.entry = f"group_{geom // 100000}x{geom % 100000}"
+
+    async def _profile(self, action: str):
+        """Forward /debug/profile to the ENGINE process (the only one
+        holding the device) through the ring's single-word control
+        channel: claim the channel non-blocking (busy -> 409), publish
+        the request word, await the collector's acknowledgement, answer
+        with the shared wire shapes (`httpcore.profile_payload`)."""
+        from mlops_tpu.serve.httpcore import profile_payload
+
+        if not self.config.profile_dir:
+            return profile_payload(404, action, "")
+        code = {"start": 1, "stop": 2}.get(action)
+        if code is None:
+            return 404, {"detail": "not found"}, "application/json"
+        ring = self.ring
+        token = ring.try_claim_profile()
+        if token is None:
+            return 409, {"detail": "profile control busy"}, "application/json"
+        try:
+            seq = ring.post_profile_request(code)
+            deadline = asyncio.get_running_loop().time() + _PROFILE_ACK_S
+            while True:
+                status = ring.read_profile_ack(seq)
+                if status is not None:
+                    break
+                if asyncio.get_running_loop().time() >= deadline:
+                    # Engine collector never answered (stalled in a long
+                    # compile / chaos stall): CANCEL the pending word so
+                    # the start/stop does not execute later against a
+                    # client already told it failed.
+                    ring.cancel_profile_request(seq, token)
+                    status = 504
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            ring.release_profile(token)
+        return profile_payload(status, action, self.config.profile_dir)
+
+    def close_tracer(self) -> None:
+        """Drain-path flush of this worker's span recorder (joins the
+        writer thread; call only once the in-flight exchanges finished)."""
+        if self.tracer is not None:
+            self.tracer.close()
 
     # ---------------------------------------------------------- lifecycle
     async def start(self) -> asyncio.AbstractServer:
@@ -292,6 +391,7 @@ def _frontend_main(
     config: ServeConfig,
     ring: RequestRing,
     preprocess_path: str,
+    trace: Any = None,
 ) -> None:
     """Front-end child process entry (forked — everything arrives by
     inheritance). Never imports jax, never touches the device."""
@@ -299,15 +399,21 @@ def _frontend_main(
 
     preprocessor = Preprocessor.load(preprocess_path)
     try:
-        asyncio.run(_run_frontend(worker_id, config, ring, preprocessor))
+        asyncio.run(
+            _run_frontend(worker_id, config, ring, preprocessor, trace)
+        )
     except KeyboardInterrupt:  # pragma: no cover - interactive stop
         pass
 
 
 async def _run_frontend(
-    worker_id: int, config: ServeConfig, ring: RequestRing, preprocessor
+    worker_id: int,
+    config: ServeConfig,
+    ring: RequestRing,
+    preprocessor,
+    trace: Any = None,
 ) -> None:
-    server = FrontendServer(config, ring, worker_id, preprocessor)
+    server = FrontendServer(config, ring, worker_id, preprocessor, trace)
     srv = await server.start()
     logger.info(
         "frontend %d serving %s on %s:%s (pid %d)",
@@ -364,6 +470,12 @@ async def _run_frontend(
     watchdog.cancel()
     with contextlib.suppress(asyncio.TimeoutError):
         await asyncio.wait_for(srv.wait_closed(), timeout=5)
+    # AFTER the busy/pending drain above: every finished exchange has
+    # recorded its span; the final flush guarantees no torn or lost
+    # lines on SIGTERM (O_APPEND single-write discipline in the writer).
+    await asyncio.get_running_loop().run_in_executor(
+        None, server.close_tracer
+    )
     logger.info("frontend %d drained; exiting", worker_id)
 
 
@@ -371,17 +483,21 @@ def start_frontends(
     config: ServeConfig,
     ring: RequestRing,
     preprocess_path: str,
+    trace: Any = None,
 ) -> list[multiprocessing.Process]:
     """Fork one front-end process per worker (call BEFORE any jax backend
     initializes in the parent — the children inherit a clean world)."""
     return [
-        _respawn(config, ring, preprocess_path, worker_id)
+        _respawn(config, ring, preprocess_path, worker_id, trace)
         for worker_id in range(ring.workers)
     ]
 
 
 def _zygote_main(
-    config: ServeConfig, ring: RequestRing, preprocess_path: str
+    config: ServeConfig,
+    ring: RequestRing,
+    preprocess_path: str,
+    trace: Any = None,
 ) -> None:
     """Spawner process: forked from the parent BEFORE the backend loads,
     so every front end — the initial set and every respawn — forks from
@@ -401,7 +517,7 @@ def _zygote_main(
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     engine_pid = os.getppid()
-    procs = start_frontends(config, ring, preprocess_path)
+    procs = start_frontends(config, ring, preprocess_path, trace)
     logger.info(
         "zygote %d spawned %d front ends (pids %s)",
         os.getpid(), len(procs), [p.pid for p in procs],
@@ -422,7 +538,7 @@ def _zygote_main(
                 "frontend %d (pid %s) died with exit code %s; respawning",
                 i, proc.pid, proc.exitcode,
             )
-            procs[i] = _respawn(config, ring, preprocess_path, i)
+            procs[i] = _respawn(config, ring, preprocess_path, i, trace)
     for proc in procs:
         if proc.is_alive() and proc.pid:
             with contextlib.suppress(ProcessLookupError):
@@ -495,6 +611,16 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         slots_large=serve_cfg.ring_slots_large,
         large_rows=max_batch,
     )
+    trace_cfg = getattr(config, "trace", None)
+    if trace_cfg is not None and trace_cfg.enabled:
+        # tracewire: validate + create the span dir BEFORE the fork (the
+        # children write their per-worker JSONL into it) and flip the
+        # shm tracing flag so the engine side stamps slot half-spans.
+        trace_cfg.validate()
+        Path(trace_cfg.dir).mkdir(parents=True, exist_ok=True)
+        ring.set_tracing(True)
+    else:
+        trace_cfg = None
     # Reserve the port once (also resolves port=0), then hand the concrete
     # port to every child; the placeholder never listens, so the kernel
     # routes nothing to it.
@@ -506,7 +632,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     )
     zygote = multiprocessing.get_context("fork").Process(
         target=_zygote_main,
-        args=(child_cfg, ring, preprocess_path),
+        args=(child_cfg, ring, preprocess_path, trace_cfg),
         name="mlops-tpu-zygote",
     )
     zygote.start()
@@ -541,6 +667,13 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             compile_cache=from_config(config),
             warmup_workers=config.cache.warmup_workers,
         )
+        if trace_cfg is not None:
+            # Shape histograms accumulate ENGINE-side (the only process
+            # that dispatches); the telemetry loop mirrors them into shm
+            # for every front end's /metrics.
+            from mlops_tpu.trace import ShapeStats
+
+            engine.set_shape_stats(ShapeStats())
         service = RingService(
             engine,
             ring,
@@ -550,6 +683,13 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
             monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
         )
+        if serve_cfg.profile_dir:
+            # /debug/profile on the multi-worker plane: the front ends
+            # forward start/stop through the ring's control word to THIS
+            # process, which owns the device (serve/server.py JaxProfiler).
+            from mlops_tpu.serve.server import JaxProfiler
+
+            service.profiler = JaxProfiler(serve_cfg.profile_dir).control
         # Service first, then warmup: early requests AOT-compile on
         # demand exactly like the single-process bind-first model, and
         # /healthz/ready flips when every bucket is compiled.
@@ -621,7 +761,11 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
 
 
 def _respawn(
-    config: ServeConfig, ring: RequestRing, preprocess_path: str, worker_id: int
+    config: ServeConfig,
+    ring: RequestRing,
+    preprocess_path: str,
+    worker_id: int,
+    trace: Any = None,
 ) -> multiprocessing.Process:
     """Fork a replacement front end for one worker slot partition (the
     generation counters in shm make any of the dead worker's in-flight
@@ -631,7 +775,7 @@ def _respawn(
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_frontend_main,
-        args=(worker_id, config, ring, preprocess_path),
+        args=(worker_id, config, ring, preprocess_path, trace),
         name=f"mlops-tpu-frontend-{worker_id}",
     )
     proc.start()

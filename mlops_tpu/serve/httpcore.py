@@ -23,6 +23,7 @@ import pydantic
 
 from mlops_tpu.config import ServeConfig
 from mlops_tpu.schema import LoanApplicant
+from mlops_tpu.trace.span import Span  # jax-free; front ends import this too
 
 logger = logging.getLogger("mlops_tpu.serve")
 
@@ -66,6 +67,34 @@ def deadline_response(detail: str = "request deadline exceeded") -> tuple:
     a 504'd request may or may not have been scored; a shed 503 never
     was, and only the 503 invites a retry)."""
     return 504, {"detail": detail}, "application/json"
+
+
+def profile_payload(
+    status: int, action: str, profile_dir: str, err: str | None = None
+) -> tuple:
+    """THE /debug/profile wire shapes, shared by both planes: the
+    single-process server answers from its in-process `jax.profiler`
+    state, the ring front ends from the engine process's acknowledgement
+    word (serve/ipc.py) — same status, same body either way."""
+    if status == 200:
+        state = "tracing" if action == "start" else "stopped"
+        return 200, {"status": state, "dir": profile_dir}, "application/json"
+    if status == 409:
+        detail = (
+            "trace already running" if action == "start"
+            else "no trace running"
+        )
+        return 409, {"detail": detail}, "application/json"
+    if status == 404:
+        return 404, {"detail": "profiling disabled"}, "application/json"
+    if status == 504:
+        return 504, {
+            "detail": "engine did not acknowledge the profile request"
+        }, "application/json"
+    detail = f"profiler {action} failed"
+    if err:
+        detail = f"{detail}: {err}"
+    return 500, {"detail": detail}, "application/json"
 
 
 def _head_prefix(status: int, content_type: str) -> bytes:
@@ -128,6 +157,13 @@ class HttpProtocol:
         self.draining = False
         self._connections: set[asyncio.StreamWriter] = set()
         self._busy: set[asyncio.StreamWriter] = set()
+        # tracewire (mlops_tpu/trace/): a TraceRecorder when the trace
+        # config section arms it, else None — the disarmed hot path pays
+        # one is-None check per request. Subclasses set the plane/worker
+        # labels their spans carry.
+        self.tracer: Any = None
+        self.trace_plane = "single"
+        self.trace_worker = 0
 
     # ------------------------------------------------------ subclass hooks
     async def _predict(
@@ -135,6 +171,7 @@ class HttpProtocol:
         body: bytes,
         request_id: str | None = None,
         deadline: float | None = None,
+        span=None,
     ):
         """The reference's `predict()` endpoint (`app/main.py:42-86`):
         validate -> log InferenceData -> score -> log ModelOutput ->
@@ -177,6 +214,11 @@ class HttpProtocol:
             return deadline_response()
         request_id = request_id or uuid.uuid4().hex
         record_dicts = [r.model_dump() for r in records]
+        if span is not None:
+            # Admission ends here: head + body read, pydantic validation,
+            # and the 413/deadline gates all behind us.
+            span.rows = len(record_dicts)
+            span.stamp("admission")
         # Two layers keep log formatting off the hot path: isEnabledFor
         # skips everything when the deployment silences INFO, and
         # _LazyJson defers the dumps of the full payload to record-emit
@@ -193,7 +235,7 @@ class HttpProtocol:
                     }
                 ),
             )
-        response = await self._score(record_dicts, request_id, deadline)
+        response = await self._score(record_dicts, request_id, deadline, span)
         if isinstance(response, tuple):
             return response  # subclass error path, already wire-shaped
         if logger.isEnabledFor(logging.INFO):
@@ -215,6 +257,7 @@ class HttpProtocol:
         record_dicts: list[dict],
         request_id: str,
         deadline: float | None = None,
+        span=None,
     ):
         raise NotImplementedError
 
@@ -231,9 +274,11 @@ class HttpProtocol:
     async def _metrics_endpoint(self):
         raise NotImplementedError
 
-    def _profile(self, action: str):
+    async def _profile(self, action: str):
         # Profiling captures a device trace — only the engine-owning
-        # process can serve it; front ends report it unavailable.
+        # process can serve it; subclasses without a route to the engine
+        # report it unavailable. Async so the ring plane's forward can
+        # await the engine's acknowledgement without blocking the loop.
         return 404, {"detail": "profiling disabled"}, "application/json"
 
     # ----------------------------------------------------------- HTTP layer
@@ -252,6 +297,10 @@ class HttpProtocol:
                 request_line = await reader.readline()
                 if not request_line:
                     break
+                # tracewire span clock zero: the request head is in hand;
+                # everything from here to the socket write lands in a
+                # stage. One time() call per request, only when armed.
+                t_recv = time.monotonic() if self.tracer is not None else 0.0
                 try:
                     method, path, _ = request_line.decode("latin1").split(" ", 2)
                 except ValueError:
@@ -334,11 +383,28 @@ class HttpProtocol:
                     start = time.perf_counter()
                     request_id = self._request_id(headers)
                     route_path = path.split("?", 1)[0]
+                    span = None
+                    if (
+                        self.tracer is not None
+                        and route_path == "/predict"
+                        and method == "POST"
+                    ):
+                        # The request id IS the trace id (inbound
+                        # x-request-id honored, echoed on the response and
+                        # both log events) — one identifier correlates the
+                        # logs, the span record, and the client's retry.
+                        span = Span(
+                            trace_id=request_id,
+                            plane=self.trace_plane,
+                            worker=self.trace_worker,
+                            route=route_path,
+                            t0=t_recv,
+                        )
                     # Routes return (status, payload, content_type) with an
                     # optional 4th element of extra header lines (the shed
                     # path's Retry-After).
                     result = await self._route(
-                        method, route_path, body, request_id, deadline
+                        method, route_path, body, request_id, deadline, span
                     )
                     status, payload, content_type = result[:3]
                     extra_headers = result[3] if len(result) > 3 else None
@@ -349,6 +415,16 @@ class HttpProtocol:
                         writer, status, payload, content_type, keep_alive,
                         request_id=request_id, extra_headers=extra_headers,
                     )
+                    if span is not None and not span.abandoned:
+                        # Respond ends once the bytes are drained to the
+                        # socket — the span's wall clock is the client's
+                        # observed latency minus only kernel delivery.
+                        # Abandoned spans (a timed-out engine call may
+                        # still be stamping from its executor thread) are
+                        # dropped, never finished: finish() must not race
+                        # a concurrent stamp.
+                        span.stamp("respond")
+                        self.tracer.record(span.finish(status))
                 finally:
                     self._busy.discard(writer)
                 if not keep_alive:
@@ -434,11 +510,12 @@ class HttpProtocol:
         body: bytes,
         request_id: str | None = None,
         deadline: float | None = None,
+        span=None,
     ):
         if path == "/predict" and method == "POST":
-            return await self._predict(body, request_id, deadline)
+            return await self._predict(body, request_id, deadline, span)
         if path.startswith("/debug/profile/") and method == "POST":
-            return self._profile(path.removeprefix("/debug/profile/"))
+            return await self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":
             if path == "/":
                 # Interactive Swagger UI (reference parity: FastAPI serves

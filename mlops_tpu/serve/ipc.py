@@ -121,7 +121,13 @@ logger = logging.getLogger("mlops_tpu.serve")
 # anywhere is (conceptually) holding an ``_inflight`` permit while taking
 # a leaf, which the declared order permits.
 TPULINT_LOCK_ORDER = {
-    "RequestRing": ("_submit_lock", "_complete_lock"),
+    # _profile_lock: serializes the /debug/profile claim-LEASE word's
+    # read-check-write only (front ends only — never the engine, never
+    # the request hot path, never held across the ack poll: channel
+    # ownership itself is the shm lease, which expires if its claimant
+    # dies); a leaf like the queue locks (nothing is ever acquired under
+    # it, and it is never taken while a queue lock is held).
+    "RequestRing": ("_submit_lock", "_complete_lock", "_profile_lock"),
     "RingService": ("_inflight", "_mon_lock"),
 }
 TPULINT_CROSS_METHOD_SEMAPHORES = {"RingService": ("_inflight",)}
@@ -258,9 +264,30 @@ class RequestRing:
         self.n_features = D = C + N
         self._nb = len(ServingMetrics.LATENCY_BUCKETS)
 
+        from mlops_tpu.trace.shapes import (
+            TABLE_KEY_BYTES,
+            TABLE_ROWS,
+            TABLE_VALS,
+        )
+
         plan: list[tuple[str, np.dtype, tuple[int, ...]]] = [
-            # control flags: [0] engine_ready, [1] draining
-            ("ctl", np.dtype(np.uint64), (2,)),
+            # control flags: [0] engine_ready, [1] draining, [2] tracing
+            # armed (tracewire — gates every per-slot stamp store)
+            ("ctl", np.dtype(np.uint64), (3,)),
+            # /debug/profile control words (front end -> engine): [0] the
+            # request word (seq << 8 | action), [1] the acknowledgement
+            # (seq << 16 | http status). Each word is ONE u64 store, so
+            # the ack and its status can never tear apart on any memory
+            # model; `_profile_lock` serializes requesting front ends.
+            ("prof_ctl", np.dtype(np.uint64), (2,)),
+            # Profile-channel claim LEASE (monotonic expiry; 0 = free):
+            # the channel's ownership lives in shm, not in the mp lock,
+            # so a front end killed mid-poll releases by expiry instead
+            # of wedging /debug/profile into permanent 409 (the lock is
+            # held only across the microsecond claim-word update — the
+            # same micro-window residual-leak class as the slot busy
+            # flag, vs an unbounded one if it spanned the ack poll).
+            ("prof_claim", np.dtype(np.float64), (1,)),
             # submission queue (MPSC: front ends -> engine collector)
             ("sub_entries", np.dtype(np.uint64), (self.n_slots,)),
             ("sub_head", np.dtype(np.uint64), (1,)),
@@ -286,6 +313,16 @@ class RequestRing:
             ("slot_deadline", np.dtype(np.float64), (self.n_slots,)),
             ("resp_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("resp_status", np.dtype(np.uint32), (self.n_slots,)),
+            # tracewire engine-half span stamps, carried per slot exactly
+            # like slot_deadline: [collect, jobstart, dispatched, fetched]
+            # CLOCK_MONOTONIC stamps plus [kind, geom] naming the compiled
+            # entry (kind 1 = bucket with geom rows; 2 = group with geom
+            # slots*100000+rows). Written by the engine BEFORE the
+            # completion push, read by the owning front end before slot
+            # release — the same ownership window as the response slab,
+            # fenced by the same completion credit. Zeroed unless the
+            # tracing ctl flag is set.
+            ("resp_trace", np.dtype(np.float64), (self.n_slots, 6)),
             # request slabs (front end writes, engine reads)
             ("small_cat", np.dtype(np.int32), (self.n_small, small_rows, C)),
             ("small_num", np.dtype(np.float32), (self.n_small, small_rows, N)),
@@ -314,6 +351,17 @@ class RequestRing:
             # checks answering 504 before a slot submits) — single writer
             # per worker, like the shed counters
             ("expired", np.dtype(np.uint64), (workers,)),
+            # tracewire spans each front end's bounded recorder DROPPED
+            # (single writer per worker, like expired/shed)
+            ("trace_dropped", np.dtype(np.uint64), (workers,)),
+            # tracewire shape-histogram mirror (trace/shapes.py): the
+            # engine's telemetry loop writes its ShapeStats into this
+            # fixed table so ANY front end renders the _bucket series on
+            # a scrape. shape_meta[0] = the stats' armed-at monotonic
+            # time (0 = tracing off), the useful_rows_per_s rate base.
+            ("shape_meta", np.dtype(np.float64), (1,)),
+            ("shape_keys", np.dtype(np.uint8), (TABLE_ROWS, TABLE_KEY_BYTES)),
+            ("shape_vals", np.dtype(np.float64), (TABLE_ROWS, TABLE_VALS)),
             # robustness counters with ENGINE-PROCESS writers (pool
             # threads under RingService._mon_lock): ROB_EXPIRED_ENGINE =
             # descriptors completed RESP_EXPIRED without a dispatch,
@@ -350,6 +398,12 @@ class RequestRing:
         ctx = multiprocessing.get_context("fork")
         self._submit_lock = ctx.Lock()
         self._complete_lock = ctx.Lock()
+        # Serializes updates to the profile claim-lease word (one
+        # outstanding /debug/profile request at a time). Never taken by
+        # the engine, never on any request hot path, held only across
+        # the microsecond lease update (busy/orphaned -> 409) — so it
+        # can neither wedge the plane nor order against the queue locks.
+        self._profile_lock = ctx.Lock()
         self.engine_doorbell = Doorbell()
         self.worker_doorbells = [Doorbell() for _ in range(workers)]
 
@@ -367,6 +421,13 @@ class RequestRing:
 
     def set_draining(self) -> None:
         self.ctl[1] = 1
+
+    @property
+    def tracing(self) -> bool:
+        return bool(self.ctl[2])
+
+    def set_tracing(self, armed: bool) -> None:
+        self.ctl[2] = 1 if armed else 0
 
     # ---------------------------------------------------- slot geometry
     def worker_slots(self, worker: int) -> tuple[range, range]:
@@ -467,6 +528,81 @@ class RequestRing:
             tail += 1
         self.comp_tail[worker] = tail
         return out
+
+    # ---------------------------------------------------- profile control
+    # Claim-lease lifetime: must exceed the front end's ack-poll window
+    # (frontend._PROFILE_ACK_S = 10 s) so a live poller is never usurped;
+    # a dead claimant frees by expiry in this bound.
+    PROFILE_LEASE_S = 15.0
+
+    def try_claim_profile(self) -> float | None:
+        """Non-blocking claim of the profile-request channel (front-end
+        side; busy -> the caller answers 409 without waiting). The claim
+        is a LEASE in shm — a claimant that dies mid-poll expires out
+        instead of holding the channel forever. Returns the claim TOKEN
+        (the lease word this claimant wrote): release/cancel require it,
+        so a claimant stalled PAST its own expiry cannot clobber a
+        successor's live lease or pending request word. The mp lock only
+        serializes the read-check-write of the lease word itself and is
+        never held across the ack poll."""
+        if not self._profile_lock.acquire(timeout=0.2):
+            return None  # contended (or micro-window orphan): busy
+        try:
+            now = time.monotonic()
+            if float(self.prof_claim[0]) > now:
+                return None  # live claim
+            token = now + self.PROFILE_LEASE_S
+            # _profile_lock IS held here — the enclosing timeout-acquire
+            # above, which the static guard inference cannot follow.
+            self.prof_claim[0] = token  # tpulint: disable=TPU402
+            return token
+        finally:
+            self._profile_lock.release()
+
+    def release_profile(self, token: float) -> None:
+        """Free the lease IF it is still this claimant's: after an expiry
+        takeover the stale ex-claimant's release must be a no-op."""
+        with self._profile_lock:
+            if float(self.prof_claim[0]) == token:
+                self.prof_claim[0] = 0.0
+
+    def post_profile_request(self, action_code: int) -> int:
+        """Publish the next profile request word (caller holds the
+        channel LEASE) and wake the engine collector; returns the seq
+        the acknowledgement must echo. The word update rides the same
+        mutex as the cancel path so a stale ex-claimant's token-checked
+        cancel can never interleave with a successor's post."""
+        with self._profile_lock:
+            seq = ((int(self.prof_ctl[0]) >> 8) + 1) & 0xFFFFFFFF
+            if seq == 0:
+                seq = 1  # 0 means "no request yet" to the collector
+            self.prof_ctl[0] = (seq << 8) | (action_code & 0xFF)
+        self.engine_doorbell.ring()
+        return seq
+
+    def read_profile_ack(self, seq: int) -> int | None:
+        """The engine's HTTP status for ``seq``, or None while pending."""
+        resp = int(self.prof_ctl[1])
+        if (resp >> 16) == seq:
+            return resp & 0xFFFF
+        return None
+
+    def cancel_profile_request(self, seq: int, token: float) -> None:
+        """Timed-out ack wait: overwrite the pending request word with a
+        no-op action at the SAME seq before releasing the lease. If the
+        collector has not consumed the original word yet, it now
+        acknowledges a 404 no-op instead of executing a start/stop the
+        client was already told failed (profiler-state desync); keeping
+        the seq preserves the monotone numbering the next request derives
+        from. Token-guarded like `release_profile`: a claimant stalled
+        past its own lease must not clobber a successor's pending word.
+        If the collector read the word in the microseconds before this
+        store, the action still runs — the window shrinks from unbounded
+        to one racy read, and the late ack is ignored (its seq is
+        already abandoned)."""
+        with self._profile_lock:
+            if float(self.prof_claim[0]) == token:
+                self.prof_ctl[0] = (int(seq) << 8) | 0
 
     # ----------------------------------------------------------- monitor
     def write_monitor(self, snapshot: dict[str, Any]) -> None:
@@ -800,6 +936,13 @@ class RingService:
         # render the loop state. Engine-process only; front ends never
         # import the lifecycle package.
         self.lifecycle: Any = None
+        # /debug/profile forwarding (tracewire): the engine process owns
+        # the device, so front ends forward start/stop through the ring's
+        # profile-control word; `profiler` is the engine-side handler
+        # (serve/server.py JaxProfiler.control — set by serve_multi_worker
+        # when serve.profile_dir is configured), None = 404.
+        self.profiler: Any = None
+        self._prof_handled = 0  # collector-thread private
         self._requests_since_fetch = 0  # collector-thread private counter;
         # the telemetry thread only READS it (a torn read costs one fetch
         # of cadence, never correctness — the totals live on device)
@@ -832,15 +975,24 @@ class RingService:
                 logger.exception("final monitor snapshot failed on drain")
         self._write_lifecycle()
         self._write_robustness()
+        self._write_shapes()
 
     # ------------------------------------------------------------ collect
     def _collect(self) -> None:
         ring = self.ring
         while not self._stop.is_set():
+            self._handle_profile()
             descs = ring.pop_submissions()
             if not descs:
                 ring.engine_doorbell.wait(timeout_s=1.0)
                 continue
+            if ring.tracing:
+                # Engine-half span stamp 1: the descriptor left the ring
+                # queue (ring_wait ends). One clock read per pop batch —
+                # the whole batch was popped together.
+                now = time.monotonic()
+                for slot, _ in descs:
+                    ring.resp_trace[slot, 0] = now
             self._requests_since_fetch += len(descs)
             groupable: list[tuple[int, int]] = []
             solo: list[tuple[int, int]] = []
@@ -863,10 +1015,54 @@ class RingService:
                 self._inflight.acquire()
                 self._pool.submit(self._run_job, job)
 
+    def _handle_profile(self) -> None:
+        """Claim a pending /debug/profile request word. Single-word
+        protocol both ways (request = seq<<8 | action, ack = seq<<16 |
+        status), so neither side can observe a half-written exchange on
+        any memory model; the issuing front end holds the profile lease
+        until it sees the ack, so there is exactly one outstanding seq.
+        The profiler call itself runs on the POOL, never here — a slow
+        ``jax.profiler.start_trace`` on the collector thread would stall
+        the plane's only dispatcher and every in-flight request with it;
+        one occupied pool thread just costs capacity."""
+        req = int(self.ring.prof_ctl[0])
+        seq = req >> 8
+        if not seq or seq == self._prof_handled:
+            return
+        self._prof_handled = seq
+        action = {1: "start", 2: "stop"}.get(req & 0xFF)
+        if self.profiler is None or action is None:
+            self._ack_profile(seq, 404)
+        else:
+            self._pool.submit(self._run_profile, seq, action)
+
+    def _run_profile(self, seq: int, action: str) -> None:
+        try:
+            status = int(self.profiler(action)[0])
+        # A profiler bug costs the request a 500, never the pool thread.
+        except Exception:  # tpulint: disable=TPU201
+            logger.exception("ring profile %s failed", action)
+            status = 500
+        self._ack_profile(seq, status)
+
+    def _ack_profile(self, seq: int, status: int) -> None:
+        # Never regress the ack word: an op abandoned by its front end's
+        # timeout acks late (the profiler serializes ops, so acks arrive
+        # in seq order — this guard is the backstop for that invariant,
+        # keeping a stale ack from masking a live op's answer).
+        if seq >= int(self.ring.prof_ctl[1]) >> 16:
+            self.ring.prof_ctl[1] = (seq << 16) | (status & 0xFFFF)
+
     # --------------------------------------------------------------- jobs
     def _run_job(self, job: list[tuple[int, int]]) -> None:
         ring = self.ring
         try:
+            if ring.tracing:
+                # Engine-half span stamp 2: a pool thread owns the job
+                # (engine_queue ends; dispatch begins).
+                now = time.monotonic()
+                for slot, _ in job:
+                    ring.resp_trace[slot, 1] = now
             # Dead-work shedding (ISSUE 9): a descriptor whose deadline
             # budget (slot header, stamped by the front end at submit)
             # ran out while it queued is completed RESP_EXPIRED WITHOUT
@@ -938,6 +1134,7 @@ class RingService:
         (`dispatch_group_arrays` — the arrays come pre-encoded from the
         front ends, so the engine process does zero per-record Python)."""
         ring, engine = self.ring, self.engine
+        tracing = ring.tracing
         parts = []
         for slot, _ in job:
             n = int(ring.slot_n[slot])
@@ -945,6 +1142,8 @@ class RingService:
             parts.append((cat[:n], num[:n]))
         if len(parts) >= 2:
             handle = engine.dispatch_group_arrays(parts)
+            if tracing:
+                self._stamp_dispatched(job, handle, kind=2)
             sizes, preds, outs, drifts = engine.fetch_group_raw(handle)
             raws = [
                 (preds[i, :n], outs[i, :n], drifts[i])
@@ -953,11 +1152,42 @@ class RingService:
         else:
             cat, num = parts[0]
             handle = engine.dispatch_arrays(cat, num)
+            if tracing:
+                self._stamp_dispatched(job, handle, kind=1)
             handle.start_copy()
             raws = [engine.fetch_arrays_raw(handle)]
+        if tracing:
+            # Engine-half span stamp 4: the blocking host copy landed
+            # (device_fetch ends; the remainder to the front end's
+            # "respond" stamp is completion-doorbell wait + formatting).
+            now = time.monotonic()
+            for slot, _ in job:
+                ring.resp_trace[slot, 3] = now
         if not self._accumulating:
             self._fold_host_monitor(raws)
         return raws
+
+    def _stamp_dispatched(
+        self, job: list[tuple[int, int]], handle: Any, kind: int
+    ) -> None:
+        """Engine-half span stamp 3 (device enqueued + async D2H started)
+        plus the compiled-entry encoding the front end decodes back into
+        a name: kind 1 = solo bucket (geom = padded rows), kind 2 = group
+        (geom = slots * 100000 + rows, from the geometry ints the handle
+        carries — degraded-fallback aware, since the engine sets them
+        AFTER choosing the shape that actually served)."""
+        ring = self.ring
+        if kind == 2:
+            geom = int(getattr(handle, "slots", 0)) * 100000 + int(
+                getattr(handle, "rows", 0)
+            )
+        else:
+            geom = int(getattr(handle, "rows", 0))
+        now = time.monotonic()
+        for slot, _ in job:
+            ring.resp_trace[slot, 2] = now
+            ring.resp_trace[slot, 4] = float(kind)
+            ring.resp_trace[slot, 5] = float(geom)
 
     def _fold_host_monitor(
         self, raws: list[tuple[np.ndarray, np.ndarray, np.ndarray]]
@@ -990,6 +1220,7 @@ class RingService:
         while not self._stop.wait(tick):
             self._write_lifecycle()
             self._write_robustness()
+            self._write_shapes()
             due_k = self._mon_every and (
                 self._requests_since_fetch >= self._mon_every
             )
@@ -1017,6 +1248,16 @@ class RingService:
         degraded = getattr(self.engine, "degraded_dispatch_total", 0)
         with self._mon_lock:
             self.ring.rob_vals[ROB_DEGRADED] = float(degraded)
+
+    def _write_shapes(self) -> None:
+        """Mirror the engine's tracewire shape histograms into the ring's
+        fixed table (host counter reads + f64 stores, no device work) so
+        every front end's /metrics renders the _bucket series."""
+        stats = getattr(self.engine, "shape_stats", None)
+        if stats is None:
+            return
+        stats.write_table(self.ring.shape_keys, self.ring.shape_vals)
+        self.ring.shape_meta[0] = stats.t0
 
     def _write_lifecycle(self) -> None:
         """Mirror the attached controller's gauge snapshot into shm (a

@@ -72,6 +72,11 @@ class ServingMetrics:
         # dispatches (mirrored from the engine's counter per scrape).
         self.deadline_expired = 0
         self.degraded_dispatches = 0
+        # tracewire (mlops_tpu/trace/): spans the bounded recorder DROPPED
+        # rather than block the hot path — mirrored from the recorder per
+        # scrape; stays 0 (and still exported) with tracing disarmed so
+        # the chaos smoke's monotonicity check covers it.
+        self.trace_dropped = 0
         # Lifecycle gauges (mlops_tpu/lifecycle/): None until a controller
         # installs a snapshot — the series are only exported when the
         # loop is actually running, so a loop-less deployment's scrape is
@@ -144,8 +149,16 @@ class ServingMetrics:
         with self._lock:
             self.degraded_dispatches = int(total)
 
+    def set_trace_dropped(self, total: int) -> None:
+        """Mirror the trace recorder's drop counter (an absolute total —
+        `trace/recorder.TraceRecorder.dropped`)."""
+        with self._lock:
+            self.trace_dropped = int(total)
+
     @staticmethod
-    def robustness_lines(deadline_expired: int, degraded: int) -> list[str]:
+    def robustness_lines(
+        deadline_expired: int, degraded: int, trace_dropped: int = 0
+    ) -> list[str]:
         """The robustness counter block — ONE definition shared by the
         single-process render and the ring render, so both telemetry
         planes export identical series names. Always emitted (a zero
@@ -155,6 +168,8 @@ class ServingMetrics:
             f"mlops_tpu_deadline_expired_total {int(deadline_expired)}",
             "# TYPE mlops_tpu_degraded_dispatch_total counter",
             f"mlops_tpu_degraded_dispatch_total {int(degraded)}",
+            "# TYPE mlops_tpu_trace_dropped_total counter",
+            f"mlops_tpu_trace_dropped_total {int(trace_dropped)}",
         ]
 
     @staticmethod
@@ -257,7 +272,9 @@ class ServingMetrics:
                 )
             lines.extend(
                 self.robustness_lines(
-                    self.deadline_expired, self.degraded_dispatches
+                    self.deadline_expired,
+                    self.degraded_dispatches,
+                    self.trace_dropped,
                 )
             )
             lines.extend(self.lifecycle_lines(self.lifecycle))
@@ -374,8 +391,24 @@ def render_ring_metrics(ring) -> str:
         ServingMetrics.robustness_lines(
             int(ring.expired.sum()) + int(ring.rob_vals[ROB_EXPIRED_ENGINE]),
             int(ring.rob_vals[ROB_DEGRADED]),
+            int(ring.trace_dropped.sum()),
         )
     )
+    if float(ring.shape_meta[0]) > 0:
+        # tracewire shape histograms, mirrored from the engine process's
+        # ShapeStats by the telemetry loop (shape_meta[0] = the stats'
+        # armed-at monotonic time, the useful_rows_per_s rate base) —
+        # identical series names to the single-process render
+        # (trace/shapes.py `_lines` is the one formatter).
+        from mlops_tpu.trace.shapes import render_table_lines
+
+        lines.extend(
+            render_table_lines(
+                ring.shape_keys,
+                ring.shape_vals,
+                time.monotonic() - float(ring.shape_meta[0]),
+            )
+        )
     if ring.life_vals[LIFE_HAS]:
         # Lifecycle block, rebuilt as a snapshot dict so the SAME
         # formatter emits it (identical series names across planes; any

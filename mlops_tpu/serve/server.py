@@ -15,6 +15,7 @@ import asyncio
 import concurrent.futures
 import contextlib
 import logging
+import threading
 
 from mlops_tpu.config import ServeConfig
 from mlops_tpu.serve.batcher import MicroBatcher
@@ -30,11 +31,72 @@ from mlops_tpu.serve.httpcore import (  # noqa: F401  (re-exports)
     _LazyJson,
     _dumps,
     deadline_response,
+    profile_payload,
 )
 from mlops_tpu.serve.metrics import ServingMetrics
 from mlops_tpu.serve.wire import DeadlineExceeded
 
 logger = logging.getLogger("mlops_tpu.serve")
+
+
+# tpulint Layer-3 manifest: JaxProfiler's one leaf lock serializes
+# control() calls — debug-endpoint cadence only, never a request path.
+TPULINT_LOCK_ORDER = {"JaxProfiler": ("_lock",)}
+
+
+class JaxProfiler:
+    """`jax.profiler` start/stop control for whichever process owns the
+    device: the single-process server drives it from its /debug/profile
+    routes; on the multi-worker plane the ENGINE process drives it from
+    the ring's profile-control word (serve/ipc.py — front ends own no
+    device, so they forward). Returns HTTP statuses; the payload shapes
+    live in `httpcore.profile_payload` so both planes answer
+    identically. ``_lock`` serializes calls: on the ring plane ops run
+    on pool threads, and a front end whose ack wait timed out releases
+    the channel lease while the consumed op may still be executing — a
+    second client's op must queue behind it, not interleave with the
+    unsynchronized ``_running`` state (serialized execution also keeps
+    ack words in seq order). Holding a lock across a slow profiler call
+    is the point here: it blocks only the next profile op, never a
+    request."""
+
+    def __init__(self, profile_dir: str) -> None:
+        self.profile_dir = profile_dir
+        self._running = False
+        self._lock = threading.Lock()
+
+    def control(self, action: str) -> tuple[int, str | None]:
+        """-> (status, error-detail-or-None). Callers pre-filter unknown
+        actions to their own 'not found'; the guard here keeps a bogus
+        action from paying the jax import or touching profiler state."""
+        if action not in ("start", "stop") or not self.profile_dir:
+            return 404, None
+        import jax
+
+        with self._lock:
+            return self._control_locked(jax, action)
+
+    def _control_locked(self, jax, action: str) -> tuple[int, str | None]:
+        try:
+            if action == "start":
+                if self._running:
+                    return 409, None
+                jax.profiler.start_trace(self.profile_dir)
+                self._running = True
+                return 200, None
+            if action == "stop":
+                if not self._running:
+                    return 409, None
+                jax.profiler.stop_trace()
+                self._running = False
+                return 200, None
+        # Unwritable dir, profiler state errors: logged + reported as a
+        # 500 body, never a dropped connection on a debug endpoint.
+        except Exception as err:  # tpulint: disable=TPU201
+            logger.exception("profiler %s failed", action)
+            self._running = False
+            return 500, str(err)
+        return 404, None
 
 
 class HttpServer(HttpProtocol):
@@ -78,7 +140,7 @@ class HttpServer(HttpProtocol):
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="predict"
         )
-        self._profiling = False
+        self._profiler = JaxProfiler(config.profile_dir)
         # Device-resident monitor aggregate telemetry (serve/engine.py
         # monitor_snapshot): the request path only counts requests; the
         # aggregate is fetched OFF the hot path — after K requests, on the
@@ -160,46 +222,43 @@ class HttpServer(HttpProtocol):
         self.metrics.set_degraded(
             getattr(self.engine, "degraded_dispatch_total", 0)
         )
-        return 200, self.metrics.render(), "text/plain; version=0.0.4"
+        if self.tracer is not None:
+            self.metrics.set_trace_dropped(self.tracer.dropped)
+        text = self.metrics.render()
+        shape_stats = getattr(self.engine, "shape_stats", None)
+        if shape_stats is not None:
+            # tracewire shape histograms (trace/shapes.py): the same
+            # series names the ring renderer emits from its shm mirror.
+            lines = shape_stats.render_lines()
+            if lines:
+                text += "\n".join(lines) + "\n"
+        return 200, text, "text/plain; version=0.0.4"
 
-    def _profile(self, action: str):
+    async def _profile(self, action: str):
         """On-demand device tracing (SURVEY.md SS5.1: the reference has no
         profiler at all; here the serving process can capture a
-        ``jax.profiler`` trace of live traffic for TensorBoard)."""
-        if not self.config.profile_dir:
-            return 404, {"detail": "profiling disabled"}, "application/json"
-        import jax
-
-        try:
-            if action == "start":
-                if self._profiling:
-                    return 409, {"detail": "trace already running"}, "application/json"
-                jax.profiler.start_trace(self.config.profile_dir)
-                self._profiling = True
-                return 200, {"status": "tracing", "dir": self.config.profile_dir}, "application/json"
-            if action == "stop":
-                if not self._profiling:
-                    return 409, {"detail": "no trace running"}, "application/json"
-                jax.profiler.stop_trace()
-                self._profiling = False
-                return 200, {"status": "stopped", "dir": self.config.profile_dir}, "application/json"
-        # Unwritable dir, profiler state errors: logged + reported as a
-        # 500 body, never a dropped connection on a debug endpoint.
-        except Exception as err:  # tpulint: disable=TPU201
-            logger.exception("profiler %s failed", action)
-            self._profiling = False
-            return 500, {"detail": f"profiler {action} failed: {err}"}, "application/json"
-        return 404, {"detail": "not found"}, "application/json"
+        ``jax.profiler`` trace of live traffic for TensorBoard). The
+        start/stop state machine and wire shapes are shared with the
+        multi-worker plane (`JaxProfiler` + `profile_payload`) — the ring
+        front ends forward to the engine process's twin of this."""
+        if action not in ("start", "stop"):
+            # Same body as the ring front end's unknown-action answer —
+            # distinct from the 'profiling disabled' 404.
+            return 404, {"detail": "not found"}, "application/json"
+        status, err = self._profiler.control(action)
+        return profile_payload(status, action, self.config.profile_dir, err)
 
     async def _score(
         self,
         record_dicts: list[dict],
         request_id: str,
         deadline: float | None = None,
+        span=None,
     ):
         """The single-process scoring hook under the shared `_predict`
         shell (serve/httpcore.py): micro-batcher -> engine, with the
-        deadline and failure contracts."""
+        deadline and failure contracts. ``span`` (tracewire) rides into
+        the batcher/engine for the queue/encode/dispatch/fetch stamps."""
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
@@ -215,7 +274,14 @@ class HttpServer(HttpProtocol):
             if deadline is not None:
                 remaining = deadline - asyncio.get_running_loop().time()
                 timeout = min(timeout or remaining, remaining)
-            call = self.batcher.predict(record_dicts, deadline=deadline)
+            # Disarmed call shape unchanged (test stubs pin it): the span
+            # kwarg only appears when tracing armed it.
+            if span is None:
+                call = self.batcher.predict(record_dicts, deadline=deadline)
+            else:
+                call = self.batcher.predict(
+                    record_dicts, deadline=deadline, span=span
+                )
             if timeout is not None:
                 response = await asyncio.wait_for(call, max(timeout, 0.0))
             else:
@@ -224,6 +290,8 @@ class HttpServer(HttpProtocol):
             # Engine-side shed: the batcher's claim-time purge found the
             # budget already spent and never dispatched — count the dead
             # work it avoided; the wire answer is the same documented 504.
+            # (The purge completed the entry before any dispatch task saw
+            # it, so nothing else holds the span — no abandon needed.)
             self.metrics.count_deadline_expired()
             return deadline_response()
         except asyncio.TimeoutError:
@@ -233,6 +301,11 @@ class HttpServer(HttpProtocol):
                 timeout,
                 request_id,
             )
+            if span is not None:
+                # The engine call keeps running in its executor thread and
+                # may still stamp this span: hand it over entirely (never
+                # finish/record a span another thread can be writing).
+                span.abandoned = True
             return deadline_response(
                 f"prediction exceeded the {timeout:g}s deadline"
             )
@@ -242,6 +315,8 @@ class HttpServer(HttpProtocol):
         # the traceback.
         except Exception:  # tpulint: disable=TPU201
             logger.exception("prediction failed request_id=%s", request_id)
+            if span is not None:
+                span.abandoned = True  # a grouped dispatch may outlive us
             return 500, {"detail": "prediction failed"}, "application/json"
         if self._monitor_accumulating:
             # Monitor totals are folded ON DEVICE inside the fused predict
@@ -333,9 +408,27 @@ class HttpServer(HttpProtocol):
 
 
 async def _serve(
-    engine: InferenceEngine, config: ServeConfig, lifecycle=None
+    engine: InferenceEngine, config: ServeConfig, lifecycle=None, trace=None
 ) -> None:
     server = HttpServer(engine, config, lifecycle=lifecycle)
+    tracer = None
+    if trace is not None and trace.enabled:
+        # tracewire (mlops_tpu/trace/): spans to <trace.dir>/spans.jsonl,
+        # shape histograms on the engine, both gated here — a disabled
+        # trace section leaves every hot path at its is-None check.
+        from pathlib import Path
+
+        from mlops_tpu.trace import ShapeStats, TraceRecorder
+
+        trace.validate()
+        tracer = TraceRecorder(
+            Path(trace.dir) / "spans.jsonl",
+            capacity=trace.ring_capacity,
+            flush_interval_s=trace.flush_interval_s,
+        )
+        server.tracer = tracer
+        engine.set_shape_stats(ShapeStats())
+        logger.info("tracewire armed; spans -> %s", tracer.path)
     srv = await server.start()
     logger.info(
         "serving %s on %s:%s", config.service_name, config.host, config.port
@@ -430,14 +523,23 @@ async def _serve(
             with contextlib.suppress(asyncio.TimeoutError):
                 await asyncio.wait_for(srv.wait_closed(), timeout=5)
             logger.info("drained; exiting")
+        if tracer is not None:
+            # AFTER the busy-drain window: every exchange that finished
+            # its response has recorded its span. close() joins the
+            # writer thread — run it in the executor so the final flush
+            # never blocks the event loop.
+            await loop.run_in_executor(None, tracer.close)
     if warmup_error:
         raise SystemExit(f"warmup failed: {warmup_error[0]}")
 
 
 def serve_forever(
-    engine: InferenceEngine, config: ServeConfig, lifecycle=None
+    engine: InferenceEngine, config: ServeConfig, lifecycle=None, trace=None
 ) -> None:
     """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`).
     ``lifecycle`` is an optional `LifecycleController`: started once
-    warmup completes, drained on shutdown, gauges on /metrics."""
-    asyncio.run(_serve(engine, config, lifecycle=lifecycle))
+    warmup completes, drained on shutdown, gauges on /metrics. ``trace``
+    is the optional `TraceConfig` section: enabled, every /predict
+    request records a stage span to <trace.dir>/spans.jsonl and the
+    engine exports shape histograms (mlops_tpu/trace/)."""
+    asyncio.run(_serve(engine, config, lifecycle=lifecycle, trace=trace))

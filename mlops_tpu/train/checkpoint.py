@@ -135,8 +135,9 @@ def load_checkpoint(directory: str | Path, target: Any) -> tuple[Any, int] | Non
         warnings.warn(
             f"{len(failures)} checkpoint(s) in {directory} failed to "
             f"restore (first: {path.name}: {err}); training restarts from "
-            "step 0 — if the TrainState shape changed (e.g. ema_decay "
-            "toggled), resume with the original settings or clear the "
+            "step 0 — if the TrainState shape changed (most commonly "
+            "train.ema_decay toggled between runs, which adds/removes the "
+            "ema field), resume with the original settings or clear the "
             "checkpoint dir",
             stacklevel=2,
         )

@@ -34,12 +34,20 @@ class StageClock:
 
     Thread-safe: each stage runs on its own thread, and the executor's
     serial mode shares one clock across all stages on the caller thread.
+
+    ``sink`` (optional) streams every completed stage as a span event —
+    ``sink(name, start_perf_counter, elapsed_s, items)`` — into the
+    tracewire layer (`trace/recorder.py TraceRecorder.stage_sink`), so
+    pipeline/bulk stage timings land in the same queryable JSONL as
+    request spans. Called OUTSIDE the lock; the tracewire sink is a
+    bounded non-blocking enqueue, never I/O on this thread.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, sink=None) -> None:
         self._lock = threading.Lock()
         self._busy: dict[str, float] = {}
         self._items: dict[str, int] = {}
+        self._sink = sink
 
     @contextlib.contextmanager
     def stage(self, name: str, items: int = 1):
@@ -51,6 +59,8 @@ class StageClock:
             with self._lock:
                 self._busy[name] = self._busy.get(name, 0.0) + elapsed
                 self._items[name] = self._items.get(name, 0) + items
+            if self._sink is not None:
+                self._sink(name, start, elapsed, items)
 
     def report(self, wall_s: float) -> dict[str, dict[str, float]]:
         with self._lock:

@@ -53,15 +53,22 @@ def get(url: str, timeout: float = 10.0):
         return resp.status, resp.read()
 
 
-def post_predict(port: int, results: list, idx: int) -> None:
+def post_predict(
+    port: int, results: list, idx: int, request_id: str | None = None
+) -> None:
     body = json.dumps([RECORD]).encode()
+    headers = {"content-type": "application/json"}
+    if request_id:
+        headers["x-request-id"] = request_id
     req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/predict",
-        data=body,
-        headers={"content-type": "application/json"},
+        f"http://127.0.0.1:{port}/predict", data=body, headers=headers
     )
     with urllib.request.urlopen(req, timeout=60) as resp:
-        results[idx] = (resp.status, json.loads(resp.read()))
+        results[idx] = (
+            resp.status,
+            json.loads(resp.read()),
+            resp.headers.get("x-request-id"),
+        )
 
 
 def main() -> int:
@@ -88,12 +95,14 @@ def main() -> int:
     print(f"# serve-smoke: bundle at {bundle}", flush=True)
 
     port = free_port()
+    trace_dir = os.path.join(tmp, "traces")
     server = subprocess.Popen(
         [
             sys.executable, "-m", "mlops_tpu", "serve", "--workers", "2",
             "serve.host=127.0.0.1", f"serve.port={port}",
             f"serve.model_directory={bundle}",
             "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+            "trace.enabled=true", f"trace.dir={trace_dir}",
         ],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -125,22 +134,28 @@ def main() -> int:
 
         results: list = [None, None]
         threads = [
-            threading.Thread(target=post_predict, args=(port, results, i))
+            threading.Thread(
+                target=post_predict,
+                args=(port, results, i, f"smoke-trace-{i}"),
+            )
             for i in range(2)
         ]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=90)
-        for status, payload in results:
+        for i, (status, payload, trace_id) in enumerate(results):
             assert status == 200, results
             assert set(payload) == {
                 "predictions", "outliers", "feature_drift_batch"
             }, payload
             assert len(payload["predictions"]) == 1
+            # tracewire: the inbound x-request-id echoes on the response.
+            assert trace_id == f"smoke-trace-{i}", results
         # Identical requests -> identical responses across connections
         # (and therefore across whichever workers served them).
         assert results[0][1] == results[1][1], results
+        print("# serve-smoke: trace ids echoed on both predicts", flush=True)
 
         status, body = get(f"http://127.0.0.1:{port}/metrics", 30)
         text = body.decode()
@@ -189,6 +204,26 @@ def main() -> int:
         assert "drained" in log, log[-2000:]
         assert "Task was destroyed" not in log, log[-2000:]
         assert "Traceback" not in log, log[-4000:]
+        # tracewire: the drain flushed each worker's span JSONL — every
+        # line parses (no torn records) and the smoke's trace ids appear
+        # as stitched ring-plane spans.
+        span_files = [
+            os.path.join(trace_dir, f)
+            for f in os.listdir(trace_dir)
+            if f.startswith("spans-w") and f.endswith(".jsonl")
+        ]
+        assert span_files, f"no span JSONL under {trace_dir}"
+        spans = []
+        for path in span_files:
+            with open(path) as f:
+                for line in f:
+                    spans.append(json.loads(line))  # torn line -> raises
+        smoke_ids = {
+            s["trace_id"] for s in spans if s.get("kind") == "span"
+        }
+        assert {"smoke-trace-0", "smoke-trace-1"} <= smoke_ids, smoke_ids
+        print(f"# serve-smoke: {len(spans)} spans parsed clean from "
+              f"{len(span_files)} worker files", flush=True)
         print("# serve-smoke: OK (clean drain, zero leaked tasks)",
               flush=True)
         return 0

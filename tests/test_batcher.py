@@ -293,12 +293,12 @@ def test_abandoned_requests_are_purged_at_claim_time(engine, sample_request):
         for _ in range(5):
             dead = loop.create_future()
             dead.cancel()
-            batcher._pending.append(([sample_request[0]], dead, None))
+            batcher._pending.append(([sample_request[0]], dead, None, None))
         live = asyncio.create_task(batcher.predict([sample_request[0]]))
         response = await asyncio.wait_for(live, timeout=30)
         assert 0.0 <= response["predictions"][0] <= 1.0
         # the dead entries did not survive the claim
-        assert all(not f.cancelled() for _, f, _ in batcher._pending)
+        assert all(not f.cancelled() for _, f, _, _ in batcher._pending)
         executor.shutdown(wait=False)
 
     asyncio.run(run())

@@ -61,11 +61,15 @@ def multi_worker_plane(
     slots_small=8,
     slots_large=2,
     service_kwargs=None,
+    trace=None,
     **cfg_kwargs,
 ):
     """The production topology with the engine half hosted in this
     process (exactly what `serve_multi_worker` builds, minus the bundle
-    load): forked SO_REUSEPORT front ends + ring + RingService."""
+    load): forked SO_REUSEPORT front ends + ring + RingService.
+    ``trace`` (a TraceConfig) arms tracewire exactly like
+    serve_multi_worker: shm tracing flag before fork, per-worker span
+    recorders in the children."""
     cfg_kwargs.setdefault("max_batch", 64)
     cfg = ServeConfig(
         host="127.0.0.1",
@@ -81,9 +85,12 @@ def multi_worker_plane(
         slots_large=slots_large,
         large_rows=cfg.max_batch,
     )
+    if trace is not None and trace.enabled:
+        os.makedirs(trace.dir, exist_ok=True)
+        ring.set_tracing(True)
     placeholder = reuseport_socket(cfg.host, cfg.port)
     child_cfg = dataclasses.replace(cfg, port=placeholder.getsockname()[1])
-    procs = start_frontends(child_cfg, ring, prep_path)
+    procs = start_frontends(child_cfg, ring, prep_path, trace)
     service = RingService(
         engine,
         ring,
@@ -126,10 +133,11 @@ def _wait_accepting(port, timeout=15.0):
 
 
 @contextlib.contextmanager
-def single_process_server(engine, **cfg_kwargs):
+def single_process_server(engine, tracer=None, **cfg_kwargs):
     """The 1-worker baseline: the in-process HttpServer on a background
     event-loop thread, addressable through the same blocking-socket
-    client as the multi-worker plane."""
+    client as the multi-worker plane. ``tracer`` (a TraceRecorder) arms
+    tracewire spans the way _serve's trace wiring would."""
     import asyncio
 
     from mlops_tpu.serve.server import HttpServer
@@ -142,6 +150,7 @@ def single_process_server(engine, **cfg_kwargs):
         server = HttpServer(
             engine, ServeConfig(host="127.0.0.1", port=0, **cfg_kwargs)
         )
+        server.tracer = tracer
         srv = await server.start()
         holder["port"] = srv.sockets[0].getsockname()[1]
         holder["stop"] = asyncio.Event()

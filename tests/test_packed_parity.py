@@ -426,3 +426,13 @@ def test_bench_breakdown_and_monitor_keys(engine, sample_request):
 
     assert not faults_mod.armed()  # the stage disarms on every path
     assert ("bucket", 8) in engine._exec  # the popped entry was restored
+    # tracewire keys (ISSUE 10): armed-vs-disarmed overhead is a real
+    # percentage (generous noise bound, same discipline as the faults
+    # key), and the skewed synthetic trace produces a nonzero padding
+    # waste with a positive goodput rate. The stage must disarm the
+    # engine's shape stats on every path.
+    trace_stats = bench._trace_stage(engine, sample_request[0])
+    assert -50.0 < trace_stats["trace_overhead_pct"] < 50.0
+    assert 0.0 < trace_stats["padding_waste_pct"] < 100.0
+    assert trace_stats["useful_rows_per_s"] > 0
+    assert engine.shape_stats is None  # disarmed after the stage

@@ -139,7 +139,8 @@ def test_ema_toggle_restore_mismatch_warns_loudly(tmp_path):
         str(w.message) for w in caught if "failed to restore" in str(w.message)
     ]
     assert len(relevant) == 1
-    assert "ema_decay" in relevant[0]
+    # The FULL config key: an operator greps the warning, finds the knob.
+    assert "train.ema_decay" in relevant[0]
     assert str(tmp_path / "c") in relevant[0]
 
 
