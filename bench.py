@@ -1162,6 +1162,187 @@ def _http_multi_stage(engine, bundle, record, base: dict) -> dict:
     return out
 
 
+def _respawn_stage(bundle_dir: str, record) -> dict:
+    """Survivable-engine evidence (ISSUE 11): boot the REAL 2-worker
+    plane as a subprocess, hammer batch-1 requests carrying a generous
+    deadline budget, SIGKILL the ENGINE process mid-run, and measure the
+    brownout. ``engine_respawn_gap_ms`` is the headline: p99 latency of
+    the PARKED requests (in flight or admitted during the outage,
+    answered 200 by the respawned engine's replay) — what a client
+    actually experiences across an engine death. The plane serves from a
+    dedicated AOT cache dir so the respawn warm-starts by deserializing
+    (the deployment-shape fast path, not a cold recompile)."""
+    import re
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    body = json.dumps([record]).encode()
+    head = (
+        "POST /predict HTTP/1.1\r\nhost: bench\r\n"
+        "content-type: application/json\r\n"
+        "x-request-deadline-ms: 90000\r\n"
+        f"content-length: {len(body)}\r\nconnection: close\r\n\r\n"
+    ).encode() + body
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def exchange(payload: bytes, timeout: float = 120.0) -> int:
+        with socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout
+        ) as sock:
+            sock.settimeout(timeout)
+            sock.sendall(payload)
+            data = b""
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        parts = data.split(b" ")
+        if len(parts) < 2 or not parts[1].isdigit():
+            # A connection severed pre-status (brownout churn, drain):
+            # surface as the OSError class every caller already retries.
+            raise OSError("short/torn HTTP response")
+        return int(parts[1])
+
+    def ready() -> bool:
+        try:
+            return (
+                exchange(
+                    b"GET /healthz/ready HTTP/1.1\r\nhost: b\r\n"
+                    b"connection: close\r\n\r\n",
+                    timeout=5.0,
+                )
+                == 200
+            )
+        except OSError:
+            return False
+
+    with tempfile.TemporaryDirectory() as td:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "mlops_tpu", "serve", "--workers",
+                "2", "serve.host=127.0.0.1", f"serve.port={port}",
+                f"serve.model_directory={bundle_dir}",
+                "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+                "serve.request_timeout_s=120",
+                f"cache.dir={os.path.join(td, 'cache')}",
+                "serve.drain_deadline_s=8",
+                "serve.zygote_join_deadline_s=10",
+                "serve.engine_zygote_join_s=16",
+            ],
+            cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        log_lines: list[str] = []
+        pump = threading.Thread(
+            target=lambda: log_lines.extend(
+                iter(proc.stdout.readline, "")
+            ),
+            daemon=True,
+        )
+        pump.start()
+        results: list[tuple[float, float, int]] = []  # (start, wall_s, st)
+        lock = threading.Lock()
+        stop = threading.Event()
+        clock = time.perf_counter
+        try:
+            deadline = time.time() + 600
+            while time.time() < deadline and not ready():
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        "respawn-stage plane died before readiness: "
+                        + "\n".join(log_lines[-25:])
+                    )
+                time.sleep(0.5)
+            if not ready():
+                raise RuntimeError("respawn-stage plane never ready")
+            engine_line = next(
+                line for line in log_lines if "engine pid" in line
+            )
+            engine_pid = int(
+                re.search(r"engine pid (\d+)", engine_line).group(1)
+            )
+
+            def hammer() -> None:
+                while not stop.is_set():
+                    t0 = clock()
+                    try:
+                        status = exchange(head)
+                    except OSError:
+                        continue
+                    with lock:
+                        results.append((t0, clock() - t0, status))
+
+            threads = [
+                threading.Thread(target=hammer) for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(3.0)  # steady state
+            kill_t = clock()
+            os.kill(engine_pid, signal.SIGKILL)
+            # Recovery = the first 200 that STARTED after the kill has
+            # completed (the respawned engine is serving fresh traffic).
+            recover_t = None
+            deadline = time.time() + 300
+            while time.time() < deadline and recover_t is None:
+                time.sleep(0.25)
+                with lock:
+                    done = [
+                        (t0, wall) for t0, wall, st in results
+                        if st == 200 and t0 > kill_t
+                    ]
+                if done:
+                    recover_t = min(t0 + wall for t0, wall in done)
+            if recover_t is None:
+                raise RuntimeError("plane never recovered after the kill")
+            time.sleep(2.0)  # post-recovery tail for the latency picture
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            stop.set()
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+    with lock:
+        snapshot = list(results)
+    statuses: dict[str, int] = {}
+    for _, _, st in snapshot:
+        statuses[str(st)] = statuses.get(str(st), 0) + 1
+    illegal = [st for st in statuses if st not in ("200", "503", "504")]
+    if illegal:
+        raise RuntimeError(
+            f"statuses outside the brownout contract: {statuses}"
+        )
+    # Parked = answered 200 AND the request's lifetime overlapped the
+    # outage window [kill, recovery].
+    parked = sorted(
+        wall * 1e3
+        for t0, wall, st in snapshot
+        if st == 200 and t0 <= recover_t and t0 + wall >= kill_t
+    )
+    outage_ms = (recover_t - kill_t) * 1e3
+    gap_ms = _percentile(parked, 99) if parked else outage_ms
+    return {
+        "engine_respawn_gap_ms": round(gap_ms, 1),
+        "engine_respawn_outage_ms": round(outage_ms, 1),
+        "engine_respawn_parked": len(parked),
+        "engine_respawn_statuses": statuses,
+    }
+
+
 def _lifecycle_stage(engine, bundle, record) -> dict:
     """Closed-loop lifecycle evidence (mlops_tpu/lifecycle/) on a
     synthetic drift-injected trace:
@@ -1527,6 +1708,14 @@ def main() -> None:
         http.update(_http_multi_stage(engine, bundle, record, http))
     except Exception as err:
         http["http_multi_error"] = f"{type(err).__name__}: {err}"
+    _note("engine respawn stage (kill -9 the engine under load)")
+    try:
+        # Survivable-engine evidence (ISSUE 11), guarded like the other
+        # plane stages: a fork/port quirk must not cost the run its
+        # headline numbers.
+        http.update(_respawn_stage(result.bundle_dir, record))
+    except Exception as err:
+        http["engine_respawn_error"] = f"{type(err).__name__}: {err}"
     _note("lifecycle stage (drift-inject -> retrain -> hot swap)")
     try:
         # LAST stage by contract: the gated promotion swaps the live

@@ -514,9 +514,6 @@ def _serve(config) -> int:
     import logging
     import os
 
-    from mlops_tpu.bundle import load_bundle
-    from mlops_tpu.serve import InferenceEngine, serve_forever
-
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     model_dir = os.environ.get("MODEL_DIRECTORY", config.serve.model_directory)
     config.serve.service_name = os.environ.get(
@@ -528,14 +525,19 @@ def _serve(config) -> int:
     config.serve.validate()
     config.trace.validate()
     if config.serve.workers > 1:
-        # Multi-worker plane: N SO_REUSEPORT front-end processes feeding
-        # this process's engine over the shared-memory ring
-        # (serve/frontend.py). The front ends fork inside
-        # serve_multi_worker BEFORE the bundle/backend loads.
+        # Multi-worker plane: N SO_REUSEPORT front-end processes + one
+        # ENGINE child process, all forked and supervised by this
+        # (jax-free) parent over the shared-memory ring
+        # (serve/frontend.py). Nothing jax-flavored may import before
+        # this branch: the supervisor must stay thread-free and
+        # backend-free so every fork — initial and respawn, front end
+        # and engine — is safe.
         from mlops_tpu.serve.frontend import serve_multi_worker
 
         return serve_multi_worker(config, _resolve_bundle(config, model_dir))
+    from mlops_tpu.bundle import load_bundle
     from mlops_tpu.compilecache.cache import from_config
+    from mlops_tpu.serve import InferenceEngine, serve_forever
 
     bundle = load_bundle(_resolve_bundle(config, model_dir))
     engine = InferenceEngine(

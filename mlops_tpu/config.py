@@ -239,14 +239,25 @@ class ServeConfig:
     # force-closing connections. Tune DOWN for chaos scenarios that
     # should converge fast, UP for slow CI boxes; keep it under the pod's
     # terminationGracePeriodSeconds (the hard stop)
-    zygote_join_deadline_s: float = 35.0  # zygote shutdown: ONE shared
-    # wall-clock budget for joining all front-end children after the
-    # SIGTERM forward (they drain concurrently; stragglers past it are
-    # SIGKILLed). Must cover drain_deadline_s plus respawn slack
-    engine_zygote_join_s: float = 50.0  # engine-process drain: how long
-    # serve_multi_worker waits for the zygote (which is itself joining
-    # children against zygote_join_deadline_s, +5 s kill grace) before
-    # escalating to SIGKILL. Must exceed zygote_join_deadline_s + 5
+    zygote_join_deadline_s: float = 35.0  # supervisor shutdown: ONE
+    # shared wall-clock budget for joining all front-end children after
+    # the SIGTERM forward (they drain concurrently; stragglers past it
+    # are SIGKILLed). Must cover drain_deadline_s plus respawn slack.
+    # (Name kept from the PR 6 zygote model for config stability; the
+    # supervisor absorbed the zygote's role in ISSUE 11.)
+    engine_zygote_join_s: float = 50.0  # engine-child drain: how long
+    # the supervisor waits for the engine process (SIGTERMed AFTER the
+    # front ends joined — their in-flight slots need a live engine)
+    # before escalating to SIGKILL. Must exceed zygote_join_deadline_s
+    # + 5 so a cleanly-draining plane is never cut short end to end
+    engine_respawn_eta_s: float = 5.0  # brownout contract (ISSUE 11): the
+    # Retry-After a front end advertises on a 503 shed while the ENGINE
+    # process is down and the parking partition is full — the estimated
+    # detect -> fork -> cached-warmup -> replay wall time, minus however
+    # long the engine has already been down. Tune to the measured warm
+    # re-attach on the deployment box (bench: engine_respawn_gap_ms);
+    # too low hammers retries into the still-full parking lot, too high
+    # parks well-behaved clients longer than the outage
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable
@@ -312,6 +323,12 @@ class ServeConfig:
                     f"serve.shed_retry_after_s={self.shed_retry_after_s} "
                     "must be >= 1 (the shed 503 contract promises a "
                     "positive Retry-After)"
+                )
+            if self.engine_respawn_eta_s <= 0:
+                problems.append(
+                    f"serve.engine_respawn_eta_s={self.engine_respawn_eta_s}"
+                    " must be > 0 (the brownout 503 contract promises a "
+                    "positive respawn-ETA Retry-After)"
                 )
         if problems:
             raise ServeConfigError("; ".join(problems))

@@ -39,7 +39,13 @@ POINTS: dict[str, str] = {
     "serve.engine.compile": "inside the novel-shape AOT compile "
     "(raise = compile/cache failure -> degraded next-bucket dispatch)",
     "serve.frontend.predict": "front-end predict entry on the ring plane "
-    "(kill = worker crash mid-request; the zygote respawn path)",
+    "(kill = worker crash mid-request; the supervisor respawn path)",
+    "serve.engine.exit": "each tick of the engine child's main loop "
+    "(kill = deterministic in-process engine death -> supervisor respawn "
+    "brownout; raise = engine main-loop failure, same recovery)",
+    "serve.ring.reattach": "entry of the respawned engine's ring "
+    "re-attach (delay = a slow re-attach stretching the brownout window; "
+    "raise = failed re-attach -> engine exits, supervisor retries)",
     "compilecache.read": "artifact bytes on cache read "
     "(corrupt = bit flips -> checksum discard + recompile)",
     "compilecache.persist.midwrite": "between the cache artifact's tmp "

@@ -538,6 +538,37 @@ class InferenceEngine:
                 self.bundle_generation += 1
         return self.bundle_generation
 
+    def seed_monitor_totals(
+        self,
+        rows: float,
+        outliers: float,
+        batches: float,
+        drift_sum,
+        drift_last,
+    ) -> None:
+        """Install absolute monitor totals from a previous engine
+        incarnation (ISSUE 11 — the shm mon block survives an engine
+        ``kill -9``; the respawned process seeds its exact host-side f64
+        totals from it so `monitor_snapshot` — and therefore every
+        exported counter — stays MONOTONE across the respawn instead of
+        restarting from zero). The accumulator window the dead process
+        never fetched is gone (bounded by the telemetry cadence) and is
+        counted by the caller in ``monitor_rows_lost_total``, never
+        silently absorbed."""
+        if not self._accumulate:
+            return
+        # Materialize the host copies OUTSIDE the lock (TPU403: the
+        # critical section is ref assignment only, like monitor_snapshot).
+        seeded_sum = np.array(drift_sum, dtype=np.float64)
+        seeded_last = np.array(drift_last, dtype=np.float64)
+        with self._totals_lock:
+            t = self._totals
+            t["rows"] = float(rows)
+            t["outliers"] = float(outliers)
+            t["batches"] = float(batches)
+            t["drift_sum"] = seeded_sum
+            t["drift_last"] = seeded_last
+
     def monitor_snapshot(self) -> dict[str, Any]:
         """ONE device->host fetch of the monitor aggregate — the telemetry
         read path (`serve/server.py` calls it every K requests / T

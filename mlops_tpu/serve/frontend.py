@@ -10,31 +10,38 @@ over the zero-copy shared-memory ring (`serve/ipc.py`). The engine
 process owns everything expensive exactly once: the compile cache, the
 warmed exec tables, the device monitor accumulator.
 
-Process model (Linux): the parent builds the ring, reserves the port,
-and FORKS one SPAWNER process (a zygote) BEFORE initializing any backend
-— the zygote inherits only the mmap + doorbells and never starts a
-thread, and it is the zygote that forks (and, when one crashes, refork)
-every front end. The parent then loads the bundle, warms the engine, and
-runs the ring service. Front ends restart freely — a crashed worker is
-respawned by the zygote within ~0.5 s and re-attaches to its slot
-partition via the shm generation counters; because every fork happens in
-the thread-free zygote, no child is ever forked from the engine's
-threaded world (jax/XLA runtime, dispatch pool, collector — the classic
-fork-after-threads deadlock). The engine process is the one that must
-stay up (docs/operations.md "Multi-worker plane").
+Process model (Linux, ISSUE 11): the parent is a thread-free, jax-free
+SUPERVISOR. It builds the ring, reserves the port, and forks EVERY other
+process — the N front ends and the ENGINE child (which imports jax only
+after the fork) — so no fork ever crosses a threaded world (jax/XLA
+runtime, dispatch pool, collector — the classic fork-after-threads
+deadlock), respawns included. Front ends restart freely: a crashed
+worker is respawned within ~0.5 s and re-attaches to its slot partition
+via the shm generation counters. ENGINE death is a survivable BROWNOUT,
+not an outage: the supervisor forks a replacement that warm-starts from
+the AOT compile cache, re-attaches to the same ring under a new
+incarnation counter, and REPLAYS every busy slot whose completion never
+arrived (`RingService.reattach` — slabs hold the full pre-encoded input
+and packed predict is pure, so replayed answers are bit-identical).
+While the engine is down, in-flight requests PARK against their PR 9
+deadline budgets (200 if the replay lands in time, 504 only on true
+budget expiry) and new admissions keep parking until the partition
+fills.
 
 Load shedding: each front end's slot partition is its bounded admission
 queue, per bucket class (small/coalescable vs large/solo). No free slot
 => immediate ``503`` with ``Retry-After`` — overload degrades into fast
 rejections while admitted requests keep their latency, instead of an
 unbounded queue melting p99 (the fleet-goodput framing of PAPERS.md
-arXiv 2502.06982).
+arXiv 2502.06982). During an engine outage the partition doubles as the
+parking lot and the shed becomes a BROWNOUT 503: Retry-After advertises
+the respawn ETA and the shed counts in ``brownout_shed_total``.
 
-Graceful drain: SIGTERM to the parent forwards to every front end; each
-stops accepting, finishes in-flight exchanges, and exits; the parent
-then drains the ring service (every accepted slot still gets its
-response) and exits 0. The engine survives front-end churn by
-construction — it never blocks on front-end state.
+Graceful drain: SIGTERM to the supervisor forwards to every front end;
+each stops accepting, finishes in-flight exchanges (the engine child
+keeps serving through this window, so parked slots still land), and
+exits; the supervisor then SIGTERMs the engine (which drains the ring
+service — every accepted slot still gets its response) and exits 0.
 """
 
 from __future__ import annotations
@@ -42,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import math
 import multiprocessing
 import os
 import signal
@@ -55,7 +63,11 @@ from mlops_tpu import faults
 from mlops_tpu.config import Config, ServeConfig
 from mlops_tpu.serve.httpcore import HttpProtocol, _LazyJson, deadline_response
 from mlops_tpu.serve.ipc import RequestRing, RingClient, RingService, ShmWorkerMetrics
-from mlops_tpu.serve.metrics import render_ring_metrics
+from mlops_tpu.serve.metrics import (
+    ENG_DOWN_SINCE,
+    ENG_RESPAWNS,
+    render_ring_metrics,
+)
 from mlops_tpu.serve.wire import (
     RESP_EXPIRED,
     RESP_OK,
@@ -145,6 +157,26 @@ class FrontendServer(HttpProtocol):
     def _ready(self) -> bool:
         return self.ring.engine_ready and not self.draining
 
+    def _respawn_retry_after(self) -> int:
+        """Retry-After seconds for a BROWNOUT 503 (engine down, parking
+        full): the configured respawn ETA minus how long the engine has
+        already been down — a well-behaved client's retry lands just
+        after the replacement's replay finishes, instead of hammering
+        into the same full parking lot. Never below 1 s (the header is
+        integer seconds, and 0 invites an immediate retry)."""
+        eta = self.config.engine_respawn_eta_s
+        down_since = float(self.ring.eng_vals[ENG_DOWN_SINCE])
+        remaining = eta - (time.monotonic() - down_since) if down_since else eta
+        if remaining <= 0:
+            # The ETA estimate is already blown (a respawn slower than
+            # advertised — e.g. the AOT cache was cold and the
+            # replacement is recompiling): re-advertise the FULL ETA so
+            # clients pace their retries at the estimate's cadence
+            # instead of hammering 1 s retries into a still-full parking
+            # lot for the whole recompile.
+            remaining = eta
+        return max(1, math.ceil(remaining))
+
     async def _metrics_endpoint(self):
         # Every gauge renders straight from shared memory — all workers'
         # request/latency blocks, the ring depth/shed counters, and the
@@ -178,8 +210,8 @@ class FrontendServer(HttpProtocol):
         from mlops_tpu.schema import records_to_columns
 
         # Injection point (mlops_tpu/faults): kill = a front-end worker
-        # crash mid-request — the zygote-respawn + slot-quarantine path
-        # the chaos smoke drives.
+        # crash mid-request — the supervisor-respawn + slot-quarantine
+        # path the chaos smoke drives.
         faults.fire("serve.frontend.predict")
         n = len(record_dicts)
         # ADMISSION BEFORE ENCODE: a to-be-shed request must cost nothing
@@ -191,14 +223,39 @@ class FrontendServer(HttpProtocol):
             # Bounded admission per bucket class: shed FAST with a
             # Retry-After instead of queueing — the slots free up as
             # in-flight responses land, so a well-behaved client's retry
-            # lands in capacity.
+            # lands in capacity. During an ENGINE OUTAGE (ISSUE 11) the
+            # partition doubles as the parking lot, so a full partition
+            # means "parking full": the shed becomes a BROWNOUT 503
+            # whose Retry-After advertises the respawn ETA, counted
+            # separately — shed latency stays flat either way.
             self.client.count_shed(n)
+            cls = "small" if n <= self.ring.small_rows else "large"
+            if not self.ring.engine_ready and (
+                float(self.ring.eng_vals[ENG_DOWN_SINCE]) > 0
+            ):
+                # A real OUTAGE (the supervisor stamped the engine's
+                # death), not a cold boot: first-boot warmup can take
+                # minutes and its sheds must advertise the steady-state
+                # Retry-After below, not a ~5 s respawn ETA that would
+                # hammer retries into a still-warming plane.
+                self.ring.brownout_shed[self.worker_id] += 1
+                retry_s = self._respawn_retry_after()
+                return (
+                    503,
+                    {
+                        "detail": "engine restarting and parking is "
+                        f"full (no free {cls} request slot); retry in "
+                        f"{retry_s}s"
+                    },
+                    "application/json",
+                    {"retry-after": str(retry_s)},
+                )
             retry_s = self.config.shed_retry_after_s
             return (
                 503,
                 {
                     "detail": "overloaded: no free "
-                    f"{'small' if n <= self.ring.small_rows else 'large'} "
+                    f"{cls} "
                     f"request slot; retry in {retry_s}s"
                 },
                 "application/json",
@@ -240,6 +297,19 @@ class FrontendServer(HttpProtocol):
             if deadline is not None:
                 remaining = deadline - loop.time()
                 timeout = min(timeout or remaining, remaining)
+            # Parking (ISSUE 11): a request admitted while the engine is
+            # down holds its slot and WAITS — the respawned engine's
+            # re-attach replays it (200 if the budget allows) or the
+            # deadline below turns it into the documented 504. The gauge
+            # counts requests currently parked this way; like the
+            # brownout shed above it requires a supervisor-stamped
+            # OUTAGE, so routine first-boot warmup waits never read as
+            # outage evidence on dashboards.
+            parked = not self.ring.engine_ready and (
+                float(self.ring.eng_vals[ENG_DOWN_SINCE]) > 0
+            )
+            if parked:
+                self.ring.parked[self.worker_id] += 1
             try:
                 if timeout is not None:
                     status = await asyncio.wait_for(future, max(timeout, 0.0))
@@ -257,6 +327,9 @@ class FrontendServer(HttpProtocol):
                 return deadline_response(
                     f"prediction exceeded the {timeout:g}s deadline"
                 )
+            finally:
+                if parked:
+                    self.ring.parked[self.worker_id] -= 1
             if status == RESP_EXPIRED:
                 # The engine shed the dead work (already counted engine-
                 # side); the completion is the proof the slab is quiescent.
@@ -436,13 +509,15 @@ async def _run_frontend(
     parent = os.getppid()
 
     async def _watch_plane() -> None:
-        # Two drain triggers besides the direct SIGTERM: the engine
-        # flipping the ring's shared drain flag (a front end forked
-        # mid-drain, or a missed signal), and a DEAD parent — the zygote
-        # in production (it only exits after setting the drain flag or
-        # because the plane is coming down), the engine half in the test
-        # harness; either way nobody is supervising this worker anymore,
-        # so drain rather than linger.
+        # Two drain triggers besides the direct SIGTERM: the shared ring
+        # drain flag (a front end forked mid-drain, or a missed signal),
+        # and a DEAD parent — the supervisor in production, the test
+        # harness process otherwise; either way nobody can respawn this
+        # worker anymore, so drain rather than linger. ENGINE death is
+        # deliberately NOT a drain trigger (ISSUE 11): the supervisor
+        # respawns the engine, in-flight requests park against their
+        # deadline budgets, and the replay answers them — the watchdog
+        # split that turned engine death from an outage into a brownout.
         while not draining.is_set():
             await asyncio.sleep(1.0)
             if ring.draining:
@@ -493,22 +568,45 @@ def start_frontends(
     ]
 
 
-def _zygote_main(
-    config: ServeConfig,
+def _write_pid_files(engine_pid: int | None) -> None:
+    """Operator convenience (ISSUE 11 satellite): pid files live under
+    ``runs/`` (gitignored), never at the repo root — ``serve.pid`` is the
+    supervisor (SIGTERM target for a drain), ``engine.pid`` the current
+    engine incarnation (SIGKILL target for a survivability drill).
+    Best-effort: a read-only working directory must not fail serving."""
+    try:
+        os.makedirs("runs", exist_ok=True)
+        with open(os.path.join("runs", "serve.pid"), "w") as f:
+            f.write(f"{os.getpid()}\n")
+        if engine_pid is not None:
+            with open(os.path.join("runs", "engine.pid"), "w") as f:
+                f.write(f"{engine_pid}\n")
+    except OSError:
+        logger.warning(
+            "could not write pid files under runs/", exc_info=True
+        )
+
+
+def _engine_main(
+    config: Config,
     ring: RequestRing,
-    preprocess_path: str,
+    bundle_dir: str,
     trace: Any = None,
 ) -> None:
-    """Spawner process: forked from the parent BEFORE the backend loads,
-    so every front end — the initial set and every respawn — forks from
-    this clean, thread-free world. Forking replacements from the engine
-    parent would snapshot a process whose collector, dispatch-pool, and
-    jax/XLA runtime threads may hold locks mid-flight; the child would
-    inherit those locks locked forever (fork-after-threads). The zygote
-    never starts a thread and never imports jax, so its forks are always
-    safe. It also supervises: a crashed front end is respawned until the
-    plane drains (SIGTERM, the ring's drain flag, or the engine process
-    dying)."""
+    """Engine child process entry (forked from the jax-free supervisor —
+    ring, doorbells, and locks arrive by inheritance; jax imports happen
+    HERE, after the fork, so no backend thread ever crosses one). Loads
+    the bundle, warms through the AOT compile cache, re-attaches to the
+    ring under a fresh incarnation — replaying any slots a dead
+    predecessor left busy (`RingService.reattach`) — and serves until
+    SIGTERM or supervisor death. ``kill -9`` of this process is the
+    survivable-engine tentpole: the supervisor forks a replacement that
+    runs this same function against the same shm ring."""
+    from mlops_tpu.bundle import load_bundle
+    from mlops_tpu.compilecache.cache import from_config
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    serve_cfg = config.serve
     stop = {"flag": False}
 
     def _stop(signum=None, frame=None) -> None:
@@ -516,56 +614,139 @@ def _zygote_main(
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
-    engine_pid = os.getppid()
-    procs = start_frontends(config, ring, preprocess_path, trace)
-    logger.info(
-        "zygote %d spawned %d front ends (pids %s)",
-        os.getpid(), len(procs), [p.pid for p in procs],
+
+    bundle = load_bundle(bundle_dir)
+    engine = InferenceEngine(
+        bundle,
+        buckets=tuple(serve_cfg.warmup_batch_sizes),
+        service_name=serve_cfg.service_name,
+        enable_grouping=serve_cfg.batch_window_ms > 0,
+        compile_cache=from_config(config),
+        warmup_workers=config.cache.warmup_workers,
     )
-    while not stop["flag"] and not ring.draining:
-        time.sleep(0.5)
-        if os.getppid() != engine_pid:
-            # The engine is gone: no response will ever arrive for a
-            # submitted slot. Flip the shared drain flag so every front
-            # end stops accepting, then fall through to the join.
-            logger.error("zygote: engine process died; draining front ends")
-            ring.set_draining()
-            break
-        for i, proc in enumerate(procs):
-            if proc.is_alive() or stop["flag"]:
-                continue
-            logger.error(
-                "frontend %d (pid %s) died with exit code %s; respawning",
-                i, proc.pid, proc.exitcode,
-            )
-            procs[i] = _respawn(config, ring, preprocess_path, i, trace)
-    for proc in procs:
-        if proc.is_alive() and proc.pid:
-            with contextlib.suppress(ProcessLookupError):
-                os.kill(proc.pid, signal.SIGTERM)
-    # One shared wall-clock budget for ALL joins (the children drain
-    # concurrently — per-child timeouts would compound when several are
-    # stuck; serve.zygote_join_deadline_s), then SIGKILL the stragglers:
-    # they already ignored SIGTERM.
-    deadline = time.monotonic() + config.zygote_join_deadline_s
-    for proc in procs:
-        proc.join(timeout=max(0.0, deadline - time.monotonic()))
-    for proc in procs:
-        if proc.is_alive():  # pragma: no cover - stuck child
-            proc.kill()
-            proc.join(timeout=5)
+    if trace is not None:
+        # Shape histograms accumulate ENGINE-side (the only process that
+        # dispatches); the telemetry loop mirrors them into shm for
+        # every front end's /metrics.
+        from mlops_tpu.trace import ShapeStats
+
+        engine.set_shape_stats(ShapeStats())
+    service = RingService(
+        engine,
+        ring,
+        max_group=serve_cfg.max_group,
+        max_inflight=serve_cfg.max_inflight,
+        threads=serve_cfg.max_workers,
+        monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
+        monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
+    )
+    if serve_cfg.profile_dir:
+        # /debug/profile: front ends forward start/stop through the
+        # ring's control word to THIS process, which owns the device.
+        from mlops_tpu.serve.server import JaxProfiler
+
+        service.profiler = JaxProfiler(serve_cfg.profile_dir).control
+    # Warmup -> re-attach (incarnation bump + busy-slot replay) -> serve:
+    # parked requests are re-answered by the replay BEFORE the ready
+    # flag flips, so "ready" means "the outage is fully healed".
+    engine.warmup()
+    attach = service.reattach()
+    service.start()
+    ring.set_ready(True)
+    ring.eng_vals[ENG_DOWN_SINCE] = 0.0
+    logger.info(
+        "warmup complete; ready %s",
+        _LazyJson(getattr(engine, "warmup_stats", {})),
+    )
+    logger.info(
+        "engine incarnation %d attached %s",
+        attach["incarnation"], _LazyJson(attach),
+    )
+    if config.lifecycle.enabled:
+        # The closed loop runs ENGINE-SIDE (the only process with the
+        # device, the exec tables, and the compile cache); the telemetry
+        # loop mirrors its gauges into shm. The fork-time preprocessor
+        # is the encode contract, so the controller is forced onto the
+        # incumbent preprocessor. A respawned engine restarts the loop
+        # from its on-disk reservoir state.
+        from mlops_tpu.lifecycle import LifecycleController
+
+        service.lifecycle = LifecycleController(
+            engine, config, force_incumbent_preprocessor=True
+        )
+        service.lifecycle.start()
+        logger.info("lifecycle controller started (engine process)")
+
+    supervisor = os.getppid()
+    rc = 0
+    try:
+        # NOT drained by the ring's drain flag: during a graceful drain
+        # the front ends finish their in-flight slots FIRST and this
+        # process must keep answering them; the supervisor SIGTERMs the
+        # engine only after the front ends have joined.
+        while not stop["flag"]:
+            time.sleep(0.5)
+            # Injection point (mlops_tpu/faults): kill = deterministic
+            # in-process engine death (the chaos path without needing a
+            # pid from outside); raise = an engine main-loop failure —
+            # either way the supervisor forks a replacement.
+            faults.fire("serve.engine.exit")
+            if os.getppid() != supervisor:
+                logger.error(
+                    "engine: supervisor died; exiting for restart"
+                )
+                rc = 1
+                break
+    finally:
+        ring.set_ready(False)
+        if service.lifecycle is not None:
+            service.lifecycle.stop()
+        service.stop()
+        logger.info("engine process drained; exiting")
+    if rc:
+        raise SystemExit(rc)
 
 
-# ----------------------------------------------------------------- parent
+def _spawn_engine(
+    config: Config,
+    ring: RequestRing,
+    bundle_dir: str,
+    trace: Any = None,
+) -> multiprocessing.Process:
+    """Fork the engine child from the (thread-free, jax-free) supervisor
+    — first boot and every respawn run the identical path."""
+    ctx = multiprocessing.get_context("fork")
+    proc = ctx.Process(
+        target=_engine_main,
+        args=(config, ring, bundle_dir, trace),
+        name="mlops-tpu-engine",
+    )
+    proc.start()
+    return proc
+
+
+# --------------------------------------------------------------- parent
+# Engine crash-loop guard: more than this many engine deaths inside one
+# 60 s window means the engine cannot hold (corrupt bundle, broken
+# cache, OOM loop) — the supervisor drains and exits 1 so the
+# orchestrator restarts the pod instead of brownout-flapping forever.
+_ENGINE_STORM_DEATHS = 5
+_ENGINE_STORM_WINDOW_S = 60.0
+
+
 def serve_multi_worker(config: Config, bundle_dir: str) -> int:
-    """Parent orchestration: ring -> fork zygote -> engine -> serve.
+    """Parent orchestration (ISSUE 11): the parent is a thread-free,
+    jax-free SUPERVISOR — ring -> fork front ends -> fork engine child ->
+    supervise both.
 
-    Order matters: the zygote (which forks and supervises every front
-    end) forks BEFORE the bundle loads, so no backend state (device
-    handles, runtime threads) ever crosses a fork — respawns included,
-    because they fork from the zygote's thread-free world, never from
-    this jax-initialized parent. The parent then becomes the engine
-    process and only supervises the zygote.
+    Because the supervisor never loads a backend and never starts a
+    thread, every fork it performs is safe (the PR 6 zygote's guarantee,
+    absorbed into the parent now that the engine lives in a child): a
+    crashed front end respawns in ~0.5 s, and a crashed/killed ENGINE is
+    a brownout — the replacement warm-starts from the AOT cache,
+    re-attaches under a new incarnation, and replays every busy slot
+    while in-flight requests park against their deadline budgets
+    (docs/operations.md "Engine death is a brownout").
     """
     from pathlib import Path
 
@@ -630,18 +811,19 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     child_cfg = dataclasses.replace(
         serve_cfg, port=placeholder.getsockname()[1], max_batch=max_batch
     )
-    zygote = multiprocessing.get_context("fork").Process(
-        target=_zygote_main,
-        args=(child_cfg, ring, preprocess_path, trace_cfg),
-        name="mlops-tpu-zygote",
+    procs = start_frontends(child_cfg, ring, preprocess_path, trace_cfg)
+    logger.info(
+        "supervisor %d spawned %d front ends (pids %s)",
+        os.getpid(), len(procs), [p.pid for p in procs],
     )
-    zygote.start()
+    engine_proc = _spawn_engine(config, ring, bundle_dir, trace_cfg)
     logger.info(
         "serving %s on %s:%s with %d SO_REUSEPORT front ends "
-        "(zygote pid %s)",
+        "(engine pid %s)",
         serve_cfg.service_name, child_cfg.host, child_cfg.port,
-        serve_cfg.workers, zygote.pid,
+        serve_cfg.workers, engine_proc.pid,
     )
+    _write_pid_files(engine_proc.pid)
 
     stopping = {"sigterm": False}
 
@@ -651,110 +833,90 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     signal.signal(signal.SIGTERM, _sigterm)
     signal.signal(signal.SIGINT, _sigterm)
 
-    service = None
+    engine_deaths: list[float] = []
+    rc = 0
     try:
-        # ---- the parent becomes the engine process ----
-        from mlops_tpu.bundle import load_bundle
-        from mlops_tpu.compilecache.cache import from_config
-        from mlops_tpu.serve.engine import InferenceEngine
-
-        bundle = load_bundle(bundle_dir)
-        engine = InferenceEngine(
-            bundle,
-            buckets=tuple(serve_cfg.warmup_batch_sizes),
-            service_name=serve_cfg.service_name,
-            enable_grouping=serve_cfg.batch_window_ms > 0,
-            compile_cache=from_config(config),
-            warmup_workers=config.cache.warmup_workers,
-        )
-        if trace_cfg is not None:
-            # Shape histograms accumulate ENGINE-side (the only process
-            # that dispatches); the telemetry loop mirrors them into shm
-            # for every front end's /metrics.
-            from mlops_tpu.trace import ShapeStats
-
-            engine.set_shape_stats(ShapeStats())
-        service = RingService(
-            engine,
-            ring,
-            max_group=serve_cfg.max_group,
-            max_inflight=serve_cfg.max_inflight,
-            threads=serve_cfg.max_workers,
-            monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
-            monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
-        )
-        if serve_cfg.profile_dir:
-            # /debug/profile on the multi-worker plane: the front ends
-            # forward start/stop through the ring's control word to THIS
-            # process, which owns the device (serve/server.py JaxProfiler).
-            from mlops_tpu.serve.server import JaxProfiler
-
-            service.profiler = JaxProfiler(serve_cfg.profile_dir).control
-        # Service first, then warmup: early requests AOT-compile on
-        # demand exactly like the single-process bind-first model, and
-        # /healthz/ready flips when every bucket is compiled.
-        service.start()
-        engine.warmup()
-        ring.set_ready(True)
-        logger.info(
-            "warmup complete; ready %s",
-            _LazyJson(getattr(engine, "warmup_stats", {})),
-        )
-        if config.lifecycle.enabled:
-            # The closed loop runs ENGINE-SIDE (the only process with
-            # the device, the exec tables, and the compile cache). The
-            # engine tee observes pre-encoded slab rows (copied — slabs
-            # are reused), the ring telemetry loop mirrors the gauge
-            # snapshot into shm for every front end's /metrics, and
-            # promotion swaps in place under the engine's locks — front
-            # ends never notice a bundle turnover. The fork-time
-            # preprocessor is the encode contract here, so the
-            # controller is forced onto the incumbent preprocessor.
-            from mlops_tpu.lifecycle import LifecycleController
-
-            service.lifecycle = LifecycleController(
-                engine, config, force_incumbent_preprocessor=True
-            )
-            service.lifecycle.start()
-            logger.info("lifecycle controller started (engine process)")
-
-        # ---- supervise the zygote (it supervises the front ends; this
-        # process must never fork again now that jax threads exist) ----
+        # ---- supervise: front ends respawn in-place; the engine
+        # respawns as a BROWNOUT (ready drops, requests park, the
+        # replacement re-attaches + replays) ----
         while not stopping["sigterm"]:
             time.sleep(0.5)
-            if not zygote.is_alive():
-                # Without the zygote no crashed front end can ever be
-                # respawned; exit nonzero so the orchestrator restarts
-                # the pod instead of limping with shrinking capacity.
+            for i, proc in enumerate(procs):
+                if proc.is_alive() or stopping["sigterm"]:
+                    continue
                 logger.error(
-                    "zygote (pid %s) died with exit code %s; front-end "
-                    "respawn is impossible — exiting for restart",
-                    zygote.pid, zygote.exitcode,
+                    "frontend %d (pid %s) died with exit code %s; "
+                    "respawning",
+                    i, proc.pid, proc.exitcode,
                 )
-                return 1
-        return 0
+                procs[i] = _respawn(
+                    child_cfg, ring, preprocess_path, i, trace_cfg
+                )
+            if not engine_proc.is_alive() and not stopping["sigterm"]:
+                now = time.monotonic()
+                engine_deaths = [
+                    t for t in engine_deaths
+                    if now - t < _ENGINE_STORM_WINDOW_S
+                ] + [now]
+                if len(engine_deaths) > _ENGINE_STORM_DEATHS:
+                    logger.error(
+                        "engine died %d times inside %.0f s — crash "
+                        "loop, not a blip; draining for an orchestrator "
+                        "restart",
+                        len(engine_deaths), _ENGINE_STORM_WINDOW_S,
+                    )
+                    rc = 1
+                    break
+                logger.error(
+                    "engine process (pid %s) died with exit code %s; "
+                    "respawning",
+                    engine_proc.pid, engine_proc.exitcode,
+                )
+                # Brownout begins: readiness drops (new admissions park
+                # until the partition fills, then shed 503 with the
+                # respawn ETA), the supervisor stamps the outage start
+                # for the Retry-After math and counts the respawn.
+                ring.set_ready(False)
+                ring.eng_vals[ENG_DOWN_SINCE] = now
+                ring.eng_vals[ENG_RESPAWNS] += 1
+                engine_proc = _spawn_engine(
+                    config, ring, bundle_dir, trace_cfg
+                )
+                logger.info(
+                    "engine process started (pid %s)", engine_proc.pid
+                )
+                _write_pid_files(engine_proc.pid)
+        return rc
     finally:
-        # ---- graceful drain ----
+        # ---- graceful drain: front ends FIRST (their in-flight slots
+        # need a live engine to land), then the engine ----
         ring.set_draining()
         ring.set_ready(False)
-        if zygote.is_alive() and zygote.pid:
+        for proc in procs:
+            if proc.is_alive() and proc.pid:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(proc.pid, signal.SIGTERM)
+        # One shared wall-clock budget for ALL front-end joins (they
+        # drain concurrently — per-child timeouts would compound when
+        # several are stuck; serve.zygote_join_deadline_s), then SIGKILL
+        # the stragglers: they already ignored SIGTERM.
+        deadline = time.monotonic() + serve_cfg.zygote_join_deadline_s
+        for proc in procs:
+            proc.join(timeout=max(0.0, deadline - time.monotonic()))
+        for proc in procs:
+            if proc.is_alive():  # pragma: no cover - stuck child
+                proc.kill()
+                proc.join(timeout=5)
+        if engine_proc.is_alive() and engine_proc.pid:
             with contextlib.suppress(ProcessLookupError):
-                os.kill(zygote.pid, signal.SIGTERM)
-        # The zygote forwards SIGTERM, joins every front end against one
-        # shared serve.zygote_join_deadline_s budget (+5 s kill grace),
-        # then exits — give it that window plus slack
-        # (serve.engine_zygote_join_s; validate() pins the ordering). A
-        # zygote still alive after that already ignored one SIGTERM (its
-        # handler only sets a flag the join loops don't consult), so
-        # escalate straight to SIGKILL.
-        zygote.join(timeout=serve_cfg.engine_zygote_join_s)
-        if zygote.is_alive():  # pragma: no cover - stuck zygote
-            zygote.kill()
-            zygote.join(timeout=5)
-        if service is not None:
-            if service.lifecycle is not None:
-                service.lifecycle.stop()
-            service.stop()
+                os.kill(engine_proc.pid, signal.SIGTERM)
+        # The engine drains its ring service (final monitor write,
+        # in-flight jobs) on SIGTERM; serve.engine_zygote_join_s bounds
+        # the wait before SIGKILL escalation.
+        engine_proc.join(timeout=serve_cfg.engine_zygote_join_s)
+        if engine_proc.is_alive():  # pragma: no cover - stuck engine
+            engine_proc.kill()
+            engine_proc.join(timeout=5)
         placeholder.close()
         ring.close()
         logger.info("multi-worker plane drained; exiting")
@@ -770,8 +932,8 @@ def _respawn(
     """Fork a replacement front end for one worker slot partition (the
     generation counters in shm make any of the dead worker's in-flight
     completions stale on arrival). Call only from a process without
-    running threads — the zygote in production, the harness process in
-    tests — never from the engine once its backend is up."""
+    running threads — the supervisor in production, the harness process
+    in tests — never from the engine once its backend is up."""
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_frontend_main,

@@ -17,13 +17,28 @@ Topology and ownership:
 - Every front end owns a fixed PARTITION of the slots (its admission
   queue): slot claim/release is event-loop confined per worker. The only
   cross-process lock a FRONT END ever takes is the submission queue's
-  head lock (microseconds of index arithmetic); the completion queue's
-  lock belongs to engine threads alone, and the completion consumer is
-  lock-free — its ordering fence on weakly-ordered CPUs is the COUNTED
-  doorbell (the eventfd value carries the number of published
-  completions; the consumer only consumes what a drained ring has
-  credited). A kill -9'd front end therefore cannot orphan the
-  completion lock and wedge the engine.
+  head lock (microseconds of index arithmetic, shared by PRODUCERS
+  only); the completion queue's lock belongs to engine threads alone.
+  BOTH queue consumers are lock-free — the ordering fence on
+  weakly-ordered CPUs is the COUNTED doorbell in each direction (the
+  eventfd value carries the number of published entries; a consumer only
+  consumes what a drained ring has credited). A kill -9'd front end
+  therefore cannot orphan the completion lock and wedge the engine, and
+  a kill -9'd ENGINE cannot orphan the submission lock and wedge the
+  front ends (ISSUE 11 — the engine never takes it).
+- Engine INCARNATION counter (ISSUE 11): the engine process is
+  restartable in place. A respawned engine bumps ``eng_vals`` 's
+  incarnation word, recovers the completion lock its predecessor may
+  have died holding (only engine processes ever take it, and they are
+  serialized by the supervisor), seeds its monitor totals from the shm
+  aggregate so exported counters stay monotone, and REPLAYS every busy
+  slot whose completion never arrived — safe because request slabs hold
+  the full pre-encoded input and the packed predict programs are pure
+  (same AOT artifacts + same inputs = bit-identical outputs). The engine
+  stamps its incarnation into ``resp_incarnation`` alongside every
+  completion; consumers drop completions carrying a dead incarnation
+  (the replay re-answers those slots) so a half-trustworthy leftover can
+  never be double-served.
 - Two slot classes per worker: ``small`` slabs hold up to
   ``GROUP_ROW_BUCKET`` rows (the coalescable class — batch-1 traffic),
   ``large`` slabs hold up to ``max_batch`` rows (solo dispatches; small
@@ -63,8 +78,13 @@ from typing import Any
 
 import numpy as np
 
+from mlops_tpu import faults
 from mlops_tpu.schema import SCHEMA
 from mlops_tpu.serve.metrics import (
+    ENG_INCARNATION,
+    ENG_REPLAYED,
+    ENG_ROWS_DISPATCHED,
+    ENG_ROWS_LOST,
     LIFE_AUC_DELTA,
     LIFE_BREAKER_OPEN,
     LIFE_BREAKER_TRIPS,
@@ -103,15 +123,17 @@ logger = logging.getLogger("mlops_tpu.serve")
 # locks (one per descriptor queue's head index). Beyond mutual exclusion
 # they order the producers' stores: plain numpy stores alone would only
 # be ordered under x86 TSO, and a weakly-ordered CPU (aarch64) could
-# otherwise observe a head bump before the slab bytes it advertises. On
-# the submission queue the consumer (engine collector) takes the same
-# lock, completing the fence; on the completion queue the consumer is
-# LOCK-FREE — only engine threads ever acquire ``_complete_lock``, so a
-# crashed front end cannot orphan it — and the consumer-side fence is
-# the counted doorbell instead (`Doorbell.ring(count)` / credit-limited
-# `pop_completions`). Both locks are leaves — nothing is ever acquired
-# under them, and neither is held across slab writes, doorbells, or
-# blocking work.
+# otherwise observe a head bump before the slab bytes it advertises.
+# BOTH consumers are LOCK-FREE and credit-fenced instead
+# (`Doorbell.ring(count)` / credit-limited `pop_submissions` /
+# `pop_completions`): only front ends ever acquire ``_submit_lock`` and
+# only engine threads ever acquire ``_complete_lock``, so a kill -9 on
+# either side can never orphan the lock the OTHER side needs (ISSUE 11 —
+# engine death must be a brownout, not a wedge; the one residual case,
+# a dead engine's own ``_complete_lock``, is recovered by its serialized
+# successor in `recover_engine_locks`). Both locks are leaves — nothing
+# is ever acquired under them, and neither is held across slab writes,
+# doorbells, or blocking work.
 #
 # RingService: ``_inflight`` is the dispatch bound, acquired by the
 # collector thread and released by the pool thread that finishes the job
@@ -184,14 +206,15 @@ class Doorbell:
         except (BlockingIOError, BrokenPipeError, OSError):
             pass  # full pipe = wakeup already pending; closed peer = gone
 
-    def wait(self, timeout_s: float | None = None) -> bool:
+    def wait(self, timeout_s: float | None = None) -> int:
         """Block (in select, so other processes' writes wake us) until the
-        doorbell rings or the timeout passes; drains the counter."""
+        doorbell rings or the timeout passes; drains the counter and
+        returns it (0 on timeout) — truthiness keeps the old bool
+        contract, and the count is the consumer's CREDIT."""
         ready, _, _ = select.select([self._rfd], [], [], timeout_s)
         if ready:
-            self.drain()
-            return True
-        return False
+            return self.drain()
+        return 0
 
     def drain(self) -> int:
         """Swallow the pending count and return it (0 on a spurious or
@@ -313,6 +336,14 @@ class RequestRing:
             ("slot_deadline", np.dtype(np.float64), (self.n_slots,)),
             ("resp_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("resp_status", np.dtype(np.uint32), (self.n_slots,)),
+            # Engine incarnation that produced this slot's response
+            # (stamped with resp_gen, checked by the completion consumer
+            # against eng_vals[ENG_INCARNATION]): a completion left
+            # behind by a dead engine incarnation is DROPPED — the
+            # respawned engine's replay re-answers the slot — so a
+            # leftover from a process that died mid-batch can never be
+            # served as fresh (ISSUE 11).
+            ("resp_incarnation", np.dtype(np.uint32), (self.n_slots,)),
             # tracewire engine-half span stamps, carried per slot exactly
             # like slot_deadline: [collect, jobstart, dispatched, fetched]
             # CLOCK_MONOTONIC stamps plus [kind, geom] naming the compiled
@@ -351,6 +382,16 @@ class RequestRing:
             # checks answering 504 before a slot submits) — single writer
             # per worker, like the shed counters
             ("expired", np.dtype(np.uint64), (workers,)),
+            # ISSUE 11 — per-worker survivability cells (single writer:
+            # that worker's event loop). `parked` is a GAUGE: requests
+            # admitted while the engine was down, currently holding a
+            # slot awaiting the respawned engine's replay.
+            # `brownout_shed` counts 503s answered because the parking
+            # partition filled DURING an engine outage (they also count
+            # in the per-class `shed` cells — brownout is a shed with a
+            # respawn-ETA Retry-After, not a new status).
+            ("parked", np.dtype(np.uint64), (workers,)),
+            ("brownout_shed", np.dtype(np.uint64), (workers,)),
             # tracewire spans each front end's bounded recorder DROPPED
             # (single writer per worker, like expired/shed)
             ("trace_dropped", np.dtype(np.uint64), (workers,)),
@@ -368,10 +409,19 @@ class RequestRing:
             # ROB_DEGRADED = the engine's degraded-dispatch total
             # (mirrored by the telemetry loop)
             ("rob_vals", np.dtype(np.float64), (2,)),
-            # monitor aggregate (single writer: the engine process)
+            # monitor aggregate (single writer: the engine process).
+            # mon_drift_sum carries the UNROUNDED cumulative sums so a
+            # respawned engine can seed its exact host totals (ISSUE 11)
+            # — reconstructing them from the rounded means would inject
+            # up to 5e-7 * batches of drift error per respawn.
             ("mon_vals", np.dtype(np.float64), (8,)),
             ("mon_drift_last", np.dtype(np.float64), (D,)),
             ("mon_drift_mean", np.dtype(np.float64), (D,)),
+            ("mon_drift_sum", np.dtype(np.float64), (D,)),
+            # engine-supervision block (ISSUE 11; serve/metrics.py ENG_*
+            # indices): incarnation, down-since stamp, respawn/replay/
+            # rows-lost counters, rows-dispatched telemetry baseline.
+            ("eng_vals", np.dtype(np.float64), (6,)),
             # lifecycle loop state (single writer: the engine process's
             # controller telemetry — serve/metrics.py LIFE_* indices), so
             # ANY front end renders the fleet's bundle generation /
@@ -470,7 +520,9 @@ class RequestRing:
     # ------------------------------------------------------- descriptors
     def submit(self, slot: int, gen: int) -> None:
         """Front-end side: enqueue a filled slot for the engine. The lock
-        guards ONLY the head bump; the doorbell rings outside it."""
+        (PRODUCERS only — the engine never takes it, so an engine kill -9
+        can never orphan it) guards the head bump; the doorbell rings
+        outside it and carries one unit of the consumer's credit."""
         entry = _pack(slot, gen)
         with self._submit_lock:
             head = int(self.sub_head[0])
@@ -478,17 +530,65 @@ class RequestRing:
             self.sub_head[0] = head + 1
         self.engine_doorbell.ring()
 
-    def pop_submissions(self) -> list[tuple[int, int]]:
-        """Engine side (single consumer): drain everything queued."""
+    def pop_submissions(
+        self, limit: int | None = None
+    ) -> list[tuple[int, int]]:
+        """Engine side (single consumer): LOCK-FREE, the mirror of
+        `pop_completions` — the tail has one writer (this consumer) and
+        the consumer never touches the producers' lock, so a kill -9'd
+        engine cannot wedge front-end submits and a kill -9'd front end
+        cannot wedge the engine. Ordering safety comes from ``limit``:
+        the collector passes the credit accumulated from the counted
+        engine doorbell (seeded with the already-queued entry count at
+        attach — a dead incarnation takes drained credit to its grave);
+        entries beyond the credit wait for their ring."""
         out: list[tuple[int, int]] = []
-        with self._submit_lock:
-            head = int(self.sub_head[0])
-            tail = int(self.sub_tail[0])
-            while tail < head:
-                out.append(_unpack(int(self.sub_entries[tail % self.n_slots])))
-                tail += 1
-            self.sub_tail[0] = tail
+        head = int(self.sub_head[0])
+        tail = int(self.sub_tail[0])
+        if limit is not None:
+            head = min(head, tail + limit)
+        while tail < head:
+            out.append(_unpack(int(self.sub_entries[tail % self.n_slots])))
+            tail += 1
+        self.sub_tail[0] = tail
         return out
+
+    def pending_submissions(self) -> set[int]:
+        """Slot ids with a descriptor currently queued (published, not yet
+        popped) — the re-attach replay scan excludes these: they reach the
+        new engine through the normal pop path. Lock-free snapshot (the
+        engine must never take the producers' lock); a submit racing the
+        scan lands either in this set or as a visible busy flag with its
+        doorbell credit still pending — both paths answer it exactly once
+        in the common case, and the worst-case race is one redundant
+        idempotent dispatch, never a lost or corrupt response."""
+        head = int(self.sub_head[0])
+        tail = int(self.sub_tail[0])
+        return {
+            _unpack(int(self.sub_entries[i % self.n_slots]))[0]
+            for i in range(tail, head)
+        }
+
+    def recover_engine_locks(self) -> None:
+        """Engine-side re-attach step (ISSUE 11): free ``_complete_lock``
+        if the dead incarnation was killed while holding it (pushing a
+        completion is microseconds of index arithmetic, but kill -9 has
+        no grace). Safe by serialization: only engine processes ever take
+        this lock, the supervisor runs at most one engine at a time, and
+        this runs before the new engine starts any pool thread — so a
+        failed non-blocking acquire can only mean an orphaned hold, and
+        releasing an unheld semaphore-backed mp.Lock just frees it."""
+        if self._complete_lock.acquire(block=False):
+            self._complete_lock.release()
+            return
+        try:
+            self._complete_lock.release()
+            logger.warning(
+                "recovered completion lock orphaned by a dead engine "
+                "incarnation"
+            )
+        except ValueError:  # pragma: no cover - platform-dependent guard
+            logger.exception("completion-lock recovery failed")
 
     def push_completion(self, slot: int, gen: int) -> None:
         """Engine side: hand a finished slot back to its owner. The lock
@@ -568,16 +668,19 @@ class RequestRing:
 
     def post_profile_request(self, action_code: int) -> int:
         """Publish the next profile request word (caller holds the
-        channel LEASE) and wake the engine collector; returns the seq
-        the acknowledgement must echo. The word update rides the same
-        mutex as the cancel path so a stale ex-claimant's token-checked
-        cancel can never interleave with a successor's post."""
+        channel LEASE); returns the seq the acknowledgement must echo.
+        The word update rides the same mutex as the cancel path so a
+        stale ex-claimant's token-checked cancel can never interleave
+        with a successor's post. Deliberately does NOT ring the engine
+        doorbell: that counter is the submission queue's consumption
+        CREDIT (a profile wakeup would be a phantom credit), and the
+        collector polls this word on its <=1 s idle tick anyway — well
+        inside the front end's 10 s ack budget."""
         with self._profile_lock:
             seq = ((int(self.prof_ctl[0]) >> 8) + 1) & 0xFFFFFFFF
             if seq == 0:
                 seq = 1  # 0 means "no request yet" to the collector
             self.prof_ctl[0] = (seq << 8) | (action_code & 0xFF)
-        self.engine_doorbell.ring()
         return seq
 
     def read_profile_ack(self, seq: int) -> int | None:
@@ -622,6 +725,12 @@ class RequestRing:
         self.mon_drift_mean[:] = np.fromiter(
             snapshot["drift_mean"].values(), np.float64, self.n_features
         )
+        # Unrounded cumulative sums (monitor_snapshot exports them for
+        # the lifecycle windows): the respawn seed reads these back so an
+        # engine restart never injects rounding error into the totals.
+        drift_sum = snapshot.get("drift_sum")
+        if drift_sum is not None:
+            self.mon_drift_sum[:] = np.asarray(drift_sum, np.float64)
         self.mon_vals[MON_FETCHES] += 1
         self.mon_vals[MON_FETCHED_AT] = time.monotonic()
         self.mon_vals[MON_HAS] = 1.0
@@ -731,6 +840,12 @@ class RingClient:
         ring.inflight[worker, :] = 0
         for slot in self._quarantined:
             ring.inflight[worker, ring.slot_class(slot)] += 1
+        # The parked gauge's decrements lived in the dead incarnation's
+        # event loop: any requests it had parked died with their
+        # connections, so the respawned worker's cell restarts at zero —
+        # otherwise a front-end crash during an engine outage would
+        # report phantom parked requests for the life of the pod.
+        ring.parked[worker] = 0
         # Completion-consumption CREDIT (see pop_completions): normally
         # accumulated from the counted doorbell; seeded here with the
         # entries already queued, whose doorbell credit a dead
@@ -868,8 +983,33 @@ class RingClient:
                     ring.inflight[self.worker, cls] -= 1
                 continue
             _, future = entry
-            if future.done() or future.cancelled():
+            if future.cancelled():
                 self.release(slot)  # zombie: waiter gave up; reuse now
+            elif future.done():
+                # Duplicate completion for a live (slot, gen): possible
+                # only across an engine respawn, when the replay
+                # re-answers a slot whose original completion the dead
+                # incarnation had already queued. The first pop resolved
+                # the future and its awaiting handler owns the release —
+                # releasing here too would double-free the slot (two
+                # requests sharing one slab). Drop the duplicate.
+                continue
+            elif int(ring.resp_incarnation[slot]) != int(
+                ring.eng_vals[ENG_INCARNATION]
+            ):
+                # Incarnation guard (ISSUE 11): this completion was
+                # produced by a DEAD engine incarnation (it may have died
+                # mid-batch; nothing about its leftovers is trusted).
+                # Leave the future pending — the respawned engine's
+                # replay re-answers this slot with a fresh completion, or
+                # the request's deadline budget turns it into a 504 and
+                # the zombie path reclaims the slot.
+                logger.info(
+                    "dropping completion for slot %d from dead engine "
+                    "incarnation %d (current %d); replay will re-answer",
+                    slot, int(ring.resp_incarnation[slot]),
+                    int(ring.eng_vals[ENG_INCARNATION]),
+                )
             elif int(ring.resp_gen[slot]) != gen:
                 # Descriptor/slab mismatch: the slab does not carry THIS
                 # request's answer (should be impossible for a live
@@ -936,6 +1076,14 @@ class RingService:
         # render the loop state. Engine-process only; front ends never
         # import the lifecycle package.
         self.lifecycle: Any = None
+        # Respawn bases (ISSUE 11, set by `reattach`): the degraded /
+        # lifecycle counter mirrors below are ABSOLUTE writes from
+        # in-process totals that restart at zero in a respawned engine —
+        # the dead incarnation's last-published values are carried as
+        # additive bases so the exported counters stay monotone (the
+        # same contract as `seed_monitor_totals`).
+        self._degraded_base = 0.0
+        self._life_base: dict[str, Any] | None = None
         # /debug/profile forwarding (tracewire): the engine process owns
         # the device, so front ends forward start/stop through the ring's
         # profile-control word; `profiler` is the engine-side handler
@@ -980,11 +1128,21 @@ class RingService:
     # ------------------------------------------------------------ collect
     def _collect(self) -> None:
         ring = self.ring
+        # Submission-consumption CREDIT (the mirror of RingClient._credit
+        # — see pop_submissions): normally accumulated from the counted
+        # engine doorbell; seeded here with the entries already queued,
+        # whose credit a dead engine incarnation may have drained and
+        # taken to its grave. Surplus credit after a pop is DISCARDED,
+        # never banked — un-credited entries always arrive with their own
+        # ring, and banking surplus would let a later consume run ahead
+        # of the eventfd fence.
+        credit = int(ring.sub_head[0]) - int(ring.sub_tail[0])
         while not self._stop.is_set():
             self._handle_profile()
-            descs = ring.pop_submissions()
+            descs = ring.pop_submissions(limit=credit) if credit else []
+            credit = 0
             if not descs:
-                ring.engine_doorbell.wait(timeout_s=1.0)
+                credit = ring.engine_doorbell.wait(timeout_s=1.0)
                 continue
             if ring.tracing:
                 # Engine-half span stamp 1: the descriptor left the ring
@@ -994,26 +1152,156 @@ class RingService:
                 for slot, _ in descs:
                     ring.resp_trace[slot, 0] = now
             self._requests_since_fetch += len(descs)
-            groupable: list[tuple[int, int]] = []
-            solo: list[tuple[int, int]] = []
-            can_group = getattr(self.engine, "supports_grouping", False)
-            for slot, gen in descs:
-                n = int(ring.slot_n[slot])
-                if can_group and 1 <= n <= GROUP_ROW_BUCKET:
-                    groupable.append((slot, gen))
-                else:
-                    solo.append((slot, gen))
-            jobs: list[list[tuple[int, int]]] = []
-            for i in range(0, len(groupable), self.max_group):
-                jobs.append(groupable[i : i + self.max_group])
-            jobs.extend([d] for d in solo)
-            for job in jobs:
+            for job in self._make_jobs(descs):
                 # Backpressure: the dispatch bound blocks the collector,
                 # submissions pile in the ring, front ends run out of
                 # slots, and the SHED path answers 503 — bounded end to
                 # end with no unbounded queue anywhere.
                 self._inflight.acquire()
                 self._pool.submit(self._run_job, job)
+
+    def _make_jobs(
+        self, descs: list[tuple[int, int]]
+    ) -> list[list[tuple[int, int]]]:
+        """The coalescing policy, shared by the live collector and the
+        re-attach replay: small requests group up to ``max_group`` per
+        device dispatch, everything else runs solo."""
+        ring = self.ring
+        groupable: list[tuple[int, int]] = []
+        solo: list[tuple[int, int]] = []
+        can_group = getattr(self.engine, "supports_grouping", False)
+        for slot, gen in descs:
+            n = int(ring.slot_n[slot])
+            if can_group and 1 <= n <= GROUP_ROW_BUCKET:
+                groupable.append((slot, gen))
+            else:
+                solo.append((slot, gen))
+        jobs: list[list[tuple[int, int]]] = []
+        for i in range(0, len(groupable), self.max_group):
+            jobs.append(groupable[i : i + self.max_group])
+        jobs.extend([d] for d in solo)
+        return jobs
+
+    # ----------------------------------------------------------- reattach
+    def reattach(self) -> dict[str, Any]:
+        """Engine-incarnation re-attach + busy-slot replay (ISSUE 11):
+        run by the engine process after warmup and BEFORE `start`, every
+        boot (a first boot just finds nothing to replay).
+
+        Steps, in order: (1) bump the shm engine-incarnation word — every
+        completion a dead incarnation left behind becomes droppable on
+        arrival (the consumer's incarnation guard); (2) recover the
+        completion lock the dead incarnation may have died holding;
+        (3) seed the engine's exact host-side monitor totals from the shm
+        aggregate so exported counters stay monotone across the respawn,
+        and count the accumulator window that died with the old process
+        in ``monitor_rows_lost_total`` (bounded by the telemetry fetch
+        cadence — never silently wrong); (4) REPLAY every busy slot whose
+        descriptor is not still queued (those reach the collector
+        normally): the request slabs hold the full pre-encoded input and
+        the packed programs are pure, so the replayed answer is
+        bit-identical to what the dead engine would have served; (5) ring
+        every worker doorbell with its full outstanding completion count
+        — stranded entries whose credit died with the old incarnation
+        flush through (surplus credit is discarded consumer-side)."""
+        ring = self.ring
+        # Injection point (mlops_tpu/faults): delay = a slow re-attach
+        # (stretches the brownout window the chaos smoke measures);
+        # raise = a failed re-attach — this engine process exits nonzero
+        # and the supervisor retries with a fresh fork.
+        faults.fire("serve.ring.reattach")
+        incarnation = int(ring.eng_vals[ENG_INCARNATION]) + 1
+        ring.eng_vals[ENG_INCARNATION] = incarnation
+        ring.recover_engine_locks()
+        # Monotone-counter seeding for the ABSOLUTE mirrors: degraded
+        # dispatches, lifecycle counters, and shape histograms all mirror
+        # in-process totals that restart at zero with this process —
+        # without bases/seeding, the first telemetry tick after a respawn
+        # would regress the exported counters (a Prometheus counter
+        # reset, and a chaos-smoke monotonicity failure).
+        self._degraded_base = float(ring.rob_vals[ROB_DEGRADED])
+        if float(ring.life_vals[LIFE_HAS]):
+            self._life_base = {
+                "drift_triggers": float(ring.life_vals[LIFE_TRIGGERS]),
+                "breaker_trips": float(
+                    ring.life_vals[LIFE_BREAKER_TRIPS]
+                ),
+                "promotions": {
+                    outcome: float(ring.life_promos[i])
+                    for i, outcome in enumerate(LIFE_OUTCOMES)
+                },
+            }
+        stats = getattr(self.engine, "shape_stats", None)
+        if stats is not None and float(ring.shape_meta[0]) > 0:
+            from mlops_tpu.trace.shapes import read_table
+
+            stats.seed(
+                read_table(ring.shape_keys, ring.shape_vals),
+                t0=float(ring.shape_meta[0]),
+            )
+        rows_lost = 0.0
+        if self._accumulating and float(ring.mon_vals[MON_HAS]):
+            self.engine.seed_monitor_totals(
+                float(ring.mon_vals[MON_ROWS]),
+                float(ring.mon_vals[MON_OUTLIERS]),
+                float(ring.mon_vals[MON_BATCHES]),
+                np.asarray(ring.mon_drift_sum, np.float64),
+                np.asarray(ring.mon_drift_last, np.float64),
+            )
+        pending = ring.pending_submissions()
+        replay = [
+            (slot, int(ring.slot_gen[slot]))
+            for slot in range(ring.n_slots)
+            if int(ring.slot_busy[slot]) and slot not in pending
+        ]
+        replay_rows = sum(int(ring.slot_n[slot]) for slot, _ in replay)
+        if self._accumulating:
+            # The dead engine's device accumulator window: rows it folded
+            # on device (ENG_ROWS_DISPATCHED) minus rows a telemetry
+            # fetch preserved (MON_ROWS), minus the rows the replay below
+            # re-folds. Counted, then the dispatch baseline re-anchors to
+            # the fetched totals so the replayed rows land exactly once.
+            dispatched = float(ring.eng_vals[ENG_ROWS_DISPATCHED])
+            fetched = float(ring.mon_vals[MON_ROWS])
+            rows_lost = max(0.0, dispatched - fetched - replay_rows)
+            ring.eng_vals[ENG_ROWS_LOST] += rows_lost
+            ring.eng_vals[ENG_ROWS_DISPATCHED] = fetched
+        if replay:
+            import concurrent.futures
+
+            pending_jobs = []
+            for job in self._make_jobs(replay):
+                self._inflight.acquire()
+                pending_jobs.append(self._pool.submit(self._run_job, job))
+            # Synchronous by design: every parked request is re-answered
+            # (or expired against its own deadline) before the ready flag
+            # flips — "resume" in the runbook's timeline means exactly
+            # this join having completed. An unexpected _run_job failure
+            # re-raises: a half-replayed engine must exit and let the
+            # supervisor retry with a fresh fork, not limp into ready.
+            concurrent.futures.wait(pending_jobs)
+            for job_future in pending_jobs:
+                exc = job_future.exception()
+                if exc is not None:
+                    raise exc
+            ring.eng_vals[ENG_REPLAYED] += len(replay)
+            self._requests_since_fetch += len(replay)
+        # Generous credit flush, replay or not: any completion entry
+        # still queued (stranded by the death window between a push and
+        # its doorbell ring, or published for a worker that has not
+        # drained yet) gets credited; consumers discard the surplus.
+        for worker in range(ring.workers):
+            outstanding = int(ring.comp_head[worker]) - int(
+                ring.comp_tail[worker]
+            )
+            if outstanding > 0:
+                ring.worker_doorbells[worker].ring(outstanding)
+        return {
+            "incarnation": incarnation,
+            "replayed_slots": len(replay),
+            "replay_rows": replay_rows,
+            "monitor_rows_lost": rows_lost,
+        }
 
     def _handle_profile(self) -> None:
         """Claim a pending /debug/profile request word. Single-word
@@ -1094,6 +1382,15 @@ class RingService:
                         "ring dispatch failed (%d slots)", len(live)
                     )
                     raws, status = None, RESP_ERROR
+            if live and status == RESP_OK and self._accumulating:
+                # Rows now folded into the device accumulator but not yet
+                # preserved by a telemetry fetch — the re-attach reads
+                # this against MON_ROWS to bound what an engine death
+                # loses (monitor_rows_lost_total, ISSUE 11).
+                rows = sum(int(ring.slot_n[s]) for s, _ in live)
+                with self._mon_lock:
+                    ring.eng_vals[ENG_ROWS_DISPATCHED] += rows
+            incarnation = int(ring.eng_vals[ENG_INCARNATION])
             for i, (slot, gen) in enumerate(live):
                 # Stale-generation write guard: if the slot has moved on
                 # (its front end crashed and the respawned incarnation
@@ -1110,9 +1407,15 @@ class RingService:
                     resp_out[:] = out
                     resp_drift[:] = drift
                 ring.resp_status[slot] = status
+                # Incarnation stamp (with resp_gen, before the push): the
+                # consumer trusts a completion only when this matches the
+                # live incarnation word — a dead engine's leftovers are
+                # dropped and re-answered by the replay instead.
+                ring.resp_incarnation[slot] = incarnation
                 ring.resp_gen[slot] = gen
             for slot, gen in expired:
                 ring.resp_status[slot] = RESP_EXPIRED
+                ring.resp_incarnation[slot] = incarnation
                 ring.resp_gen[slot] = gen
             # The doorbell count IS the owner's consumption credit: ring
             # AFTER the pushes with how many landed, per owner.
@@ -1244,10 +1547,13 @@ class RingService:
     def _write_robustness(self) -> None:
         """Mirror the engine's degraded-dispatch total into shm (a host
         int read + one f64 store, no device work) so every front end's
-        /metrics renders it."""
+        /metrics renders it. The respawn base keeps the exported counter
+        monotone across engine incarnations (reattach)."""
         degraded = getattr(self.engine, "degraded_dispatch_total", 0)
         with self._mon_lock:
-            self.ring.rob_vals[ROB_DEGRADED] = float(degraded)
+            self.ring.rob_vals[ROB_DEGRADED] = (
+                self._degraded_base + float(degraded)
+            )
 
     def _write_shapes(self) -> None:
         """Mirror the engine's tracewire shape histograms into the ring's
@@ -1266,7 +1572,29 @@ class RingService:
         if lifecycle is None:
             return
         try:
-            self.ring.write_lifecycle(lifecycle.metrics_snapshot())
+            snapshot = lifecycle.metrics_snapshot()
+            base = self._life_base
+            if base and snapshot:
+                # Respawn bases: a fresh controller's counters restart
+                # at zero — fold the dead incarnation's published totals
+                # back in so drift_trigger/promotions/breaker-trip
+                # counters never regress across an engine respawn.
+                snapshot = dict(snapshot)
+                snapshot["drift_triggers"] = (
+                    snapshot.get("drift_triggers", 0)
+                    + base["drift_triggers"]
+                )
+                snapshot["breaker_trips"] = (
+                    snapshot.get("breaker_trips", 0)
+                    + base["breaker_trips"]
+                )
+                promotions = dict(snapshot.get("promotions", {}))
+                for outcome, count in base["promotions"].items():
+                    promotions[outcome] = (
+                        promotions.get(outcome, 0) + count
+                    )
+                snapshot["promotions"] = promotions
+            self.ring.write_lifecycle(snapshot)
         # Telemetry breadth contract: a controller mid-transition (or a
         # snapshot bug) costs one gauge refresh, never the telemetry
         # thread.

@@ -43,6 +43,20 @@ MON_ROWS, MON_OUTLIERS, MON_BATCHES, MON_FETCHES, MON_FETCHED_AT, MON_HAS = (
 # deadline expiries (descriptors completed RESP_EXPIRED without a
 # dispatch) and degraded-shape dispatches.
 ROB_EXPIRED_ENGINE, ROB_DEGRADED = range(2)
+# Field indices of the ring's engine-supervision block (ISSUE 11,
+# RequestRing eng_vals). One writer per cell: INCARNATION / REPLAYED /
+# ROWS_LOST / ROWS_DISPATCHED belong to the (single, serialized) engine
+# process; DOWN_SINCE and RESPAWNS to the supervisor (DOWN_SINCE is also
+# cleared by the engine at ready — the two writers never race because the
+# supervisor only stamps it after the engine died).
+(
+    ENG_INCARNATION,
+    ENG_DOWN_SINCE,
+    ENG_RESPAWNS,
+    ENG_REPLAYED,
+    ENG_ROWS_LOST,
+    ENG_ROWS_DISPATCHED,
+) = range(6)
 # Promotion outcomes, in their ring-array order (write_lifecycle /
 # render_ring_metrics and the single-process render share this tuple so
 # the label sets can never diverge between telemetry planes).
@@ -173,6 +187,39 @@ class ServingMetrics:
         ]
 
     @staticmethod
+    def survivability_lines(
+        respawns: int,
+        replayed: int,
+        rows_lost: float,
+        parked: int,
+        brownout: int,
+        incarnation: int = 0,
+    ) -> list[str]:
+        """The engine-survivability block (ISSUE 11) — ONE definition
+        shared by the single-process render and the ring render so both
+        telemetry planes export identical series names. Always emitted
+        (zero baseline keeps the chaos smoke's monotonicity contract
+        checkable); on the single-process plane — where there is no
+        separate engine process to kill — every value is structurally 0."""
+        return [
+            "# TYPE mlops_tpu_engine_respawn_total counter",
+            f"mlops_tpu_engine_respawn_total {int(respawns)}",
+            "# TYPE mlops_tpu_replayed_slots_total counter",
+            f"mlops_tpu_replayed_slots_total {int(replayed)}",
+            "# TYPE mlops_tpu_monitor_rows_lost_total counter",
+            f"mlops_tpu_monitor_rows_lost_total {int(rows_lost)}",
+            "# TYPE mlops_tpu_parked_requests gauge",
+            f"mlops_tpu_parked_requests {int(parked)}",
+            "# TYPE mlops_tpu_brownout_shed_total counter",
+            f"mlops_tpu_brownout_shed_total {int(brownout)}",
+            # 0 on the single-process plane (there is no supervised
+            # engine child to count incarnations of) — exported anyway
+            # so the series SET is identical across planes.
+            "# TYPE mlops_tpu_engine_incarnation gauge",
+            f"mlops_tpu_engine_incarnation {int(incarnation)}",
+        ]
+
+    @staticmethod
     def lifecycle_lines(snapshot: dict | None) -> list[str]:
         """The lifecycle gauge block — ONE definition shared by the
         single-process render and the ring render's label set, so the two
@@ -277,6 +324,11 @@ class ServingMetrics:
                     self.trace_dropped,
                 )
             )
+            # Single-process plane: the engine lives in THIS process, so
+            # there is no respawn/replay/parking machinery — the block is
+            # structurally zero but still exported (identical series set
+            # across planes; monotonicity stays checkable).
+            lines.extend(self.survivability_lines(0, 0, 0, 0, 0))
             lines.extend(self.lifecycle_lines(self.lifecycle))
             return "\n".join(lines) + "\n"
 
@@ -392,6 +444,19 @@ def render_ring_metrics(ring) -> str:
             int(ring.expired.sum()) + int(ring.rob_vals[ROB_EXPIRED_ENGINE]),
             int(ring.rob_vals[ROB_DEGRADED]),
             int(ring.trace_dropped.sum()),
+        )
+    )
+    # Engine-survivability block (ISSUE 11): supervisor/engine cells plus
+    # the per-worker parking/brownout cells summed into plane totals —
+    # identical series names to the single-process render's zero baseline.
+    lines.extend(
+        ServingMetrics.survivability_lines(
+            int(ring.eng_vals[ENG_RESPAWNS]),
+            int(ring.eng_vals[ENG_REPLAYED]),
+            float(ring.eng_vals[ENG_ROWS_LOST]),
+            int(ring.parked.sum()),
+            int(ring.brownout_shed.sum()),
+            incarnation=int(ring.eng_vals[ENG_INCARNATION]),
         )
     )
     if float(ring.shape_meta[0]) > 0:

@@ -103,8 +103,11 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                                 "full; the response carries a Retry-After "
                                 "header (seconds) and the request was NOT "
                                 "scored — retry after the advertised "
-                                "delay. Deadline exhaustion is a 504, "
-                                "never a 503."
+                                "delay. During an engine respawn "
+                                "(brownout) the same contract applies "
+                                "with Retry-After advertising the "
+                                "respawn ETA. Deadline exhaustion is a "
+                                "504, never a 503."
                             ),
                             "headers": {
                                 "Retry-After": {

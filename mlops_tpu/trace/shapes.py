@@ -81,6 +81,32 @@ class ShapeStats:
             row[2] += padded
             row[3 + bucket] += 1
 
+    def seed(
+        self, entries: dict[str, list[float]], t0: float | None = None
+    ) -> None:
+        """Install a prior engine incarnation's mirrored totals
+        (ISSUE 11): a respawned engine starts its in-memory histograms at
+        zero, and re-mirroring absolute zeros over the shm table would
+        regress the exported ``_total``/``_bucket`` counters into a
+        Prometheus counter reset. Seeding folds the dead incarnation's
+        last-published entries back in (first-seen row order preserved)
+        and restores the armed-at rate base so ``useful_rows_per_s``
+        keeps its denominator across the respawn."""
+        with self._lock:
+            for entry, vals in entries.items():
+                row = self._entries.get(entry)
+                if row is None:
+                    self._entries[entry] = [float(v) for v in vals]
+                else:
+                    for i, v in enumerate(vals):
+                        row[i] += float(v)
+                if entry not in self._table_rows and (
+                    len(self._table_rows) < TABLE_ROWS
+                ):
+                    self._table_rows[entry] = len(self._table_rows)
+            if t0 is not None and t0 > 0:
+                self.t0 = t0
+
     # ----------------------------------------------------------- snapshots
     def snapshot(self) -> dict[str, list[float]]:
         with self._lock:

@@ -2,9 +2,9 @@
 
 The deployment-path proof for ISSUE 9 (faultline): train a tiny bundle,
 launch the REAL `mlops-tpu serve --workers 2` plane with a seeded fault
-plan armed through `MLOPS_TPU_FAULTS` (every process — engine, zygote,
-front ends — arms at import), and drive the failure scenarios end to
-end:
+plan armed through `MLOPS_TPU_FAULTS` (every process — supervisor,
+engine, front ends — arms at import), and drive the failure scenarios
+end to end:
 
 1. engine stall  — a seeded delay fault on `serve.engine.dispatch*`:
    requests carrying `x-request-deadline-ms` answer the documented 504
@@ -13,12 +13,19 @@ end:
    traffic (and completes 200 itself).
 3. overload      — a connection burst against a deliberately tiny ring:
    every response is in the contract set (sheds answer 503+Retry-After).
-4. worker kill   — SIGKILL a front end mid-traffic: the zygote respawns
-   it and the plane keeps serving (slot quarantine drains).
-5. mid-write kills (subprocesses) — SIGKILL between tmp-write and rename
+4. worker kill   — SIGKILL a front end mid-traffic: the supervisor
+   respawns it and the plane keeps serving (slot quarantine drains).
+5. ENGINE kill (ISSUE 11) — SIGKILL the engine process under live
+   budgeted traffic: the supervisor forks a replacement that warm-starts
+   from the AOT cache, re-attaches under a new incarnation, and replays
+   the busy slots. Asserts: zero statuses outside {200, 503, 504} during
+   the outage, every 504 inside its deadline budget, identical 200
+   bodies across the respawn (replay bit-identity), recovery, and
+   `engine_respawn_total >= 1` with MONOTONE counters across the respawn.
+6. mid-write kills (subprocesses) — SIGKILL between tmp-write and rename
    on the compile-cache persist, the reservoir snapshot, and
    `utils.io.atomic_write`: no torn file ever lands.
-6. cache corruption — seeded bit flips at `compilecache.read`: counted
+7. cache corruption — seeded bit flips at `compilecache.read`: counted
    discard + recompile, correct outputs, self-healed store.
 
 Global assertions: every /predict status is in {200, 413, 422, 503, 504},
@@ -277,6 +284,10 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
             "serve.request_timeout_s=6",
             "serve.drain_deadline_s=8", "serve.zygote_join_deadline_s=10",
             "serve.engine_zygote_join_s=16",
+            # AOT cache: the first boot compiles + persists; the engine
+            # RESPAWN in the kill scenario warm-starts by deserializing,
+            # which is what keeps the brownout window tight.
+            f"cache.dir={os.path.join(tmp, 'chaos-serve-cache')}",
         ],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -415,7 +426,7 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         assert not any(t.is_alive() for t in burst), "burst client hung"
         print("# chaos-smoke: overload burst OK", flush=True)
 
-        # ---- scenario: worker kill -> zygote respawn -----------------
+        # ---- scenario: worker kill -> supervisor respawn -------------
         spawn_line = next(line for line in log_lines if "spawned" in line)
         pids = [
             int(p) for p in
@@ -428,7 +439,7 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         ):
             time.sleep(0.2)
         assert any("respawning" in line for line in log_lines), (
-            "zygote never respawned the SIGKILLed front end"
+            "supervisor never respawned the SIGKILLed front end"
         )
         deadline = time.time() + 30
         served = False
@@ -443,6 +454,94 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         print("# chaos-smoke: worker kill OK (respawned, still serving)",
               flush=True)
 
+        # ---- scenario: ENGINE kill -> respawn + replay (ISSUE 11) ----
+        # Budgeted hammer traffic across a SIGKILL of the engine process:
+        # requests in flight at kill time park and are replayed by the
+        # respawned incarnation; 504 is legal ONLY on true budget expiry
+        # (budget = the 5 s header here, tighter than request_timeout_s);
+        # every 200 body must be identical to the pre-kill body (replay
+        # bit-identity: same AOT artifacts, same slab input, pure packed
+        # predict).
+        engine_line = next(line for line in log_lines if "engine pid" in line)
+        engine_pid = int(re.search(r"engine pid (\d+)", engine_line).group(1))
+        status, _, ref_body = raw_predict(port, body)
+        assert status == 200, "no reference response before the engine kill"
+        kill_results: list[tuple[int, float, bytes]] = []
+        kill_lock = threading.Lock()
+        hammer_stop = threading.Event()
+
+        def kill_hammer() -> None:
+            while not hammer_stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    s_, _, b_ = raw_predict(
+                        port, body,
+                        headers={"x-request-deadline-ms": "5000"},
+                        timeout=30,
+                    )
+                except OSError:
+                    continue  # accept-queue churn during the brownout
+                with kill_lock:
+                    kill_results.append(
+                        (s_, time.perf_counter() - t0, b_)
+                    )
+
+        hammers = [threading.Thread(target=kill_hammer) for _ in range(3)]
+        for t in hammers:
+            t.start()
+        time.sleep(1.0)  # traffic flowing; some requests in flight
+        os.kill(engine_pid, signal.SIGKILL)
+        deadline = time.time() + 60
+        while time.time() < deadline and not any(
+            "engine process (pid" in line and "respawning" in line
+            for line in log_lines
+        ):
+            time.sleep(0.2)
+        assert any(
+            "engine process (pid" in line and "respawning" in line
+            for line in log_lines
+        ), "supervisor never respawned the SIGKILLed engine"
+        # Keep hammering until the respawned engine serves again.
+        deadline = time.time() + 180
+        recovered = False
+        while time.time() < deadline and not recovered:
+            with kill_lock:
+                n_before = len(kill_results)
+            time.sleep(0.5)
+            with kill_lock:
+                recovered = any(
+                    s_ == 200 for s_, _, _ in kill_results[n_before:]
+                )
+        hammer_stop.set()
+        for t in hammers:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in hammers), "kill hammer hung"
+        assert recovered, "plane never recovered after the engine kill"
+        with kill_lock:
+            kill_statuses = [s_ for s_, _, _ in kill_results]
+            for s_, elapsed, _ in kill_results:
+                record_status(s_)
+                assert s_ in {200, 503, 504}, (
+                    f"status {s_} during the engine-kill window"
+                )
+                if s_ == 504:
+                    assert elapsed <= 6.5, (
+                        f"504 took {elapsed:.2f}s — outside its 5 s budget"
+                    )
+            for s_, _, b_ in kill_results:
+                if s_ == 200:
+                    assert b_ == ref_body, (
+                        "a 200 body across the respawn differs from the "
+                        "pre-kill reference (replay bit-identity broken)"
+                    )
+        tally_kill = {
+            s_: kill_statuses.count(s_) for s_ in sorted(set(kill_statuses))
+        }
+        print(
+            "# chaos-smoke: engine kill OK (respawned + replayed; "
+            f"window tally {tally_kill})", flush=True,
+        )
+
         # ---- metrics scrape #2: counters are monotone ----------------
         status, text = get(f"http://127.0.0.1:{port}/metrics", 30)
         assert status == 200
@@ -453,6 +552,9 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
             if k in second and second[k] < first[k]
         }
         assert not regressions, f"non-monotone counters: {regressions}"
+        assert second.get("mlops_tpu_engine_respawn_total", 0) >= 1, (
+            "engine_respawn_total missing or zero after the engine kill"
+        )
 
         # ---- the global status contract ------------------------------
         with statuses_lock:

@@ -112,6 +112,11 @@ def test_serve_drain_deadline_knobs_validate():
         ).validate()
     with _pytest.raises(ServeConfigError, match="engine_zygote_join_s"):
         ServeConfig(engine_zygote_join_s=36.0).validate()
+    # ISSUE 11: the brownout 503 contract promises a positive respawn
+    # ETA; zero/negative is rejected by name on the multi-worker plane.
+    with _pytest.raises(ServeConfigError, match="engine_respawn_eta_s"):
+        ServeConfig(workers=2, engine_respawn_eta_s=-1.0).validate()
+    ServeConfig(workers=2, engine_respawn_eta_s=2.5).validate()
 
 
 def test_lifecycle_breaker_knobs_validate():

@@ -27,6 +27,7 @@ import signal
 import socket
 import threading
 import time
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -621,6 +622,11 @@ def test_respawned_client_counts_quarantined_slots_as_inflight():
         small, _ = ring.worker_slots(0)
         busy = small[0]
         ring.slot_busy[busy] = 1  # the dead incarnation's in-flight slot
+        # The dead incarnation also had requests PARKED (engine outage):
+        # their decrements died with its event loop, so the respawned
+        # client must zero the cell — not report phantom parked requests
+        # forever (ISSUE 11 review finding).
+        ring.parked[0] = 3
         # Worst-case ordering: the engine answered (stale generation) and
         # the DEAD incarnation drained the doorbell credit before dying —
         # the respawned client must seed its credit from the entries
@@ -631,12 +637,373 @@ def test_respawned_client_counts_quarantined_slots_as_inflight():
         client = RingClient(ring, 0)
         assert int(ring.inflight[0, SMALL]) == 1
         assert int(ring.inflight[0, LARGE]) == 0
+        assert int(ring.parked[0]) == 0, "phantom parked gauge survived"
         assert client._credit == 1
         client.on_doorbell()
         assert int(ring.inflight[0, SMALL]) == 0
         assert busy in client._free[SMALL]
     finally:
         ring.close()
+
+
+# ------------------------------------------------ survivable engine (11)
+def test_engine_reattach_replays_busy_slot_bit_identically(
+    engine, sample_request
+):
+    """ISSUE 11 tentpole correctness: a slot whose descriptor the dead
+    engine POPPED but never answered (busy in shm, absent from the sub
+    queue) is replayed by the respawned engine's re-attach — and the
+    replayed answer is bit-identical to an uninterrupted run, because the
+    slab holds the full pre-encoded input and packed predict is pure."""
+    import asyncio
+    import json as _json
+
+    from mlops_tpu.schema import records_to_columns
+    from mlops_tpu.serve.ipc import RingClient
+    from mlops_tpu.serve.wire import RESP_OK, format_response
+
+    expected = engine.predict_records(sample_request)
+
+    async def scenario():
+        ring = RequestRing(
+            workers=1, slots_small=2, slots_large=1, large_rows=8
+        )
+        try:
+            client = RingClient(ring, 0)
+            ds = engine.bundle.preprocessor.encode(
+                records_to_columns(sample_request)
+            )
+            slot = client.claim(len(sample_request))
+            future = client.submit(slot, ds.cat_ids, ds.numeric)
+            # Simulate the kill -9 window: the dead engine popped the
+            # descriptor (tail advanced past it) and died mid-batch.
+            popped = ring.pop_submissions()
+            assert [s for s, _ in popped] == [slot]
+            assert int(ring.slot_busy[slot]) == 1
+            service = RingService(engine, ring, max_inflight=2, threads=2)
+            try:
+                stats = service.reattach()
+            finally:
+                service.stop()
+            assert stats["incarnation"] == 1
+            assert stats["replayed_slots"] == 1
+            assert stats["replay_rows"] == len(sample_request)
+            client.on_doorbell()  # the re-attach flush credited the entry
+            assert future.done() and int(future.result()) == RESP_OK
+            pred, out, drift = client.response_arrays(slot)
+            got = format_response(
+                np.array(pred), np.array(out), np.array(drift)
+            )
+            client.release(slot)
+            assert got == _json.loads(_json.dumps(expected))
+            assert int(ring.slot_busy.sum()) == 0
+        finally:
+            ring.close()
+
+    asyncio.run(scenario())
+
+
+def test_dead_incarnation_completion_is_dropped_not_double_served():
+    """A completion a dead engine incarnation left behind must be DROPPED
+    by the incarnation guard (nothing about a process that died mid-batch
+    is trusted) — the replay's fresh completion, stamped with the live
+    incarnation, is what resolves the future, exactly once."""
+    import asyncio
+
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.ipc import RingClient
+    from mlops_tpu.serve.metrics import ENG_INCARNATION
+
+    async def scenario():
+        ring = RequestRing(
+            workers=1, slots_small=2, slots_large=1, large_rows=8
+        )
+        try:
+            ring.eng_vals[ENG_INCARNATION] = 1  # incarnation 1 is live
+            client = RingClient(ring, 0)
+            slot = client.claim(1)
+            cat = np.zeros((1, SCHEMA.num_categorical), np.int32)
+            num = np.zeros((1, SCHEMA.num_numeric), np.float32)
+            future = client.submit(slot, cat, num)
+            gen = int(ring.slot_gen[slot])
+            # Incarnation 1 answered into the slab and queued the
+            # completion... then got kill -9'd; the supervisor respawned
+            # and the replacement bumped the incarnation word.
+            ring.resp_status[slot] = 0
+            ring.resp_incarnation[slot] = 1
+            ring.resp_gen[slot] = gen
+            ring.push_completion(slot, gen)
+            ring.eng_vals[ENG_INCARNATION] = 2
+            ring.worker_doorbells[0].ring(1)
+            client.on_doorbell()
+            assert not future.done(), (
+                "a dead incarnation's completion was served"
+            )
+            # The replay (incarnation 2) re-answers the same (slot, gen).
+            ring.resp_incarnation[slot] = 2
+            ring.push_completion(slot, gen)
+            ring.worker_doorbells[0].ring(1)
+            client.on_doorbell()
+            assert future.done() and int(future.result()) == 0
+            client.release(slot)
+            assert int(ring.inflight.sum()) == 0
+        finally:
+            ring.close()
+
+    asyncio.run(scenario())
+
+
+def test_duplicate_completion_across_respawn_is_not_double_released():
+    """Replay can duplicate a completion the dead incarnation had already
+    queued (its entry consumes a flush credit after the replay re-stamped
+    the slot). The FIRST pop resolves the future; the duplicate must be a
+    no-op — the awaiting handler owns the release, and releasing again
+    would put the slot on the free list twice."""
+    import asyncio
+
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.ipc import RingClient
+
+    async def scenario():
+        ring = RequestRing(
+            workers=1, slots_small=2, slots_large=1, large_rows=8
+        )
+        try:
+            client = RingClient(ring, 0)
+            slot = client.claim(1)
+            cat = np.zeros((1, SCHEMA.num_categorical), np.int32)
+            num = np.zeros((1, SCHEMA.num_numeric), np.float32)
+            future = client.submit(slot, cat, num)
+            gen = int(ring.slot_gen[slot])
+            ring.resp_status[slot] = 0
+            ring.resp_gen[slot] = gen  # incarnation 0 == live word: trusted
+            ring.push_completion(slot, gen)
+            ring.push_completion(slot, gen)  # the replay's duplicate
+            ring.worker_doorbells[0].ring(2)
+            client.on_doorbell()
+            assert future.done() and int(future.result()) == 0
+            free = sum(len(f) for f in client._free)
+            inflight = int(ring.inflight.sum())
+            assert inflight == 1, "slot must stay held by the handler"
+            client.release(slot)  # the handler's release — exactly once
+            assert sum(len(f) for f in client._free) == free + 1
+            assert int(ring.inflight.sum()) == 0
+        finally:
+            ring.close()
+
+    asyncio.run(scenario())
+
+
+def test_brownout_shed_advertises_respawn_eta_and_parks_admissions(
+    prep_path,
+):
+    """Engine-outage admission contract (ISSUE 11): while the engine is
+    down, admissions PARK against the slot partition (the parked gauge
+    counts them); once the partition is full, sheds become BROWNOUT 503s
+    whose Retry-After advertises the respawn ETA and which count in
+    brownout_shed_total — and /metrics exports the whole block."""
+    from mlops_tpu.serve.metrics import ENG_DOWN_SINCE
+
+    stub = _SlowStubEngine(delay_s=2.5)
+    with multi_worker_plane(
+        stub, prep_path, workers=1, slots_small=1, slots_large=1,
+        engine_respawn_eta_s=7.0, request_timeout_s=30.0,
+    ) as (port, ring, _, _svc):
+        # The supervisor's detect-time moves: readiness drops and the
+        # outage start is stamped (the stub RingService keeps running,
+        # standing in for the respawned engine's replay).
+        ring.set_ready(False)
+        ring.eng_vals[ENG_DOWN_SINCE] = time.monotonic()
+        results: list = [None, None]
+        threads = [
+            threading.Thread(
+                target=lambda i=i: results.__setitem__(i, predict(port, [{}]))
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and int(ring.parked.sum()) < 2:
+            time.sleep(0.02)
+        assert int(ring.parked.sum()) == 2, "admissions did not park"
+        status, _, body = http_exchange(port, "GET", "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "mlops_tpu_parked_requests 2" in text
+        assert "mlops_tpu_engine_respawn_total" in text
+        assert "mlops_tpu_replayed_slots_total" in text
+        assert "mlops_tpu_monitor_rows_lost_total" in text
+        # Partition full + engine down => brownout 503 with the ETA.
+        status, headers, payload = predict(port, [{}])
+        assert status == 503, payload
+        retry_after = int(headers["retry-after"])
+        assert 1 <= retry_after <= 7
+        assert "restarting" in str(payload)
+        assert int(ring.brownout_shed.sum()) == 1
+        for t in threads:
+            t.join(timeout=30)
+        # Parked admissions were answered (the stand-in engine replayed
+        # them), not 504'd: budget never expired.
+        assert [r[0] for r in results] == [200, 200]
+        assert int(ring.parked.sum()) == 0
+        ring.set_ready(True)
+
+
+def test_survivability_series_zero_baseline_on_single_process_plane():
+    """The single-process render exports the same survivability series
+    names at a structural zero baseline — scrapes stay plane-portable and
+    the chaos smoke's monotonicity check covers them everywhere."""
+    from mlops_tpu.serve.metrics import ServingMetrics
+
+    text = ServingMetrics().render()
+    for series in (
+        "mlops_tpu_engine_respawn_total 0",
+        "mlops_tpu_replayed_slots_total 0",
+        "mlops_tpu_monitor_rows_lost_total 0",
+        "mlops_tpu_parked_requests 0",
+        "mlops_tpu_brownout_shed_total 0",
+        "mlops_tpu_engine_incarnation 0",
+    ):
+        assert series in text, series
+
+
+@pytest.mark.slow  # boots the real CLI plane twice across an engine kill
+def test_engine_kill9_is_survivable_brownout_on_real_plane(
+    tiny_pipeline, tmp_path
+):
+    """The deployed-shape seeded faultline proof (ISSUE 11 acceptance):
+    kill -9 the ENGINE process of a live 2-worker plane with a request
+    held in flight by a seeded dispatch stall. The supervisor respawns
+    the engine (warm from the AOT cache), the replacement re-attaches and
+    REPLAYS the busy slot, and the parked request answers 200 with a body
+    bit-identical to the pre-kill response — 504 never fires because the
+    budget holds, and /metrics shows the respawn + replay counters."""
+    import json as _json
+    import re
+    import subprocess
+    import sys
+
+    config, result = tiny_pipeline
+    plan = tmp_path / "plan.toml"
+    # Seeded stalls: the first TWO dispatches of each engine process hang
+    # 2 s. Fire 1 is absorbed by the pre-kill reference request; fire 2
+    # holds the kill victim in the engine — guaranteeing a busy, popped,
+    # unanswered slot at kill time. The respawned engine's fresh counters
+    # stall its replay dispatch the same way, proving parked requests
+    # ride out a slow replay too.
+    plan.write_text(
+        'seed = 11\n[[fault]]\npoint = "serve.engine.dispatch*"\n'
+        'mode = "delay"\ndelay_s = 2.0\nmax_fires = 2\n'
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MLOPS_TPU_FAULTS"] = str(plan)
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlops_tpu", "serve", "--workers", "2",
+            "serve.host=127.0.0.1", f"serve.port={port}",
+            f"serve.model_directory={result.bundle_dir}",
+            "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+            "serve.request_timeout_s=90",
+            f"cache.dir={tmp_path / 'cache'}",
+            "serve.drain_deadline_s=8", "serve.zygote_join_deadline_s=10",
+            "serve.engine_zygote_join_s=16",
+        ],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    log_lines: list[str] = []
+    pump = threading.Thread(
+        target=lambda: log_lines.extend(iter(server.stdout.readline, "")),
+        daemon=True,
+    )
+    pump.start()
+    try:
+        deadline = time.time() + 420
+        ready = False
+        while time.time() < deadline and not ready:
+            assert server.poll() is None, "\n".join(log_lines[-40:])
+            try:
+                status, _, _ = http_exchange(port, "GET", "/healthz/ready")
+                ready = status == 200
+            except OSError:
+                pass
+            if not ready:
+                time.sleep(0.5)
+        assert ready, "plane never became ready"
+        # Pre-kill reference response (absorbs the first seeded stall).
+        status, _, expected = predict(port, [{"credit_limit": 9000}])
+        assert status == 200
+        engine_line = next(
+            line for line in log_lines if "engine pid" in line
+        )
+        engine_pid = int(re.search(r"engine pid (\d+)", engine_line).group(1))
+
+        inflight: dict = {}
+
+        def stalled_call():
+            t0 = time.perf_counter()
+            s_, _, payload = predict(port, [{"credit_limit": 9000}])
+            inflight["result"] = (s_, payload, time.perf_counter() - t0)
+
+        # The kill victim: submitted, popped, held by the seeded stall —
+        # then the engine dies under it. The replay must answer it.
+        t = threading.Thread(target=stalled_call)
+        t.start()
+        time.sleep(0.25)  # let it reach the engine
+        os.kill(engine_pid, signal.SIGKILL)
+        # A second request ADMITTED DURING the outage parks on its
+        # deadline budget and is answered once the replacement attaches.
+        parked: dict = {}
+
+        def parked_call():
+            s_, _, payload = predict(port, [{"credit_limit": 9000}])
+            parked["result"] = (s_, payload)
+
+        t2 = threading.Thread(target=parked_call)
+        t2.start()
+        t.join(timeout=180)
+        t2.join(timeout=180)
+        assert not t.is_alive() and not t2.is_alive(), "parked call hung"
+        status, payload, elapsed = inflight["result"]
+        assert status == 200, (status, payload)
+        # Bit-identical across the respawn: same AOT artifacts, same
+        # pre-encoded slab input, pure packed predict.
+        assert payload == expected
+        assert elapsed > 1.0, "the kill victim never actually parked"
+        assert parked["result"][0] == 200
+        assert parked["result"][1] == expected
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status, _, body = http_exchange(port, "GET", "/metrics")
+            if status == 200 and b"mlops_tpu_engine_respawn_total 1" in body:
+                break
+            time.sleep(0.5)
+        assert b"mlops_tpu_engine_respawn_total 1" in body
+        assert re.search(rb"mlops_tpu_replayed_slots_total [1-9]", body), (
+            body.decode()
+        )
+        assert b"mlops_tpu_engine_incarnation 2" in body
+        server.send_signal(signal.SIGTERM)
+        rc = server.wait(timeout=90)
+        pump.join(timeout=10)
+        log = "\n".join(log_lines)
+        assert rc == 0, log[-3000:]
+        assert "drained" in log
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+    expected_json = _json.dumps(expected, sort_keys=True)
+    assert _json.dumps(inflight["result"][1], sort_keys=True) == expected_json
 
 
 # ---------------------------------------------------------- lock hygiene
@@ -725,6 +1092,9 @@ def test_serveconfig_rejects_inconsistent_geometry_with_named_errors():
         cfg.validate()
     cfg = ServeConfig(workers=2, shed_retry_after_s=0)
     with pytest.raises(ServeConfigError, match="shed_retry_after_s"):
+        cfg.validate()
+    cfg = ServeConfig(workers=2, engine_respawn_eta_s=0.0)
+    with pytest.raises(ServeConfigError, match="engine_respawn_eta_s"):
         cfg.validate()
     cfg = ServeConfig(max_workers=0)
     with pytest.raises(ServeConfigError, match="max_workers"):
