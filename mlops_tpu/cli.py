@@ -73,6 +73,27 @@ def build_parser() -> argparse.ArgumentParser:
                 "all feeding one engine process over the shared-memory "
                 "ring; 0/1 = single-process server",
             )
+            p.add_argument(
+                "--tenants",
+                default=None,
+                help="multi-tenant fleet declaration (sugar for "
+                "serve.tenants_path=<file>): a tenants.toml naming N "
+                "tenants (name, bundle_dir, quota weight, default "
+                "tenant) served from ONE engine process — requests "
+                "route by the x-tenant header, ring-plane admission "
+                "(--workers >= 2) is weighted max-min fair per tenant "
+                "per slot class (the single-process plane reserves "
+                "each tenant a fixed slice of the dispatch pool "
+                "instead), and every per-tenant series and span "
+                "carries a tenant label",
+            )
+        if name == "trace-report":
+            p.add_argument(
+                "--tenant",
+                default=None,
+                help="only aggregate spans whose tenant label matches "
+                "(multi-tenant planes stamp every span with its tenant)",
+            )
     # `analyze` takes paths + flags, not config overrides: static analysis
     # must run identically with zero configuration (CI, pre-commit).
     analyze = sub.add_parser(

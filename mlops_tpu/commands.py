@@ -32,6 +32,13 @@ def run(args: argparse.Namespace) -> int:
     # form is the documented deployment invocation).
     if getattr(args, "workers", None) is not None:
         config.serve.workers = args.workers
+    # `serve --tenants tenants.toml` is sugar for
+    # `serve serve.tenants_path=<file>` (the multi-tenant fleet form).
+    if getattr(args, "tenants", None):
+        config.serve.tenants_path = args.tenants
+    # `trace-report --tenant NAME` is sugar for `trace.tenant=NAME`.
+    if getattr(args, "tenant", None):
+        config.trace.tenant = args.tenant
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
@@ -534,33 +541,89 @@ def _serve(config) -> int:
         # and engine — is safe.
         from mlops_tpu.serve.frontend import serve_multi_worker
 
-        return serve_multi_worker(config, _resolve_bundle(config, model_dir))
+        # A tenants.toml names every bundle itself — resolving
+        # serve.model_directory (default "latest") against the registry
+        # would fail a fleet-only deployment that never registered a
+        # "default" model.
+        bundle_dir = (
+            "" if config.serve.tenants_path
+            else _resolve_bundle(config, model_dir)
+        )
+        return serve_multi_worker(config, bundle_dir)
     from mlops_tpu.bundle import load_bundle
     from mlops_tpu.compilecache.cache import from_config
     from mlops_tpu.serve import InferenceEngine, serve_forever
 
-    bundle = load_bundle(_resolve_bundle(config, model_dir))
-    engine = InferenceEngine(
-        bundle,
-        buckets=tuple(config.serve.warmup_batch_sizes),
-        service_name=config.serve.service_name,
-        enable_grouping=config.serve.batch_window_ms > 0,
-        # cache.dir set (or MLOPS_TPU_CACHE_DIR, e.g. baked into the Docker
-        # image by `warmup`): readiness deserializes executables instead of
-        # recompiling them — restarts in seconds, not minutes.
-        compile_cache=from_config(config),
-        warmup_workers=config.cache.warmup_workers,
-    )
+    registry = None
+    if config.serve.tenants_path:
+        # Multi-tenant fleet on the single-process plane
+        # (mlops_tpu/tenancy/): N bundles behind one HTTP server, with
+        # architecture-identical tenants sharing compiled entries.
+        from mlops_tpu.tenancy import TenantRegistry, load_tenants_toml
+
+        try:
+            tenancy = load_tenants_toml(
+                config.serve.tenants_path
+            ).validate()
+        except ValueError as err:
+            raise SystemExit(str(err))
+        registry = TenantRegistry(
+            tenancy,
+            buckets=tuple(config.serve.warmup_batch_sizes),
+            service_name=config.serve.service_name,
+            enable_grouping=config.serve.batch_window_ms > 0,
+            compile_cache=from_config(config),
+            warmup_workers=config.cache.warmup_workers,
+        )
+        engine = registry.default_engine
+    else:
+        bundle = load_bundle(_resolve_bundle(config, model_dir))
+        engine = InferenceEngine(
+            bundle,
+            buckets=tuple(config.serve.warmup_batch_sizes),
+            service_name=config.serve.service_name,
+            enable_grouping=config.serve.batch_window_ms > 0,
+            # cache.dir set (or MLOPS_TPU_CACHE_DIR, e.g. baked into the
+            # Docker image by `warmup`): readiness deserializes
+            # executables instead of recompiling them — restarts in
+            # seconds, not minutes.
+            compile_cache=from_config(config),
+            warmup_workers=config.cache.warmup_workers,
+        )
     lifecycle = None
     if config.lifecycle.enabled:
         # Serve-integrated closed loop (mlops_tpu/lifecycle/): the
         # controller thread watches the monitor aggregates, retrains off
-        # the hot path, shadow-mirrors, and hot-promotes through gates.
+        # the hot path, shadow-mirrors, and hot-promotes through gates —
+        # ONE controller PER TENANT on a multi-tenant plane (each on a
+        # tenant-namespaced state dir; tenant A drifting retrains and
+        # promotes A alone).
         from mlops_tpu.lifecycle import LifecycleController
 
-        lifecycle = LifecycleController(engine, config)
+        if registry is not None:
+            from mlops_tpu.tenancy import tenant_scoped_config
+
+            # The 1-tenant "default" fleet keeps the UN-NAMESPACED state
+            # dir — same guard as the ring plane's _engine_main, so a
+            # deployment migrating between a bare model_directory and a
+            # one-tenant tenants.toml (or between planes) never abandons
+            # its reservoir/candidates/generation state.
+            single_default = (
+                len(registry) == 1 and registry.names[0] == "default"
+            )
+            lifecycle = [
+                LifecycleController(
+                    eng,
+                    config if single_default
+                    else tenant_scoped_config(config, name),
+                )
+                for name, eng in zip(registry.names, registry.engines)
+            ]
+        else:
+            lifecycle = LifecycleController(engine, config)
     serve_forever(
-        engine, config.serve, lifecycle=lifecycle, trace=config.trace
+        engine, config.serve, lifecycle=lifecycle, trace=config.trace,
+        registry=registry,
     )
     return 0
 
@@ -684,6 +747,14 @@ def _trace_report(config) -> int:
     from mlops_tpu.trace import format_report, load_spans, stage_report
 
     spans = load_spans(config.trace.dir)
+    if config.trace.tenant:
+        # Per-tenant slice (`--tenant` / trace.tenant): multi-tenant
+        # planes stamp every span with its tenant label; spans written
+        # before tenancy carry none and count as "default".
+        spans = [
+            span for span in spans
+            if span.get("tenant", "default") == config.trace.tenant
+        ]
     report = stage_report(spans)
     print(format_report(report), file=sys.stderr)
     print(json.dumps(report))

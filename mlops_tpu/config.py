@@ -258,6 +258,12 @@ class ServeConfig:
     # re-attach on the deployment box (bench: engine_respawn_gap_ms);
     # too low hammers retries into the still-full parking lot, too high
     # parks well-behaved clients longer than the outage
+    tenants_path: str = ""  # multi-tenant fleet declaration
+    # (mlops_tpu/tenancy/): a tenants.toml naming N tenants (name,
+    # bundle_dir, quota weight, default tenant) served from ONE engine
+    # process on either plane — `mlops-tpu serve --tenants <file>` is the
+    # flag sugar. Empty (default) = the single-tenant "default" fleet
+    # serving serve.model_directory, bit-identical to pre-tenancy serving
     profile_dir: str = ""  # jax.profiler trace dir for the /debug/profile
     # endpoints (SURVEY.md SS5.1). Empty = DISABLED (default): the routes
     # are unauthenticated, so tracing is opt-in per deployment — enable
@@ -556,6 +562,10 @@ class TraceConfig:
     flush_interval_s: float = 0.5  # background writer cadence; the drain
     # path flushes everything regardless, so this only bounds how long a
     # span sits in memory while the server runs
+    tenant: str = ""  # `trace-report` filter (`--tenant` flag sugar):
+    # only aggregate spans carrying this tenant label — multi-tenant
+    # planes (mlops_tpu/tenancy/) stamp every span with its tenant;
+    # pre-tenancy spans count as "default". Empty = all tenants
 
     def validate(self) -> "TraceConfig":
         problems: list[str] = []

@@ -451,6 +451,49 @@ class InferenceEngine:
                 self._exec[key] = fn
         return fn
 
+    def adopt_executables(self, donor: "InferenceEngine") -> None:
+        """Share a WARMED architecture-twin's compiled entries instead of
+        warming (mlops_tpu/tenancy/registry.py): the packed serving
+        programs take params/monitor/temperature as ARGUMENTS, so one
+        executable serves any tenant whose bundle matches the donor's
+        abstract signature — this engine keeps its OWN state refs
+        (`_dispatch_fused` reads them per dispatch) while the exec table,
+        the base jits, and crucially the donor's ``_compile_lock`` are
+        adopted BY REFERENCE. Sharing the lock is load-bearing: twin
+        tenants' concurrent novel-shape compiles must serialize on the
+        one lock guarding the one shared table (separate locks over a
+        shared dict would race `_compile_novel`'s double-check). A later
+        `swap_bundle` on this tenant re-points only ITS refs at the
+        candidate's table — the donor and every other twin keep serving
+        the shared entries untouched (per-tenant lifecycle isolation)."""
+        if not self._accumulate or not donor._accumulate:
+            raise ValueError(
+                "executable adoption requires device-accumulating (flax) "
+                "engines on both sides — the sklearn flavor has no "
+                "shareable compiled entries"
+            )
+        if not donor.ready:
+            raise ValueError("donor engine is not warmed")
+        # Adoption runs pre-traffic (registry warmup, starting thread),
+        # but the refs it swaps are the same ones swap_bundle guards —
+        # hold the declared _compile_lock -> _acc_lock order anyway so
+        # every write site of these fields shares one discipline. The
+        # lock handoff itself happens under the OLD lock (nobody else
+        # can hold it before the fleet serves).
+        with self._compile_lock:
+            with self._acc_lock:
+                self._exec = donor._exec
+                self._predict = donor._predict
+                self._predict_group = donor._predict_group
+                self._compile_lock = donor._compile_lock
+        self.ready = True
+        self.warmup_stats = {
+            "warmup_s": 0.0,
+            "programs": len(donor._exec),
+            "mode": "shared",
+            "cache": None,
+        }
+
     def set_shape_stats(self, stats) -> None:
         """Install (or clear, with None) the tracewire shape recorder: a
         `trace/shapes.ShapeStats` fed (entry, requested_rows, padded_rows)

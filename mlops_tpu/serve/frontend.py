@@ -114,13 +114,61 @@ class FrontendServer(HttpProtocol):
         worker_id: int,
         preprocessor: Any,
         trace: Any = None,
+        tenancy: Any = None,
     ) -> None:
+        from mlops_tpu.tenancy import QuotaGovernor, TenantRouter
+
         super().__init__(config)
         self.ring = ring
         self.worker_id = worker_id
-        self.preprocessor = preprocessor
+        # Tenant fleet (mlops_tpu/tenancy/): one preprocessor per tenant
+        # (each bundle's own encode contract, loaded at fork), the header
+        # router, and a per-worker weighted max-min admission governor
+        # over this worker's slot partition. A plain single preprocessor
+        # (every pre-tenancy caller) is the 1-tenant fleet.
+        self.preprocessors = (
+            list(preprocessor)
+            if isinstance(preprocessor, (list, tuple))
+            else [preprocessor]
+        )
+        if len(self.preprocessors) != ring.tenants:
+            raise ValueError(
+                f"{len(self.preprocessors)} preprocessors for "
+                f"{ring.tenants} ring tenants"
+            )
+        default_index = (
+            tenancy.default_index if tenancy is not None else 0
+        )
+        weights = (
+            tenancy.weights
+            if tenancy is not None
+            else (1.0,) * ring.tenants
+        )
+        self.tenants = TenantRouter(ring.tenant_names, default_index)
+        # ONE GOVERNOR PER SLOT CLASS over the worker's partition: the
+        # classes are separate physical pools (a large request can only
+        # land in a large slab), so fairness must hold per class — a
+        # single partition-wide governor would let a hot tenant park
+        # requests in every large slab while staying under its combined
+        # floor, starving cold tenants' large traffic with no quota
+        # signal. Physical exhaustion within an admitted class still
+        # sheds through the classic slot path at claim time. A 1-tenant
+        # fleet needs no governor (fairness is trivial), and skipping it
+        # keeps single-tenant admission EXACTLY the pre-tenancy path.
+        # Event-loop confined like the RingClient free lists — no locks
+        # (tenancy/quota.py).
+        self.quota = (
+            (
+                QuotaGovernor(ring.slots_small, weights),
+                QuotaGovernor(ring.slots_large, weights),
+            )
+            if ring.tenants > 1
+            else None
+        )
         self.client = RingClient(ring, worker_id)
-        self.metrics = ShmWorkerMetrics(ring, worker_id)
+        self.metrics = ShmWorkerMetrics(
+            ring, worker_id, default_tenant=default_index
+        )
         self.trace_plane = "ring"
         self.trace_worker = worker_id
         if trace is not None and trace.enabled:
@@ -196,17 +244,86 @@ class FrontendServer(HttpProtocol):
         request_id: str,
         deadline: float | None = None,
         span=None,
+        tenant: int = 0,
     ):
         """The ring-backed scoring hook under the shared `_predict` shell
-        (serve/httpcore.py): admission first, then encode, then the slot
-        round trip. The deadline budget (``x-request-deadline-ms``)
-        decrements across every stage: checked before the encode pool is
-        touched, stamped into the slot header so the ENGINE can complete
-        an expired descriptor without dispatching, and bounding the
-        completion wait — each stage answers the documented 504 rather
-        than doing work the client stopped waiting for."""
+        (serve/httpcore.py): per-tenant quota, then slot admission, then
+        encode, then the slot round trip. The deadline budget
+        (``x-request-deadline-ms``) decrements across every stage:
+        checked before the encode pool is touched, stamped into the slot
+        header so the ENGINE can complete an expired descriptor without
+        dispatching, and bounding the completion wait — each stage
+        answers the documented 504 rather than doing work the client
+        stopped waiting for.
+
+        ``tenant`` (resolved by the shell from ``x-tenant``) selects the
+        preprocessor, tags the slot so the engine dispatches the right
+        bundle, and is the quota/metrics dimension."""
         if not record_dicts:
             return empty_response()
+        if self.quota is None:
+            # 1-tenant fleet: fairness is trivial; admission is exactly
+            # the pre-tenancy slot path.
+            return await self._score_admitted(
+                record_dicts, request_id, deadline, span, tenant
+            )
+        # QUOTA BEFORE EVERYTHING (weighted max-min, tenancy/quota.py),
+        # per slot CLASS — the request's row count picks the physical
+        # pool it will claim from, and fairness is enforced over that
+        # pool: a hot tenant past its share sheds against its OWN quota
+        # while every other tenant's reserved floor in EACH class stays
+        # claimable. The 503 + Retry-After is the same wire contract as
+        # the slot shed, with the tenant and the word "quota" in the
+        # detail and the rejection counted per tenant
+        # (mlops_tpu_tenant_quota_shed_total — quota sheds are NOT
+        # physical sheds: shed_total stays a pure slot-exhaustion
+        # counter operators can difference against). A physically FULL
+        # class is NOT a quota event: it falls through to the classic
+        # slot-shed contract (class detail, brownout ETA during an
+        # engine outage) via claim() below.
+        governor = self.quota[
+            0 if len(record_dicts) <= self.ring.small_rows else 1
+        ]
+        verdict = governor.try_acquire(tenant)
+        if verdict == "quota":
+            self.client.count_quota_shed(tenant)
+            retry_s = self.config.shed_retry_after_s
+            name = self.tenants.names[tenant]
+            return (
+                503,
+                {
+                    "detail": f"tenant {name!r} over quota; retry in "
+                    f"{retry_s}s"
+                },
+                "application/json",
+                {"retry-after": str(retry_s)},
+            )
+        if verdict == "full":
+            # No governor hold to release: score through the claim path,
+            # which answers the physical-shed 503 (claim can still
+            # succeed if a slot freed since the check — benign).
+            return await self._score_admitted(
+                record_dicts, request_id, deadline, span, tenant
+            )
+        try:
+            return await self._score_admitted(
+                record_dicts, request_id, deadline, span, tenant
+            )
+        finally:
+            # The governor tracks ADMITTED REQUESTS, not slots: a zombie
+            # slot awaiting a late engine completion keeps holding its
+            # slot (never its quota), so a stalled engine degrades into
+            # slot sheds, never into quota leakage.
+            governor.release(tenant)
+
+    async def _score_admitted(
+        self,
+        record_dicts: list[dict],
+        request_id: str,
+        deadline: float | None,
+        span,
+        tenant: int,
+    ):
         from mlops_tpu.schema import records_to_columns
 
         # Injection point (mlops_tpu/faults): kill = a front-end worker
@@ -217,8 +334,14 @@ class FrontendServer(HttpProtocol):
         # ADMISSION BEFORE ENCODE: a to-be-shed request must cost nothing
         # — the row count is known from the validated records, so the
         # shed 503 never queues through (or wastes) the encode pool, and
-        # its latency stays flat no matter how deep the overload.
-        slot = self.client.claim(n)
+        # its latency stays flat no matter how deep the overload. On a
+        # multi-tenant plane the claim may not cross classes: the quota
+        # governor admitted against the class the row count names, so an
+        # overflow slab would hold capacity the other class's governor
+        # never accounted (tenancy/quota.py).
+        slot = self.client.claim(
+            n, tenant, allow_overflow=self.quota is None
+        )
         if slot is None:
             # Bounded admission per bucket class: shed FAST with a
             # Retry-After instead of queueing — the slots free up as
@@ -228,7 +351,7 @@ class FrontendServer(HttpProtocol):
             # means "parking full": the shed becomes a BROWNOUT 503
             # whose Retry-After advertises the respawn ETA, counted
             # separately — shed latency stays flat either way.
-            self.client.count_shed(n)
+            self.client.count_shed(n, tenant)
             cls = "small" if n <= self.ring.small_rows else "large"
             if not self.ring.engine_ready and (
                 float(self.ring.eng_vals[ENG_DOWN_SINCE]) > 0
@@ -277,9 +400,10 @@ class FrontendServer(HttpProtocol):
             # spends its cycles on device dispatch only. The native
             # encoder releases the GIL, so the pool keeps the accept loop
             # responsive through a 256-row encode.
+            preprocessor = self.preprocessors[tenant]
             ds = await loop.run_in_executor(
                 self._encode_pool,
-                lambda: self.preprocessor.encode(
+                lambda: preprocessor.encode(
                     records_to_columns(record_dicts)
                 ),
             )
@@ -463,17 +587,27 @@ def _frontend_main(
     worker_id: int,
     config: ServeConfig,
     ring: RequestRing,
-    preprocess_path: str,
+    preprocess_path: str | list[str],
     trace: Any = None,
+    tenancy: Any = None,
 ) -> None:
     """Front-end child process entry (forked — everything arrives by
-    inheritance). Never imports jax, never touches the device."""
+    inheritance). Never imports jax, never touches the device.
+    ``preprocess_path`` is one path per tenant (a bare string = the
+    1-tenant fleet)."""
     from mlops_tpu.data.encode import Preprocessor
 
-    preprocessor = Preprocessor.load(preprocess_path)
+    paths = (
+        [preprocess_path]
+        if isinstance(preprocess_path, str)
+        else list(preprocess_path)
+    )
+    preprocessors = [Preprocessor.load(path) for path in paths]
     try:
         asyncio.run(
-            _run_frontend(worker_id, config, ring, preprocessor, trace)
+            _run_frontend(
+                worker_id, config, ring, preprocessors, trace, tenancy
+            )
         )
     except KeyboardInterrupt:  # pragma: no cover - interactive stop
         pass
@@ -485,8 +619,11 @@ async def _run_frontend(
     ring: RequestRing,
     preprocessor,
     trace: Any = None,
+    tenancy: Any = None,
 ) -> None:
-    server = FrontendServer(config, ring, worker_id, preprocessor, trace)
+    server = FrontendServer(
+        config, ring, worker_id, preprocessor, trace, tenancy
+    )
     srv = await server.start()
     logger.info(
         "frontend %d serving %s on %s:%s (pid %d)",
@@ -557,13 +694,14 @@ async def _run_frontend(
 def start_frontends(
     config: ServeConfig,
     ring: RequestRing,
-    preprocess_path: str,
+    preprocess_path: str | list[str],
     trace: Any = None,
+    tenancy: Any = None,
 ) -> list[multiprocessing.Process]:
     """Fork one front-end process per worker (call BEFORE any jax backend
     initializes in the parent — the children inherit a clean world)."""
     return [
-        _respawn(config, ring, preprocess_path, worker_id, trace)
+        _respawn(config, ring, preprocess_path, worker_id, trace, tenancy)
         for worker_id in range(ring.workers)
     ]
 
@@ -592,19 +730,22 @@ def _engine_main(
     ring: RequestRing,
     bundle_dir: str,
     trace: Any = None,
+    tenancy: Any = None,
 ) -> None:
     """Engine child process entry (forked from the jax-free supervisor —
     ring, doorbells, and locks arrive by inheritance; jax imports happen
     HERE, after the fork, so no backend thread ever crosses one). Loads
-    the bundle, warms through the AOT compile cache, re-attaches to the
-    ring under a fresh incarnation — replaying any slots a dead
-    predecessor left busy (`RingService.reattach`) — and serves until
-    SIGTERM or supervisor death. ``kill -9`` of this process is the
-    survivable-engine tentpole: the supervisor forks a replacement that
-    runs this same function against the same shm ring."""
-    from mlops_tpu.bundle import load_bundle
+    the tenant fleet's bundles (the 1-tenant "default" fleet when no
+    tenants.toml was given), warms through the AOT compile cache with
+    architecture-level executable dedupe (`tenancy/registry.py`),
+    re-attaches to the ring under a fresh incarnation — replaying any
+    slots a dead predecessor left busy, each under its shm-tagged tenant
+    (`RingService.reattach`) — and serves until SIGTERM or supervisor
+    death. ``kill -9`` of this process is the survivable-engine
+    tentpole: the supervisor forks a replacement that runs this same
+    function against the same shm ring."""
     from mlops_tpu.compilecache.cache import from_config
-    from mlops_tpu.serve.engine import InferenceEngine
+    from mlops_tpu.tenancy import TenantRegistry, single_tenant_config
 
     serve_cfg = config.serve
     stop = {"flag": False}
@@ -615,30 +756,36 @@ def _engine_main(
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
-    bundle = load_bundle(bundle_dir)
-    engine = InferenceEngine(
-        bundle,
+    if tenancy is None:
+        tenancy = single_tenant_config(bundle_dir)
+    registry = TenantRegistry(
+        tenancy,
         buckets=tuple(serve_cfg.warmup_batch_sizes),
         service_name=serve_cfg.service_name,
         enable_grouping=serve_cfg.batch_window_ms > 0,
         compile_cache=from_config(config),
         warmup_workers=config.cache.warmup_workers,
     )
+    engines = registry.engines
     if trace is not None:
         # Shape histograms accumulate ENGINE-side (the only process that
-        # dispatches); the telemetry loop mirrors them into shm for
-        # every front end's /metrics.
+        # dispatches); ONE shared ShapeStats across the fleet — entries
+        # are keyed by compiled shape, which tenants share by design —
+        # mirrored into shm for every front end's /metrics.
         from mlops_tpu.trace import ShapeStats
 
-        engine.set_shape_stats(ShapeStats())
+        stats = ShapeStats()
+        for eng in engines:
+            eng.set_shape_stats(stats)
     service = RingService(
-        engine,
+        engines[0],
         ring,
         max_group=serve_cfg.max_group,
         max_inflight=serve_cfg.max_inflight,
         threads=serve_cfg.max_workers,
         monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
         monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
+        engines=engines,
     )
     if serve_cfg.profile_dir:
         # /debug/profile: front ends forward start/stop through the
@@ -649,33 +796,47 @@ def _engine_main(
     # Warmup -> re-attach (incarnation bump + busy-slot replay) -> serve:
     # parked requests are re-answered by the replay BEFORE the ready
     # flag flips, so "ready" means "the outage is fully healed".
-    engine.warmup()
+    warm_report = registry.warmup()
     attach = service.reattach()
     service.start()
     ring.set_ready(True)
     ring.eng_vals[ENG_DOWN_SINCE] = 0.0
-    logger.info(
-        "warmup complete; ready %s",
-        _LazyJson(getattr(engine, "warmup_stats", {})),
-    )
+    logger.info("warmup complete; ready %s", _LazyJson(warm_report))
     logger.info(
         "engine incarnation %d attached %s",
         attach["incarnation"], _LazyJson(attach),
     )
     if config.lifecycle.enabled:
-        # The closed loop runs ENGINE-SIDE (the only process with the
-        # device, the exec tables, and the compile cache); the telemetry
-        # loop mirrors its gauges into shm. The fork-time preprocessor
-        # is the encode contract, so the controller is forced onto the
-        # incumbent preprocessor. A respawned engine restarts the loop
-        # from its on-disk reservoir state.
+        # The closed loops run ENGINE-SIDE (the only process with the
+        # device, the exec tables, and the compile cache) — ONE
+        # controller PER TENANT, each on a tenant-namespaced state dir,
+        # so tenant A drifting retrains/shadows/promotes A alone; the
+        # telemetry loop mirrors each controller's gauges into its
+        # tenant's shm row. The fork-time preprocessors are the encode
+        # contract, so every controller is forced onto its incumbent
+        # preprocessor. A respawned engine restarts each loop from its
+        # on-disk reservoir state. (The 1-tenant "default" fleet keeps
+        # the un-namespaced state dir — bit-identical to pre-tenancy.)
         from mlops_tpu.lifecycle import LifecycleController
+        from mlops_tpu.tenancy import tenant_scoped_config
 
-        service.lifecycle = LifecycleController(
-            engine, config, force_incumbent_preprocessor=True
+        single_default = len(registry) == 1 and registry.names[0] == "default"
+        service.lifecycles = []
+        for name, eng in zip(registry.names, engines):
+            scoped = (
+                config if single_default
+                else tenant_scoped_config(config, name)
+            )
+            controller = LifecycleController(
+                eng, scoped, force_incumbent_preprocessor=True
+            )
+            controller.start()
+            service.lifecycles.append(controller)
+        service.lifecycle = service.lifecycles[0]
+        logger.info(
+            "lifecycle controllers started (engine process, %d tenants)",
+            len(service.lifecycles),
         )
-        service.lifecycle.start()
-        logger.info("lifecycle controller started (engine process)")
 
     supervisor = os.getppid()
     rc = 0
@@ -699,8 +860,8 @@ def _engine_main(
                 break
     finally:
         ring.set_ready(False)
-        if service.lifecycle is not None:
-            service.lifecycle.stop()
+        for _, controller in service._tenant_lifecycles():
+            controller.stop()
         service.stop()
         logger.info("engine process drained; exiting")
     if rc:
@@ -712,13 +873,14 @@ def _spawn_engine(
     ring: RequestRing,
     bundle_dir: str,
     trace: Any = None,
+    tenancy: Any = None,
 ) -> multiprocessing.Process:
     """Fork the engine child from the (thread-free, jax-free) supervisor
     — first boot and every respawn run the identical path."""
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_engine_main,
-        args=(config, ring, bundle_dir, trace),
+        args=(config, ring, bundle_dir, trace, tenancy),
         name="mlops-tpu-engine",
     )
     proc.start()
@@ -765,9 +927,30 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             "(Linux); run single-process (serve.workers=0) on this "
             "platform"
         )
-    preprocess_path = str(Path(bundle_dir) / "preprocess.npz")
-    if not Path(preprocess_path).is_file():
-        raise SystemExit(f"no preprocessor at {preprocess_path}")
+    # Tenant fleet (mlops_tpu/tenancy/): serve.tenants_path names a
+    # tenants.toml; without one the plane is the 1-tenant "default"
+    # fleet serving the resolved bundle — the identical code path with a
+    # one-row tenant axis (bit-identical degradation, test-pinned).
+    from mlops_tpu.tenancy import (
+        load_tenants_toml,
+        single_tenant_config,
+    )
+
+    if serve_cfg.tenants_path:
+        try:
+            tenancy = load_tenants_toml(serve_cfg.tenants_path).validate()
+        except ValueError as err:
+            raise SystemExit(str(err))
+    else:
+        tenancy = single_tenant_config(bundle_dir)
+    preprocess_paths: list[str] = []
+    for spec in tenancy.tenants:
+        path = str(Path(spec.bundle_dir) / "preprocess.npz")
+        if not Path(path).is_file():
+            raise SystemExit(
+                f"no preprocessor at {path} (tenant {spec.name!r})"
+            )
+        preprocess_paths.append(path)
 
     # Same invariant the single-process server clamps at runtime: the
     # request cap must not exceed the largest warmed bucket, or
@@ -791,6 +974,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         slots_small=serve_cfg.ring_slots_small,
         slots_large=serve_cfg.ring_slots_large,
         large_rows=max_batch,
+        tenant_names=tenancy.names,
     )
     trace_cfg = getattr(config, "trace", None)
     if trace_cfg is not None and trace_cfg.enabled:
@@ -811,12 +995,15 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     child_cfg = dataclasses.replace(
         serve_cfg, port=placeholder.getsockname()[1], max_batch=max_batch
     )
-    procs = start_frontends(child_cfg, ring, preprocess_path, trace_cfg)
-    logger.info(
-        "supervisor %d spawned %d front ends (pids %s)",
-        os.getpid(), len(procs), [p.pid for p in procs],
+    procs = start_frontends(
+        child_cfg, ring, preprocess_paths, trace_cfg, tenancy
     )
-    engine_proc = _spawn_engine(config, ring, bundle_dir, trace_cfg)
+    logger.info(
+        "supervisor %d spawned %d front ends (pids %s) for %d tenant(s) %s",
+        os.getpid(), len(procs), [p.pid for p in procs],
+        len(tenancy.tenants), list(tenancy.names),
+    )
+    engine_proc = _spawn_engine(config, ring, bundle_dir, trace_cfg, tenancy)
     logger.info(
         "serving %s on %s:%s with %d SO_REUSEPORT front ends "
         "(engine pid %s)",
@@ -850,7 +1037,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
                     i, proc.pid, proc.exitcode,
                 )
                 procs[i] = _respawn(
-                    child_cfg, ring, preprocess_path, i, trace_cfg
+                    child_cfg, ring, preprocess_paths, i, trace_cfg, tenancy
                 )
             if not engine_proc.is_alive() and not stopping["sigterm"]:
                 now = time.monotonic()
@@ -880,7 +1067,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
                 ring.eng_vals[ENG_DOWN_SINCE] = now
                 ring.eng_vals[ENG_RESPAWNS] += 1
                 engine_proc = _spawn_engine(
-                    config, ring, bundle_dir, trace_cfg
+                    config, ring, bundle_dir, trace_cfg, tenancy
                 )
                 logger.info(
                     "engine process started (pid %s)", engine_proc.pid
@@ -925,9 +1112,10 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
 def _respawn(
     config: ServeConfig,
     ring: RequestRing,
-    preprocess_path: str,
+    preprocess_path: str | list[str],
     worker_id: int,
     trace: Any = None,
+    tenancy: Any = None,
 ) -> multiprocessing.Process:
     """Fork a replacement front end for one worker slot partition (the
     generation counters in shm make any of the dead worker's in-flight
@@ -937,7 +1125,7 @@ def _respawn(
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_frontend_main,
-        args=(worker_id, config, ring, preprocess_path, trace),
+        args=(worker_id, config, ring, preprocess_path, trace, tenancy),
         name=f"mlops-tpu-frontend-{worker_id}",
     )
     proc.start()

@@ -23,6 +23,7 @@ import pydantic
 
 from mlops_tpu.config import ServeConfig
 from mlops_tpu.schema import LoanApplicant
+from mlops_tpu.tenancy.router import TenantRouter  # jax-free
 from mlops_tpu.trace.span import Span  # jax-free; front ends import this too
 
 logger = logging.getLogger("mlops_tpu.serve")
@@ -164,6 +165,12 @@ class HttpProtocol:
         self.tracer: Any = None
         self.trace_plane = "single"
         self.trace_worker = 0
+        # Tenant routing (mlops_tpu/tenancy/): the ``x-tenant`` header
+        # resolves to a tenant index through this router; subclasses
+        # serving a multi-tenant fleet install their own. The default is
+        # the degenerate single-tenant fleet ("default"), under which
+        # untagged traffic behaves exactly like the pre-tenancy plane.
+        self.tenants = TenantRouter(())
 
     # ------------------------------------------------------ subclass hooks
     async def _predict(
@@ -172,6 +179,7 @@ class HttpProtocol:
         request_id: str | None = None,
         deadline: float | None = None,
         span=None,
+        tenant_raw: str = "",
     ):
         """The reference's `predict()` endpoint (`app/main.py:42-86`):
         validate -> log InferenceData -> score -> log ModelOutput ->
@@ -188,7 +196,19 @@ class HttpProtocol:
         moves through validation -> encode -> ring wait -> dispatch:
         every stage that is about to start expensive work checks the
         REMAINING budget and answers the documented ``504`` instead of
-        doing dead work the client will never read."""
+        doing dead work the client will never read.
+
+        ``tenant_raw`` is the request's ``x-tenant`` header value:
+        resolved FIRST (before validation pays pydantic) — an unknown
+        tenant answers 404 rather than silently billing the default
+        tenant's quota and monitors for a stranger's traffic."""
+        tenant = self.tenants.resolve(tenant_raw)
+        if tenant is None:
+            return (
+                404,
+                {"detail": f"unknown tenant {tenant_raw[:64]!r}"},
+                "application/json",
+            )
         try:
             records = self._applicant_list.validate_json(body)
         except pydantic.ValidationError as err:
@@ -235,7 +255,9 @@ class HttpProtocol:
                     }
                 ),
             )
-        response = await self._score(record_dicts, request_id, deadline, span)
+        response = await self._score(
+            record_dicts, request_id, deadline, span, tenant
+        )
         if isinstance(response, tuple):
             return response  # subclass error path, already wire-shaped
         if logger.isEnabledFor(logging.INFO):
@@ -258,6 +280,7 @@ class HttpProtocol:
         request_id: str,
         deadline: float | None = None,
         span=None,
+        tenant: int = 0,
     ):
         raise NotImplementedError
 
@@ -383,6 +406,18 @@ class HttpProtocol:
                     start = time.perf_counter()
                     request_id = self._request_id(headers)
                     route_path = path.split("?", 1)[0]
+                    # The tenant tag rides the request (mlops_tpu/tenancy/):
+                    # resolved to a BOUNDED label here (known name,
+                    # default, or the closed unknown marker) for the
+                    # span dimension; the predict shell resolves the
+                    # index (unknown -> 404) before any scoring work.
+                    # Metrics bill strangers' 404s to the DEFAULT
+                    # tenant's row (bill_label) — the ring plane's shm
+                    # counters have one fixed row per declared tenant,
+                    # and both planes must emit identical series.
+                    tenant_raw = headers.get("x-tenant", "")
+                    tenant_label = self.tenants.label(tenant_raw)
+                    tenant_bill = self.tenants.bill_label(tenant_raw)
                     span = None
                     if (
                         self.tracer is not None
@@ -399,17 +434,21 @@ class HttpProtocol:
                             worker=self.trace_worker,
                             route=route_path,
                             t0=t_recv,
+                            tenant=tenant_label,
                         )
                     # Routes return (status, payload, content_type) with an
                     # optional 4th element of extra header lines (the shed
                     # path's Retry-After).
                     result = await self._route(
-                        method, route_path, body, request_id, deadline, span
+                        method, route_path, body, request_id, deadline,
+                        span, tenant_raw,
                     )
                     status, payload, content_type = result[:3]
                     extra_headers = result[3] if len(result) > 3 else None
                     latency_ms = (time.perf_counter() - start) * 1e3
-                    self.metrics.observe_request(route_path, status, latency_ms)
+                    self.metrics.observe_request(
+                        route_path, status, latency_ms, tenant=tenant_bill
+                    )
                     keep_alive = keep_alive and not self.draining
                     await self._write_response(
                         writer, status, payload, content_type, keep_alive,
@@ -511,9 +550,12 @@ class HttpProtocol:
         request_id: str | None = None,
         deadline: float | None = None,
         span=None,
+        tenant_raw: str = "",
     ):
         if path == "/predict" and method == "POST":
-            return await self._predict(body, request_id, deadline, span)
+            return await self._predict(
+                body, request_id, deadline, span, tenant_raw
+            )
         if path.startswith("/debug/profile/") and method == "POST":
             return await self._profile(path.removeprefix("/debug/profile/"))
         if method == "GET":

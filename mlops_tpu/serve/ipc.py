@@ -272,10 +272,21 @@ class RequestRing:
         slots_large: int,
         large_rows: int,
         small_rows: int = GROUP_ROW_BUCKET,
+        tenant_names: tuple[str, ...] = ("default",),
     ) -> None:
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        if not tenant_names:
+            raise ValueError("tenant_names must name at least one tenant")
         self.workers = workers
+        # Tenant fleet (mlops_tpu/tenancy/): tenant INDEX — the shm slot
+        # tag, every per-tenant stats row — is the position in this tuple,
+        # fixed for the plane's lifetime (the names themselves are plain
+        # Python state inherited through fork, never stored in shm). The
+        # default single-name tuple makes every pre-tenancy caller a
+        # 1-tenant fleet with identical layout semantics.
+        self.tenant_names = tuple(tenant_names)
+        self.tenants = T = len(self.tenant_names)
         self.slots_small = slots_small
         self.slots_large = slots_large
         self.small_rows = small_rows
@@ -328,6 +339,13 @@ class RequestRing:
             ("slot_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("slot_n", np.dtype(np.uint32), (self.n_slots,)),
             ("slot_busy", np.dtype(np.uint32), (self.n_slots,)),
+            # Tenant index of the request occupying the slot (stamped by
+            # the front end at CLAIM, before the descriptor is visible):
+            # the engine dispatches the slot against this tenant's bundle
+            # and the respawn replay re-answers it under the same tenant
+            # — the tag survives both front-end and engine crashes
+            # because it lives in shm with the busy flag.
+            ("slot_tenant", np.dtype(np.uint32), (self.n_slots,)),
             # Absolute request deadline (time.monotonic seconds — the same
             # CLOCK_MONOTONIC the front ends' event loops read, so values
             # compare across processes on one host; 0 = no deadline). The
@@ -370,14 +388,20 @@ class RequestRing:
              (self.n_small, 2 * small_rows + D)),
             ("large_resp", np.dtype(np.float64),
              (self.n_large, 2 * self.large_rows + D)),
-            # per-worker serving stats (single writer: that worker)
+            # per-worker serving stats (single writer: that worker),
+            # tenant-dimensioned (mlops_tpu/tenancy/): row T is the
+            # tenant index; a 1-tenant plane carries exactly one row.
             ("req_counts", np.dtype(np.uint64),
-             (workers, len(_ROUTES), len(STATUSES) + 1)),
-            ("lat_counts", np.dtype(np.uint64), (workers, self._nb)),
-            ("lat_sum_ms", np.dtype(np.float64), (workers,)),
-            ("lat_n", np.dtype(np.uint64), (workers,)),
-            ("shed", np.dtype(np.uint64), (workers, 2)),
-            ("inflight", np.dtype(np.uint64), (workers, 2)),
+             (workers, T, len(_ROUTES), len(STATUSES) + 1)),
+            ("lat_counts", np.dtype(np.uint64), (workers, T, self._nb)),
+            ("lat_sum_ms", np.dtype(np.float64), (workers, T)),
+            ("lat_n", np.dtype(np.uint64), (workers, T)),
+            ("shed", np.dtype(np.uint64), (workers, T, 2)),
+            ("inflight", np.dtype(np.uint64), (workers, T, 2)),
+            # quota rejections (admission refused by the tenant's own
+            # weighted max-min floor, not physical exhaustion) — the
+            # fairness contract's observable, single writer per worker
+            ("quota_shed", np.dtype(np.uint64), (workers, T)),
             # dead-work sheds counted FRONT-END side (admission/budget
             # checks answering 504 before a slot submits) — single writer
             # per worker, like the shed counters
@@ -409,25 +433,33 @@ class RequestRing:
             # ROB_DEGRADED = the engine's degraded-dispatch total
             # (mirrored by the telemetry loop)
             ("rob_vals", np.dtype(np.float64), (2,)),
-            # monitor aggregate (single writer: the engine process).
-            # mon_drift_sum carries the UNROUNDED cumulative sums so a
-            # respawned engine can seed its exact host totals (ISSUE 11)
-            # — reconstructing them from the rounded means would inject
-            # up to 5e-7 * batches of drift error per respawn.
-            ("mon_vals", np.dtype(np.float64), (8,)),
-            ("mon_drift_last", np.dtype(np.float64), (D,)),
-            ("mon_drift_mean", np.dtype(np.float64), (D,)),
-            ("mon_drift_sum", np.dtype(np.float64), (D,)),
+            # monitor aggregate, ONE ROW PER TENANT (single writer: the
+            # engine process — each tenant engine owns its own device
+            # accumulator and exact host totals, mirrored here per
+            # telemetry tick). mon_drift_sum carries the UNROUNDED
+            # cumulative sums so a respawned engine can seed each
+            # tenant's exact host totals (ISSUE 11) — reconstructing
+            # them from the rounded means would inject up to
+            # 5e-7 * batches of drift error per respawn.
+            ("mon_vals", np.dtype(np.float64), (T, 8)),
+            ("mon_drift_last", np.dtype(np.float64), (T, D)),
+            ("mon_drift_mean", np.dtype(np.float64), (T, D)),
+            ("mon_drift_sum", np.dtype(np.float64), (T, D)),
             # engine-supervision block (ISSUE 11; serve/metrics.py ENG_*
             # indices): incarnation, down-since stamp, respawn/replay/
-            # rows-lost counters, rows-dispatched telemetry baseline.
+            # rows-lost counters, rows-dispatched telemetry baseline
+            # (the eng_vals ROWS_DISPATCHED cell keeps the fleet sum;
+            # eng_rows_tenant carries the per-tenant baselines the
+            # respawn's per-tenant rows-lost accounting differences).
             ("eng_vals", np.dtype(np.float64), (6,)),
-            # lifecycle loop state (single writer: the engine process's
-            # controller telemetry — serve/metrics.py LIFE_* indices), so
-            # ANY front end renders the fleet's bundle generation /
-            # trigger / promotion gauges from shm.
-            ("life_vals", np.dtype(np.float64), (8,)),
-            ("life_promos", np.dtype(np.float64), (len(LIFE_OUTCOMES),)),
+            ("eng_rows_tenant", np.dtype(np.float64), (T,)),
+            # lifecycle loop state, ONE ROW PER TENANT (single writer:
+            # the engine process's per-tenant controller telemetry —
+            # serve/metrics.py LIFE_* indices), so ANY front end renders
+            # each tenant's bundle generation / trigger / promotion
+            # gauges from shm.
+            ("life_vals", np.dtype(np.float64), (T, 8)),
+            ("life_promos", np.dtype(np.float64), (T, len(LIFE_OUTCOMES))),
         ]
         offset = 0
         offsets = {}
@@ -708,21 +740,24 @@ class RequestRing:
                 self.prof_ctl[0] = (int(seq) << 8) | 0
 
     # ----------------------------------------------------------- monitor
-    def write_monitor(self, snapshot: dict[str, Any]) -> None:
-        """Engine-process single writer: install a `monitor_snapshot`
-        aggregate for the front ends' /metrics renders. Field-at-a-time
-        f64 stores are individually atomic; a scrape racing this write
-        can see a mid-update mix, which Prometheus gauges tolerate (same
-        contract as a scrape racing the single-process fetch)."""
+    def write_monitor(
+        self, snapshot: dict[str, Any], tenant: int = 0
+    ) -> None:
+        """Engine-process single writer: install one tenant's
+        `monitor_snapshot` aggregate for the front ends' /metrics
+        renders. Field-at-a-time f64 stores are individually atomic; a
+        scrape racing this write can see a mid-update mix, which
+        Prometheus gauges tolerate (same contract as a scrape racing the
+        single-process fetch)."""
         if not snapshot:
             return
-        self.mon_vals[MON_ROWS] = float(snapshot["rows"])
-        self.mon_vals[MON_OUTLIERS] = float(snapshot["outliers"])
-        self.mon_vals[MON_BATCHES] = float(snapshot["batches"])
-        self.mon_drift_last[:] = np.fromiter(
+        self.mon_vals[tenant, MON_ROWS] = float(snapshot["rows"])
+        self.mon_vals[tenant, MON_OUTLIERS] = float(snapshot["outliers"])
+        self.mon_vals[tenant, MON_BATCHES] = float(snapshot["batches"])
+        self.mon_drift_last[tenant, :] = np.fromiter(
             snapshot["drift_last"].values(), np.float64, self.n_features
         )
-        self.mon_drift_mean[:] = np.fromiter(
+        self.mon_drift_mean[tenant, :] = np.fromiter(
             snapshot["drift_mean"].values(), np.float64, self.n_features
         )
         # Unrounded cumulative sums (monitor_snapshot exports them for
@@ -730,37 +765,36 @@ class RequestRing:
         # engine restart never injects rounding error into the totals.
         drift_sum = snapshot.get("drift_sum")
         if drift_sum is not None:
-            self.mon_drift_sum[:] = np.asarray(drift_sum, np.float64)
-        self.mon_vals[MON_FETCHES] += 1
-        self.mon_vals[MON_FETCHED_AT] = time.monotonic()
-        self.mon_vals[MON_HAS] = 1.0
+            self.mon_drift_sum[tenant, :] = np.asarray(drift_sum, np.float64)
+        self.mon_vals[tenant, MON_FETCHES] += 1
+        self.mon_vals[tenant, MON_FETCHED_AT] = time.monotonic()
+        self.mon_vals[tenant, MON_HAS] = 1.0
 
-    def write_lifecycle(self, snapshot: dict[str, Any]) -> None:
-        """Engine-process single writer: install a lifecycle controller
-        snapshot (`lifecycle/controller.py metrics_snapshot`) for the
-        front ends' /metrics renders. Same tearing contract as
+    def write_lifecycle(
+        self, snapshot: dict[str, Any], tenant: int = 0
+    ) -> None:
+        """Engine-process single writer: install one tenant's lifecycle
+        controller snapshot (`lifecycle/controller.py metrics_snapshot`)
+        for the front ends' /metrics renders. Same tearing contract as
         `write_monitor`: per-field f64 stores are individually atomic and
         a mid-update mix is gauge-tolerable."""
         if not snapshot:
             return
-        self.life_vals[LIFE_GENERATION] = float(snapshot["generation"])
-        self.life_vals[LIFE_TRIGGERS] = float(snapshot["drift_triggers"])
+        row = self.life_vals[tenant]
+        row[LIFE_GENERATION] = float(snapshot["generation"])
+        row[LIFE_TRIGGERS] = float(snapshot["drift_triggers"])
         delta = snapshot.get("shadow_auc_delta")
-        self.life_vals[LIFE_AUC_DELTA] = 0.0 if delta is None else float(delta)
-        self.life_vals[LIFE_HAS_DELTA] = 0.0 if delta is None else 1.0
-        self.life_vals[LIFE_RESERVOIR] = float(
-            snapshot.get("reservoir_rows") or 0
-        )
-        self.life_vals[LIFE_BREAKER_OPEN] = (
+        row[LIFE_AUC_DELTA] = 0.0 if delta is None else float(delta)
+        row[LIFE_HAS_DELTA] = 0.0 if delta is None else 1.0
+        row[LIFE_RESERVOIR] = float(snapshot.get("reservoir_rows") or 0)
+        row[LIFE_BREAKER_OPEN] = (
             1.0 if snapshot.get("breaker_open") else 0.0
         )
-        self.life_vals[LIFE_BREAKER_TRIPS] = float(
-            snapshot.get("breaker_trips", 0)
-        )
+        row[LIFE_BREAKER_TRIPS] = float(snapshot.get("breaker_trips", 0))
         promotions = snapshot.get("promotions", {})
         for i, outcome in enumerate(LIFE_OUTCOMES):
-            self.life_promos[i] = float(promotions.get(outcome, 0))
-        self.life_vals[LIFE_HAS] = 1.0
+            self.life_promos[tenant, i] = float(promotions.get(outcome, 0))
+        row[LIFE_HAS] = 1.0
 
     def close(self) -> None:
         self.engine_doorbell.close()
@@ -776,21 +810,38 @@ class ShmWorkerMetrics:
     worker's shared stats block — single writer (that worker's event
     loop), so no lock; cross-process readers see monotonic counters."""
 
-    def __init__(self, ring: RequestRing, worker: int) -> None:
+    def __init__(
+        self, ring: RequestRing, worker: int, default_tenant: int = 0
+    ) -> None:
         self._ring = ring
         self._worker = worker
         self._buckets = ServingMetrics.LATENCY_BUCKETS
+        # Tenant LABEL -> shm row. Labels are bounded upstream
+        # (TenantRouter.label); the closed unknown marker — requests
+        # 404'd for naming no declared tenant — lands on the default
+        # tenant's row (there is no stranger row to bill).
+        self._tenant_idx = {
+            name: i for i, name in enumerate(ring.tenant_names)
+        }
+        self._default_tenant = int(default_tenant)
 
-    def observe_request(self, route: str, status: int, latency_ms: float) -> None:
+    def observe_request(
+        self,
+        route: str,
+        status: int,
+        latency_ms: float,
+        tenant: str = "default",
+    ) -> None:
         ring, w = self._ring, self._worker
+        t = self._tenant_idx.get(tenant, self._default_tenant)
         r = _ROUTE_IDX.get(route, _ROUTE_IDX["<other>"])
         s = _STATUS_IDX.get(status, len(STATUSES))
-        ring.req_counts[w, r, s] += 1
-        ring.lat_sum_ms[w] += latency_ms
-        ring.lat_n[w] += 1
+        ring.req_counts[w, t, r, s] += 1
+        ring.lat_sum_ms[w, t] += latency_ms
+        ring.lat_n[w, t] += 1
         for i, edge in enumerate(self._buckets):
             if latency_ms <= edge:
-                ring.lat_counts[w, i] += 1
+                ring.lat_counts[w, t, i] += 1
                 break
 
     def count_deadline_expired(self) -> None:
@@ -836,10 +887,12 @@ class RingClient:
         # zero: those slots are still occupied (the engine may be writing
         # them) and the drain path in `on_doorbell` decrements as each one
         # returns to the free list — so the gauge never undercounts after
-        # a worker crash.
-        ring.inflight[worker, :] = 0
+        # a worker crash. Quarantined slots keep their shm tenant tag, so
+        # the per-tenant depth cells stay attributed correctly too.
+        ring.inflight[worker, :, :] = 0
         for slot in self._quarantined:
-            ring.inflight[worker, ring.slot_class(slot)] += 1
+            tenant = int(ring.slot_tenant[slot]) % ring.tenants
+            ring.inflight[worker, tenant, ring.slot_class(slot)] += 1
         # The parked gauge's decrements lived in the dead incarnation's
         # event loop: any requests it had parked died with their
         # connections, so the respawned worker's cell restarts at zero —
@@ -866,23 +919,51 @@ class RingClient:
         self._pending: dict[int, tuple[int, Any]] = {}
 
     # -------------------------------------------------------------- claim
-    def claim(self, n_rows: int) -> int | None:
+    def claim(
+        self, n_rows: int, tenant: int = 0, allow_overflow: bool = True
+    ) -> int | None:
         """A free slot whose slab fits ``n_rows``, or None (shed). Small
-        requests prefer the small class and may overflow into large;
-        large requests never take a small slab."""
+        requests prefer the small class and (with ``allow_overflow``,
+        the 1-tenant default) may overflow into large; large requests
+        never take a small slab. A multi-tenant caller passes
+        ``allow_overflow=False``: the per-class quota governors admit
+        against the class the ROW COUNT names, so a small request
+        sneaking into a large slab (reachable when quarantined slots
+        shrink the small free list) would occupy large capacity the
+        large-class governor never accounted — a hot tenant could starve
+        cold tenants' large floors with no quota signal. The slot is
+        TAGGED with ``tenant`` in shm before any counter moves: the
+        engine (and a respawned engine's replay) dispatches it against
+        that tenant's bundle, and the per-tenant depth/release
+        bookkeeping reads the tag back rather than threading the index
+        through every path."""
         small_free, large_free = self._free
-        if n_rows <= self.ring.small_rows and small_free:
-            slot = small_free.pop()
+        if n_rows <= self.ring.small_rows:
+            if small_free:
+                slot = small_free.pop()
+            elif allow_overflow and large_free:
+                slot = large_free.pop()
+            else:
+                return None
         elif large_free:
             slot = large_free.pop()
         else:
             return None
-        self.ring.inflight[self.worker, self.ring.slot_class(slot)] += 1
+        self.ring.slot_tenant[slot] = tenant
+        self.ring.inflight[
+            self.worker, tenant, self.ring.slot_class(slot)
+        ] += 1
         return slot
 
-    def count_shed(self, n_rows: int) -> None:
+    def count_shed(self, n_rows: int, tenant: int = 0) -> None:
         cls = SMALL if n_rows <= self.ring.small_rows else LARGE
-        self.ring.shed[self.worker, cls] += 1
+        self.ring.shed[self.worker, tenant, cls] += 1
+
+    def count_quota_shed(self, tenant: int) -> None:
+        """One admission refused by the tenant's own weighted max-min
+        quota (free slots existed; the tenant's floor did not allow the
+        claim) — the fairness contract's per-tenant observable."""
+        self.ring.quota_shed[self.worker, tenant] += 1
 
     def submit(
         self,
@@ -923,8 +1004,9 @@ class RingClient:
         self._pending.pop(slot, None)
         self.ring.slot_busy[slot] = 0
         cls = self.ring.slot_class(slot)
+        tenant = int(self.ring.slot_tenant[slot]) % self.ring.tenants
         self._free[cls].append(slot)
-        self.ring.inflight[self.worker, cls] -= 1
+        self.ring.inflight[self.worker, tenant, cls] -= 1
 
     def abandon(self, slot: int) -> None:
         """Deadline/error path after a successful submit: if the response
@@ -979,8 +1061,9 @@ class RingClient:
                     self._quarantined.discard(slot)
                     ring.slot_busy[slot] = 0
                     cls = ring.slot_class(slot)
+                    tenant = int(ring.slot_tenant[slot]) % ring.tenants
                     self._free[cls].append(slot)
-                    ring.inflight[self.worker, cls] -= 1
+                    ring.inflight[self.worker, tenant, cls] -= 1
                 continue
             _, future = entry
             if future.cancelled():
@@ -1050,10 +1133,28 @@ class RingService:
         threads: int = 8,
         monitor_fetch_every_s: float = 2.0,
         monitor_fetch_every_requests: int = 512,
+        engines: list[Any] | None = None,
     ) -> None:
         import concurrent.futures
 
         self.engine = engine
+        # Tenant fleet (mlops_tpu/tenancy/): ``engines[t]`` serves slot
+        # tenant index ``t``. The single-engine call shape (every
+        # pre-tenancy caller, the test stubs) is the degenerate 1-tenant
+        # fleet — identical dispatch behavior by construction.
+        self.engines: list[Any] = (
+            list(engines) if engines is not None else [engine]
+        )
+        # Exactly one engine per tenant row — FEWER would make
+        # _slot_tenant's defensive clamp wrap a declared tenant's tag
+        # onto another tenant's model and serve the wrong portfolio
+        # with a 200 (front ends route by the ring's tenant_names, so
+        # every row is reachable).
+        if len(self.engines) != ring.tenants:
+            raise ValueError(
+                f"{len(self.engines)} engines but the ring carries "
+                f"{ring.tenants} tenant rows"
+            )
         self.ring = ring
         # A group can never exceed the largest warmed slot bucket — beyond
         # it there is no compiled shape to run (same clamp as the
@@ -1069,21 +1170,29 @@ class RingService:
         self._telemetry: threading.Thread | None = None
         self._mon_period = monitor_fetch_every_s
         self._mon_every = monitor_fetch_every_requests
-        self._accumulating = bool(getattr(engine, "monitor_accumulating", False))
-        # Optional lifecycle controller (mlops_tpu/lifecycle/), attached
-        # by serve_multi_worker after warmup: the telemetry loop mirrors
-        # its gauge snapshot into shm each tick so every front end can
-        # render the loop state. Engine-process only; front ends never
-        # import the lifecycle package.
+        self._accumulating = [
+            bool(getattr(eng, "monitor_accumulating", False))
+            for eng in self.engines
+        ]
+        self._any_accumulating = any(self._accumulating)
+        # Optional lifecycle controllers (mlops_tpu/lifecycle/), attached
+        # by the engine process after warmup — ONE PER TENANT (tenant A
+        # drifting retrains, shadows, and promotes A alone): the
+        # telemetry loop mirrors each controller's gauge snapshot into
+        # its tenant's shm row every tick so any front end can render
+        # the whole fleet's loop state. ``lifecycle`` keeps the
+        # pre-tenancy single-controller surface (tenant 0).
         self.lifecycle: Any = None
+        self.lifecycles: list[Any] | None = None
         # Respawn bases (ISSUE 11, set by `reattach`): the degraded /
         # lifecycle counter mirrors below are ABSOLUTE writes from
         # in-process totals that restart at zero in a respawned engine —
         # the dead incarnation's last-published values are carried as
         # additive bases so the exported counters stay monotone (the
-        # same contract as `seed_monitor_totals`).
+        # same contract as `seed_monitor_totals`). Life bases are keyed
+        # by tenant row.
         self._degraded_base = 0.0
-        self._life_base: dict[str, Any] | None = None
+        self._life_base: dict[int, dict[str, Any]] = {}
         # /debug/profile forwarding (tracewire): the engine process owns
         # the device, so front ends forward start/stop through the ring's
         # profile-control word; `profiler` is the engine-side handler
@@ -1101,7 +1210,7 @@ class RingService:
             target=self._collect, name="ring-collector", daemon=True
         )
         self._collector.start()
-        if self._accumulating and self._mon_period > 0:
+        if self._any_accumulating and self._mon_period > 0:
             self._telemetry = threading.Thread(
                 target=self._telemetry_loop, name="ring-telemetry", daemon=True
             )
@@ -1116,9 +1225,11 @@ class RingService:
             if thread is not None:
                 thread.join(timeout=10)
         self._pool.shutdown(wait=True)
-        if self._accumulating:
+        for t, eng in enumerate(self.engines):
+            if not self._accumulating[t]:
+                continue
             try:
-                self.ring.write_monitor(self.engine.monitor_snapshot())
+                self.ring.write_monitor(eng.monitor_snapshot(), t)
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("final monitor snapshot failed on drain")
         self._write_lifecycle()
@@ -1160,25 +1271,44 @@ class RingService:
                 self._inflight.acquire()
                 self._pool.submit(self._run_job, job)
 
+    def _slot_tenant(self, slot: int) -> int:
+        """The slot's shm tenant tag, clamped into the engine list.
+        The constructor guarantees one engine per tenant row, so every
+        tag a front end can stamp maps to exactly its own engine; the
+        modulo only defends against a garbage value (a crashed writer's
+        scribble) ever indexing out of range — the tag itself is a
+        single aligned store written before submit, so a torn read is
+        not a real failure mode."""
+        return int(self.ring.slot_tenant[slot]) % len(self.engines)
+
     def _make_jobs(
         self, descs: list[tuple[int, int]]
     ) -> list[list[tuple[int, int]]]:
         """The coalescing policy, shared by the live collector and the
         re-attach replay: small requests group up to ``max_group`` per
-        device dispatch, everything else runs solo."""
+        device dispatch, everything else runs solo. Grouping is PER
+        TENANT — a grouped dispatch runs one tenant's compiled program
+        with one tenant's params and folds one tenant's monitor
+        accumulator, so slots from different tenants can never share a
+        device dispatch (they still share the pool and the ring)."""
         ring = self.ring
-        groupable: list[tuple[int, int]] = []
+        groupable: dict[int, list[tuple[int, int]]] = {}
         solo: list[tuple[int, int]] = []
-        can_group = getattr(self.engine, "supports_grouping", False)
         for slot, gen in descs:
             n = int(ring.slot_n[slot])
+            tenant = self._slot_tenant(slot)
+            can_group = getattr(
+                self.engines[tenant], "supports_grouping", False
+            )
             if can_group and 1 <= n <= GROUP_ROW_BUCKET:
-                groupable.append((slot, gen))
+                groupable.setdefault(tenant, []).append((slot, gen))
             else:
                 solo.append((slot, gen))
         jobs: list[list[tuple[int, int]]] = []
-        for i in range(0, len(groupable), self.max_group):
-            jobs.append(groupable[i : i + self.max_group])
+        for tenant in sorted(groupable):
+            batch = groupable[tenant]
+            for i in range(0, len(batch), self.max_group):
+                jobs.append(batch[i : i + self.max_group])
         jobs.extend([d] for d in solo)
         return jobs
 
@@ -1220,17 +1350,18 @@ class RingService:
         # would regress the exported counters (a Prometheus counter
         # reset, and a chaos-smoke monotonicity failure).
         self._degraded_base = float(ring.rob_vals[ROB_DEGRADED])
-        if float(ring.life_vals[LIFE_HAS]):
-            self._life_base = {
-                "drift_triggers": float(ring.life_vals[LIFE_TRIGGERS]),
-                "breaker_trips": float(
-                    ring.life_vals[LIFE_BREAKER_TRIPS]
-                ),
-                "promotions": {
-                    outcome: float(ring.life_promos[i])
-                    for i, outcome in enumerate(LIFE_OUTCOMES)
-                },
-            }
+        for t in range(len(self.engines)):
+            if float(ring.life_vals[t, LIFE_HAS]):
+                self._life_base[t] = {
+                    "drift_triggers": float(ring.life_vals[t, LIFE_TRIGGERS]),
+                    "breaker_trips": float(
+                        ring.life_vals[t, LIFE_BREAKER_TRIPS]
+                    ),
+                    "promotions": {
+                        outcome: float(ring.life_promos[t, i])
+                        for i, outcome in enumerate(LIFE_OUTCOMES)
+                    },
+                }
         stats = getattr(self.engine, "shape_stats", None)
         if stats is not None and float(ring.shape_meta[0]) > 0:
             from mlops_tpu.trace.shapes import read_table
@@ -1240,14 +1371,15 @@ class RingService:
                 t0=float(ring.shape_meta[0]),
             )
         rows_lost = 0.0
-        if self._accumulating and float(ring.mon_vals[MON_HAS]):
-            self.engine.seed_monitor_totals(
-                float(ring.mon_vals[MON_ROWS]),
-                float(ring.mon_vals[MON_OUTLIERS]),
-                float(ring.mon_vals[MON_BATCHES]),
-                np.asarray(ring.mon_drift_sum, np.float64),
-                np.asarray(ring.mon_drift_last, np.float64),
-            )
+        for t, eng in enumerate(self.engines):
+            if self._accumulating[t] and float(ring.mon_vals[t, MON_HAS]):
+                eng.seed_monitor_totals(
+                    float(ring.mon_vals[t, MON_ROWS]),
+                    float(ring.mon_vals[t, MON_OUTLIERS]),
+                    float(ring.mon_vals[t, MON_BATCHES]),
+                    np.asarray(ring.mon_drift_sum[t], np.float64),
+                    np.asarray(ring.mon_drift_last[t], np.float64),
+                )
         pending = ring.pending_submissions()
         replay = [
             (slot, int(ring.slot_gen[slot]))
@@ -1255,17 +1387,35 @@ class RingService:
             if int(ring.slot_busy[slot]) and slot not in pending
         ]
         replay_rows = sum(int(ring.slot_n[slot]) for slot, _ in replay)
-        if self._accumulating:
-            # The dead engine's device accumulator window: rows it folded
-            # on device (ENG_ROWS_DISPATCHED) minus rows a telemetry
-            # fetch preserved (MON_ROWS), minus the rows the replay below
-            # re-folds. Counted, then the dispatch baseline re-anchors to
-            # the fetched totals so the replayed rows land exactly once.
-            dispatched = float(ring.eng_vals[ENG_ROWS_DISPATCHED])
-            fetched = float(ring.mon_vals[MON_ROWS])
-            rows_lost = max(0.0, dispatched - fetched - replay_rows)
+        replay_rows_by_tenant: dict[int, int] = {}
+        for slot, _ in replay:
+            t = self._slot_tenant(slot)
+            replay_rows_by_tenant[t] = (
+                replay_rows_by_tenant.get(t, 0) + int(ring.slot_n[slot])
+            )
+        fetched_total = 0.0
+        for t in range(len(self.engines)):
+            if not self._accumulating[t]:
+                continue
+            # The dead engine's device accumulator window, PER TENANT:
+            # rows it folded on device (eng_rows_tenant) minus rows a
+            # telemetry fetch preserved (that tenant's MON_ROWS), minus
+            # the rows the replay below re-folds into that tenant's
+            # accumulator. Counted, then the dispatch baseline
+            # re-anchors to the fetched totals so the replayed rows land
+            # exactly once — per tenant, so one tenant's loss can never
+            # hide inside another tenant's surplus.
+            dispatched = float(ring.eng_rows_tenant[t])
+            fetched = float(ring.mon_vals[t, MON_ROWS])
+            fetched_total += fetched
+            rows_lost += max(
+                0.0,
+                dispatched - fetched - replay_rows_by_tenant.get(t, 0),
+            )
+            ring.eng_rows_tenant[t] = fetched
+        if rows_lost:
             ring.eng_vals[ENG_ROWS_LOST] += rows_lost
-            ring.eng_vals[ENG_ROWS_DISPATCHED] = fetched
+        ring.eng_vals[ENG_ROWS_DISPATCHED] = fetched_total
         if replay:
             import concurrent.futures
 
@@ -1370,9 +1520,10 @@ class RingService:
                 with self._mon_lock:
                     ring.rob_vals[ROB_EXPIRED_ENGINE] += len(expired)
             raws, status = None, RESP_OK
+            tenant = self._slot_tenant(job[0][0]) if job else 0
             if live:
                 try:
-                    raws = self._score(live)
+                    raws = self._score(live, tenant)
                 # The breadth is the contract: ANY scoring failure (device
                 # error, geometry bug) must become an error completion on
                 # every waiting slot — a dropped descriptor would strand
@@ -1382,13 +1533,15 @@ class RingService:
                         "ring dispatch failed (%d slots)", len(live)
                     )
                     raws, status = None, RESP_ERROR
-            if live and status == RESP_OK and self._accumulating:
-                # Rows now folded into the device accumulator but not yet
-                # preserved by a telemetry fetch — the re-attach reads
-                # this against MON_ROWS to bound what an engine death
-                # loses (monitor_rows_lost_total, ISSUE 11).
+            if live and status == RESP_OK and self._accumulating[tenant]:
+                # Rows now folded into the tenant's device accumulator but
+                # not yet preserved by a telemetry fetch — the re-attach
+                # reads this against the tenant's MON_ROWS to bound what
+                # an engine death loses (monitor_rows_lost_total, ISSUE
+                # 11). The eng_vals cell keeps the fleet sum.
                 rows = sum(int(ring.slot_n[s]) for s, _ in live)
                 with self._mon_lock:
+                    ring.eng_rows_tenant[tenant] += rows
                     ring.eng_vals[ENG_ROWS_DISPATCHED] += rows
             incarnation = int(ring.eng_vals[ENG_INCARNATION])
             for i, (slot, gen) in enumerate(live):
@@ -1430,13 +1583,17 @@ class RingService:
             self._inflight.release()
 
     def _score(
-        self, job: list[tuple[int, int]]
+        self, job: list[tuple[int, int]], tenant: int = 0
     ) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Score one job -> per-slot raw (predictions, outliers, drift).
         Multi-slot jobs ride ONE grouped device dispatch
         (`dispatch_group_arrays` — the arrays come pre-encoded from the
-        front ends, so the engine process does zero per-record Python)."""
-        ring, engine = self.ring, self.engine
+        front ends, so the engine process does zero per-record Python).
+        ``tenant`` selects the bundle: every slot in a job belongs to one
+        tenant (`_make_jobs` partitions), so the whole job dispatches
+        through that tenant's engine — its params, its monitor
+        accumulator, its temperature."""
+        ring, engine = self.ring, self.engines[tenant]
         tracing = ring.tracing
         parts = []
         for slot, _ in job:
@@ -1466,8 +1623,8 @@ class RingService:
             now = time.monotonic()
             for slot, _ in job:
                 ring.resp_trace[slot, 3] = now
-        if not self._accumulating:
-            self._fold_host_monitor(raws)
+        if not self._accumulating[tenant]:
+            self._fold_host_monitor(raws, tenant)
         return raws
 
     def _stamp_dispatched(
@@ -1493,23 +1650,25 @@ class RingService:
             ring.resp_trace[slot, 5] = float(geom)
 
     def _fold_host_monitor(
-        self, raws: list[tuple[np.ndarray, np.ndarray, np.ndarray]]
+        self,
+        raws: list[tuple[np.ndarray, np.ndarray, np.ndarray]],
+        tenant: int = 0,
     ) -> None:
         """Host-side monitor fold for engines without a device accumulator
         (the sklearn flavor / test stubs) — the seed's per-response
-        `observe_prediction`, landed in the shared block instead. The
-        numpy reductions run OUTSIDE the lock; only the scalar
-        read-modify-writes sit inside."""
+        `observe_prediction`, landed in the tenant's shared block
+        instead. The numpy reductions run OUTSIDE the lock; only the
+        scalar read-modify-writes sit inside."""
         rows = sum(len(pred) for pred, _, _ in raws)
         outliers = float(sum(float(out.sum()) for _, out, _ in raws))
         last = raws[-1][2]
         ring = self.ring
         with self._mon_lock:
-            ring.mon_vals[MON_ROWS] += rows
-            ring.mon_vals[MON_OUTLIERS] += outliers
-            ring.mon_vals[MON_BATCHES] += len(raws)
-            ring.mon_drift_last[:] = last
-            ring.mon_vals[MON_HAS] = 1.0
+            ring.mon_vals[tenant, MON_ROWS] += rows
+            ring.mon_vals[tenant, MON_OUTLIERS] += outliers
+            ring.mon_vals[tenant, MON_BATCHES] += len(raws)
+            ring.mon_drift_last[tenant, :] = last
+            ring.mon_vals[tenant, MON_HAS] = 1.0
 
     # ----------------------------------------------------------- telemetry
     def _telemetry_loop(self) -> None:
@@ -1531,25 +1690,38 @@ class RingService:
                 time.monotonic() - last_fetch >= self._mon_period
                 and self._requests_since_fetch > 0
             )
-            never = self.ring.mon_vals[MON_HAS] == 0.0
+            never = any(
+                self._accumulating[t]
+                and self.ring.mon_vals[t, MON_HAS] == 0.0
+                for t in range(len(self.engines))
+            )
             if not (due_k or due_t or never):
                 continue
             self._requests_since_fetch = 0
             last_fetch = time.monotonic()
-            try:
-                self.ring.write_monitor(self.engine.monitor_snapshot())
-            # A transient device fetch failure keeps the last-written
-            # gauges; the next tick retries (same contract as the
-            # single-process fetch task's done-callback).
-            except Exception:  # tpulint: disable=TPU201
-                logger.exception("ring monitor fetch failed; gauges stale")
+            for t, eng in enumerate(self.engines):
+                if not self._accumulating[t]:
+                    continue
+                try:
+                    self.ring.write_monitor(eng.monitor_snapshot(), t)
+                # A transient device fetch failure keeps the last-written
+                # gauges; the next tick retries (same contract as the
+                # single-process fetch task's done-callback).
+                except Exception:  # tpulint: disable=TPU201
+                    logger.exception(
+                        "ring monitor fetch failed (tenant %d); gauges "
+                        "stale", t,
+                    )
 
     def _write_robustness(self) -> None:
-        """Mirror the engine's degraded-dispatch total into shm (a host
-        int read + one f64 store, no device work) so every front end's
+        """Mirror the fleet's degraded-dispatch total into shm (host int
+        reads + one f64 store, no device work) so every front end's
         /metrics renders it. The respawn base keeps the exported counter
         monotone across engine incarnations (reattach)."""
-        degraded = getattr(self.engine, "degraded_dispatch_total", 0)
+        degraded = sum(
+            getattr(eng, "degraded_dispatch_total", 0)
+            for eng in self.engines
+        )
         with self._mon_lock:
             self.ring.rob_vals[ROB_DEGRADED] = (
                 self._degraded_base + float(degraded)
@@ -1565,38 +1737,55 @@ class RingService:
         stats.write_table(self.ring.shape_keys, self.ring.shape_vals)
         self.ring.shape_meta[0] = stats.t0
 
+    def _tenant_lifecycles(self) -> list[tuple[int, Any]]:
+        """(tenant index, controller) pairs: the per-tenant list when the
+        fleet attached one, else the pre-tenancy single controller on
+        tenant row 0."""
+        if self.lifecycles is not None:
+            return [
+                (t, ctl)
+                for t, ctl in enumerate(self.lifecycles)
+                if ctl is not None
+            ]
+        if self.lifecycle is not None:
+            return [(0, self.lifecycle)]
+        return []
+
     def _write_lifecycle(self) -> None:
-        """Mirror the attached controller's gauge snapshot into shm (a
-        host-dict read plus f64 stores — no device work)."""
-        lifecycle = self.lifecycle
-        if lifecycle is None:
-            return
-        try:
-            snapshot = lifecycle.metrics_snapshot()
-            base = self._life_base
-            if base and snapshot:
-                # Respawn bases: a fresh controller's counters restart
-                # at zero — fold the dead incarnation's published totals
-                # back in so drift_trigger/promotions/breaker-trip
-                # counters never regress across an engine respawn.
-                snapshot = dict(snapshot)
-                snapshot["drift_triggers"] = (
-                    snapshot.get("drift_triggers", 0)
-                    + base["drift_triggers"]
-                )
-                snapshot["breaker_trips"] = (
-                    snapshot.get("breaker_trips", 0)
-                    + base["breaker_trips"]
-                )
-                promotions = dict(snapshot.get("promotions", {}))
-                for outcome, count in base["promotions"].items():
-                    promotions[outcome] = (
-                        promotions.get(outcome, 0) + count
+        """Mirror each attached controller's gauge snapshot into its
+        tenant's shm row (host-dict reads plus f64 stores — no device
+        work)."""
+        for tenant, lifecycle in self._tenant_lifecycles():
+            try:
+                snapshot = lifecycle.metrics_snapshot()
+                base = self._life_base.get(tenant)
+                if base and snapshot:
+                    # Respawn bases: a fresh controller's counters restart
+                    # at zero — fold the dead incarnation's published
+                    # totals back in so drift_trigger/promotions/
+                    # breaker-trip counters never regress across an
+                    # engine respawn.
+                    snapshot = dict(snapshot)
+                    snapshot["drift_triggers"] = (
+                        snapshot.get("drift_triggers", 0)
+                        + base["drift_triggers"]
                     )
-                snapshot["promotions"] = promotions
-            self.ring.write_lifecycle(snapshot)
-        # Telemetry breadth contract: a controller mid-transition (or a
-        # snapshot bug) costs one gauge refresh, never the telemetry
-        # thread.
-        except Exception:  # tpulint: disable=TPU201
-            logger.exception("ring lifecycle write failed; gauges stale")
+                    snapshot["breaker_trips"] = (
+                        snapshot.get("breaker_trips", 0)
+                        + base["breaker_trips"]
+                    )
+                    promotions = dict(snapshot.get("promotions", {}))
+                    for outcome, count in base["promotions"].items():
+                        promotions[outcome] = (
+                            promotions.get(outcome, 0) + count
+                        )
+                    snapshot["promotions"] = promotions
+                self.ring.write_lifecycle(snapshot, tenant)
+            # Telemetry breadth contract: a controller mid-transition (or
+            # a snapshot bug) costs one gauge refresh, never the
+            # telemetry thread.
+            except Exception:  # tpulint: disable=TPU201
+                logger.exception(
+                    "ring lifecycle write failed (tenant %d); gauges "
+                    "stale", tenant,
+                )

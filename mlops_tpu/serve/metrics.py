@@ -63,23 +63,49 @@ ROB_EXPIRED_ENGINE, ROB_DEGRADED = range(2)
 LIFE_OUTCOMES = ("promoted", "rejected", "rolled_back")
 
 
+DEFAULT_TENANT_LABEL = "default"
+
+
+def _zero_monitor_block() -> dict:
+    return {
+        "rows": 0,
+        "outliers": 0,
+        "batches": 0,
+        "last_drift": {},
+        "mean_drift": {},
+    }
+
+
 class ServingMetrics:
     # Fixed latency histogram buckets (ms).
     LATENCY_BUCKETS = (0.5, 1, 2, 5, 10, 25, 50, 100, 250, 1000, float("inf"))
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.requests: dict[tuple[str, int], int] = defaultdict(int)
-        self.latency_counts = [0] * len(self.LATENCY_BUCKETS)
-        self.latency_sum_ms = 0.0
-        self.latency_n = 0
-        self.rows_total = 0
-        self.outliers_total = 0
-        self.last_drift: dict[str, float] = {}
-        self.mean_drift: dict[str, float] = {}
-        self.monitor_batches = 0
+        # Every per-traffic series carries a ``tenant`` dimension
+        # (mlops_tpu/tenancy/): untagged pre-tenancy traffic lands on the
+        # "default" label, so single-tenant dashboards keep parsing —
+        # they just gain one constant label. Tenant label values are
+        # BOUNDED upstream (TenantRouter.bill_label: declared names
+        # only — strangers' 404s bill the default tenant's row, same as
+        # the ring plane's fixed shm rows), never raw header text.
+        self.requests: dict[tuple[str, int, str], int] = defaultdict(int)
+        self.latency_counts: dict[str, list[int]] = {
+            DEFAULT_TENANT_LABEL: [0] * len(self.LATENCY_BUCKETS)
+        }
+        self.latency_sum_ms: dict[str, float] = defaultdict(float)
+        self.latency_n: dict[str, int] = defaultdict(int)
+        # tenant label -> monitor aggregate block (rows/outliers/batches/
+        # drift gauges). The default tenant's block always exists so the
+        # zero baseline stays exported (chaos-smoke monotonicity).
+        self.monitor: dict[str, dict] = {
+            DEFAULT_TENANT_LABEL: _zero_monitor_block()
+        }
         self.monitor_fetches = 0
-        self.monitor_fetched_at: float | None = None  # time.monotonic()
+        # time.monotonic() of each tenant's last applied snapshot: the
+        # age gauge reads the OLDEST (one stuck tenant must not be
+        # masked by another's fresh fetch).
+        self.monitor_fetched_at: dict[str, float] = {}
         # Robustness counters (ISSUE 9): dead-work sheds (requests
         # answered 504 WITHOUT their work running — the admission check
         # and the batcher's claim-time purge) and degraded-shape
@@ -91,11 +117,11 @@ class ServingMetrics:
         # scrape; stays 0 (and still exported) with tracing disarmed so
         # the chaos smoke's monotonicity check covers it.
         self.trace_dropped = 0
-        # Lifecycle gauges (mlops_tpu/lifecycle/): None until a controller
-        # installs a snapshot — the series are only exported when the
-        # loop is actually running, so a loop-less deployment's scrape is
-        # byte-identical to pre-lifecycle builds.
-        self.lifecycle: dict | None = None
+        # Lifecycle gauges (mlops_tpu/lifecycle/), per tenant: empty until
+        # a controller installs a snapshot — the series are only exported
+        # when a loop is actually running, so a loop-less deployment's
+        # scrape is byte-identical to pre-lifecycle builds.
+        self.lifecycle: dict[str, dict] = {}
 
     # Known routes only: arbitrary request paths must not become unbounded
     # (and injectable) Prometheus label values.
@@ -107,49 +133,75 @@ class ServingMetrics:
         "/metrics",
     )
 
-    def observe_request(self, route: str, status: int, latency_ms: float) -> None:
+    def observe_request(
+        self,
+        route: str,
+        status: int,
+        latency_ms: float,
+        tenant: str = DEFAULT_TENANT_LABEL,
+    ) -> None:
         if route not in self.KNOWN_ROUTES:
             route = "<other>"
         with self._lock:
-            self.requests[(route, status)] += 1
-            self.latency_sum_ms += latency_ms
-            self.latency_n += 1
+            self.requests[(route, status, tenant)] += 1
+            self.latency_sum_ms[tenant] += latency_ms
+            self.latency_n[tenant] += 1
+            counts = self.latency_counts.get(tenant)
+            if counts is None:
+                counts = self.latency_counts[tenant] = (
+                    [0] * len(self.LATENCY_BUCKETS)
+                )
             for i, edge in enumerate(self.LATENCY_BUCKETS):
                 if latency_ms <= edge:
-                    self.latency_counts[i] += 1
+                    counts[i] += 1
                     break
 
-    def observe_prediction(self, response: dict) -> None:
+    def _monitor_block(self, tenant: str) -> dict:
+        block = self.monitor.get(tenant)
+        if block is None:
+            block = self.monitor[tenant] = _zero_monitor_block()
+        return block
+
+    def observe_prediction(
+        self, response: dict, tenant: str = DEFAULT_TENANT_LABEL
+    ) -> None:
         """Host-side per-response fold — the seed path, kept for engines
         without a device monitor accumulator (sklearn flavor, stubs)."""
         with self._lock:
-            self.rows_total += len(response["predictions"])
-            self.outliers_total += int(sum(response["outliers"]))
-            self.last_drift = dict(response["feature_drift_batch"])
+            block = self._monitor_block(tenant)
+            block["rows"] += len(response["predictions"])
+            block["outliers"] += int(sum(response["outliers"]))
+            block["last_drift"] = dict(response["feature_drift_batch"])
 
-    def set_monitor_aggregate(self, snapshot: dict) -> None:
+    def set_monitor_aggregate(
+        self, snapshot: dict, tenant: str = DEFAULT_TENANT_LABEL
+    ) -> None:
         """Install a device-accumulator snapshot
         (`serve/engine.py monitor_snapshot`): the device totals are
-        absolute counters, so this REPLACES the monitor gauges rather than
-        adding — per-request host folding never runs on this path."""
+        absolute counters, so this REPLACES the tenant's monitor gauges
+        rather than adding — per-request host folding never runs on this
+        path."""
         if not snapshot:
             return
         with self._lock:
-            self.rows_total = int(snapshot["rows"])
-            self.outliers_total = int(snapshot["outliers"])
-            self.monitor_batches = int(snapshot["batches"])
-            self.last_drift = dict(snapshot["drift_last"])
-            self.mean_drift = dict(snapshot["drift_mean"])
+            block = self._monitor_block(tenant)
+            block["rows"] = int(snapshot["rows"])
+            block["outliers"] = int(snapshot["outliers"])
+            block["batches"] = int(snapshot["batches"])
+            block["last_drift"] = dict(snapshot["drift_last"])
+            block["mean_drift"] = dict(snapshot["drift_mean"])
             self.monitor_fetches += 1
-            self.monitor_fetched_at = time.monotonic()
+            self.monitor_fetched_at[tenant] = time.monotonic()
 
-    def set_lifecycle(self, snapshot: dict) -> None:
+    def set_lifecycle(
+        self, snapshot: dict, tenant: str = DEFAULT_TENANT_LABEL
+    ) -> None:
         """Install a lifecycle-controller snapshot
         (`lifecycle/controller.py metrics_snapshot`) for the next render."""
         if not snapshot:
             return
         with self._lock:
-            self.lifecycle = dict(snapshot)
+            self.lifecycle[tenant] = dict(snapshot)
 
     def count_deadline_expired(self) -> None:
         """One dead-work shed: a request answered the documented 504
@@ -220,99 +272,152 @@ class ServingMetrics:
         ]
 
     @staticmethod
-    def lifecycle_lines(snapshot: dict | None) -> list[str]:
-        """The lifecycle gauge block — ONE definition shared by the
-        single-process render and the ring render's label set, so the two
-        telemetry planes export identical series names."""
+    def lifecycle_lines(
+        snapshot: dict | None, tenant: str = DEFAULT_TENANT_LABEL
+    ) -> list[str]:
+        """The lifecycle gauge block for ONE tenant's controller — ONE
+        definition shared by the single-process render and the ring
+        render's label set, so the two telemetry planes export identical
+        series names. Every series carries the ``tenant`` label: the
+        lifecycle loop runs PER TENANT (tenant A drifting retrains and
+        promotes A alone), so generation/trigger/promotion gauges are
+        only meaningful per tenant."""
         if not snapshot:
             return []
+        t = f'tenant="{tenant}"'
         lines = [
             "# TYPE mlops_tpu_bundle_generation gauge",
-            f"mlops_tpu_bundle_generation {int(snapshot['generation'])}",
+            f"mlops_tpu_bundle_generation{{{t}}} "
+            f"{int(snapshot['generation'])}",
             "# TYPE mlops_tpu_drift_trigger_total counter",
-            f"mlops_tpu_drift_trigger_total {int(snapshot['drift_triggers'])}",
+            f"mlops_tpu_drift_trigger_total{{{t}}} "
+            f"{int(snapshot['drift_triggers'])}",
         ]
         delta = snapshot.get("shadow_auc_delta")
         if delta is not None:
             lines.append("# TYPE mlops_tpu_shadow_auc_delta gauge")
-            lines.append(f"mlops_tpu_shadow_auc_delta {float(delta):.6f}")
+            lines.append(
+                f"mlops_tpu_shadow_auc_delta{{{t}}} {float(delta):.6f}"
+            )
         lines.append("# TYPE mlops_tpu_promotions_total counter")
         promotions = snapshot.get("promotions", {})
         for outcome in LIFE_OUTCOMES:
             lines.append(
-                f'mlops_tpu_promotions_total{{outcome="{outcome}"}} '
+                f'mlops_tpu_promotions_total{{{t},outcome="{outcome}"}} '
                 f"{int(promotions.get(outcome, 0))}"
             )
         rows = snapshot.get("reservoir_rows")
         if rows is not None:
             lines.append("# TYPE mlops_tpu_lifecycle_reservoir_rows gauge")
-            lines.append(f"mlops_tpu_lifecycle_reservoir_rows {int(rows)}")
+            lines.append(
+                f"mlops_tpu_lifecycle_reservoir_rows{{{t}}} {int(rows)}"
+            )
         if "breaker_open" in snapshot:
             # Circuit breaker (lifecycle/controller.py): open = repeated
             # retrain/shadow failures tripped the loop into a cooldown
             # instead of hot-looping; trips count the openings.
             lines.append("# TYPE mlops_tpu_lifecycle_breaker_open gauge")
             lines.append(
-                "mlops_tpu_lifecycle_breaker_open "
+                f"mlops_tpu_lifecycle_breaker_open{{{t}}} "
                 f"{1 if snapshot['breaker_open'] else 0}"
             )
             lines.append(
                 "# TYPE mlops_tpu_lifecycle_breaker_trips_total counter"
             )
             lines.append(
-                "mlops_tpu_lifecycle_breaker_trips_total "
+                f"mlops_tpu_lifecycle_breaker_trips_total{{{t}}} "
                 f"{int(snapshot.get('breaker_trips', 0))}"
             )
         return lines
 
     def render(self) -> str:
-        """Prometheus text format."""
+        """Prometheus text format. Per-traffic series carry the
+        ``tenant`` label (constant "default" on a single-tenant plane,
+        so pre-tenancy dashboards parse unchanged)."""
         with self._lock:
             lines = [
                 "# TYPE mlops_tpu_requests_total counter",
             ]
-            for (route, status), count in sorted(self.requests.items()):
+            for (route, status, tenant), count in sorted(
+                self.requests.items(), key=lambda kv: (kv[0][2],) + kv[0][:2]
+            ):
                 lines.append(
-                    f'mlops_tpu_requests_total{{route="{route}",status="{status}"}} {count}'
+                    f'mlops_tpu_requests_total{{route="{route}",'
+                    f'status="{status}",tenant="{tenant}"}} {count}'
                 )
             lines.append("# TYPE mlops_tpu_request_latency_ms histogram")
-            cumulative = 0
-            for edge, count in zip(self.LATENCY_BUCKETS, self.latency_counts):
-                cumulative += count
-                label = "+Inf" if edge == float("inf") else str(edge)
-                lines.append(
-                    f'mlops_tpu_request_latency_ms_bucket{{le="{label}"}} {cumulative}'
-                )
-            lines.append(f"mlops_tpu_request_latency_ms_sum {self.latency_sum_ms}")
-            lines.append(f"mlops_tpu_request_latency_ms_count {self.latency_n}")
-            lines.append("# TYPE mlops_tpu_rows_scored_total counter")
-            lines.append(f"mlops_tpu_rows_scored_total {self.rows_total}")
-            lines.append("# TYPE mlops_tpu_outliers_total counter")
-            lines.append(f"mlops_tpu_outliers_total {self.outliers_total}")
-            lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
-            for feature, score in self.last_drift.items():
-                lines.append(
-                    f'mlops_tpu_feature_drift_score{{feature="{feature}"}} {score}'
-                )
-            if self.mean_drift:
-                lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
-                for feature, score in self.mean_drift.items():
+            for tenant in sorted(self.latency_counts):
+                cumulative = 0
+                for edge, count in zip(
+                    self.LATENCY_BUCKETS, self.latency_counts[tenant]
+                ):
+                    cumulative += count
+                    label = "+Inf" if edge == float("inf") else str(edge)
                     lines.append(
-                        f'mlops_tpu_feature_drift_mean{{feature="{feature}"}} {score}'
+                        f'mlops_tpu_request_latency_ms_bucket{{le="{label}",'
+                        f'tenant="{tenant}"}} {cumulative}'
                     )
+                lines.append(
+                    f'mlops_tpu_request_latency_ms_sum{{tenant="{tenant}"}} '
+                    f"{self.latency_sum_ms[tenant]}"
+                )
+                lines.append(
+                    f'mlops_tpu_request_latency_ms_count{{tenant="{tenant}"}} '
+                    f"{self.latency_n[tenant]}"
+                )
+            lines.append("# TYPE mlops_tpu_rows_scored_total counter")
+            for tenant in sorted(self.monitor):
+                lines.append(
+                    f'mlops_tpu_rows_scored_total{{tenant="{tenant}"}} '
+                    f"{self.monitor[tenant]['rows']}"
+                )
+            lines.append("# TYPE mlops_tpu_outliers_total counter")
+            for tenant in sorted(self.monitor):
+                lines.append(
+                    f'mlops_tpu_outliers_total{{tenant="{tenant}"}} '
+                    f"{self.monitor[tenant]['outliers']}"
+                )
+            if any(m["last_drift"] for m in self.monitor.values()):
+                lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
+                for tenant in sorted(self.monitor):
+                    for feature, score in self.monitor[tenant][
+                        "last_drift"
+                    ].items():
+                        lines.append(
+                            "mlops_tpu_feature_drift_score"
+                            f'{{feature="{feature}",tenant="{tenant}"}} '
+                            f"{score}"
+                        )
+            if any(m["mean_drift"] for m in self.monitor.values()):
+                lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
+                for tenant in sorted(self.monitor):
+                    for feature, score in self.monitor[tenant][
+                        "mean_drift"
+                    ].items():
+                        lines.append(
+                            "mlops_tpu_feature_drift_mean"
+                            f'{{feature="{feature}",tenant="{tenant}"}} '
+                            f"{score}"
+                        )
             if self.monitor_fetches:
                 lines.append("# TYPE mlops_tpu_monitor_fetches_total counter")
                 lines.append(
                     f"mlops_tpu_monitor_fetches_total {self.monitor_fetches}"
                 )
                 lines.append("# TYPE mlops_tpu_monitor_batches_total counter")
-                lines.append(
-                    f"mlops_tpu_monitor_batches_total {self.monitor_batches}"
-                )
+                for tenant in sorted(self.monitor):
+                    lines.append(
+                        f'mlops_tpu_monitor_batches_total{{tenant="{tenant}"}} '
+                        f"{self.monitor[tenant]['batches']}"
+                    )
                 # The staleness bound docs/operations.md advertises, made
-                # observable: seconds since the exported monitor gauges
-                # were last refreshed from the device.
-                age = time.monotonic() - self.monitor_fetched_at
+                # observable: seconds since the OLDEST tenant's gauges
+                # were refreshed from the device (min over tenants —
+                # same alarm semantics as the ring render: one stuck
+                # tenant must not hide behind another's fresh fetch).
+                age = time.monotonic() - min(
+                    self.monitor_fetched_at.values()
+                )
                 lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
                 lines.append(
                     f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
@@ -329,7 +434,10 @@ class ServingMetrics:
             # structurally zero but still exported (identical series set
             # across planes; monotonicity stays checkable).
             lines.extend(self.survivability_lines(0, 0, 0, 0, 0))
-            lines.extend(self.lifecycle_lines(self.lifecycle))
+            for tenant in sorted(self.lifecycle):
+                lines.extend(
+                    self.lifecycle_lines(self.lifecycle[tenant], tenant)
+                )
             return "\n".join(lines) + "\n"
 
 
@@ -348,94 +456,148 @@ def render_ring_metrics(ring) -> str:
 
     routes = ServingMetrics.KNOWN_ROUTES + ("<other>",)
     buckets = ServingMetrics.LATENCY_BUCKETS
+    tenants = tuple(getattr(ring, "tenant_names", ("default",)))
     lines = ["# TYPE mlops_tpu_requests_total counter"]
     for w in range(ring.workers):
-        for r_i, route in enumerate(routes):
-            for s_i, status in enumerate(RING_STATUSES):
-                count = int(ring.req_counts[w, r_i, s_i])
-                if count:
+        for t, tenant in enumerate(tenants):
+            for r_i, route in enumerate(routes):
+                for s_i, status in enumerate(RING_STATUSES):
+                    count = int(ring.req_counts[w, t, r_i, s_i])
+                    if count:
+                        lines.append(
+                            f'mlops_tpu_requests_total{{route="{route}",'
+                            f'status="{status}",worker="{w}",'
+                            f'tenant="{tenant}"}} {count}'
+                        )
+                other = int(ring.req_counts[w, t, r_i, len(RING_STATUSES)])
+                if other:
                     lines.append(
                         f'mlops_tpu_requests_total{{route="{route}",'
-                        f'status="{status}",worker="{w}"}} {count}'
+                        f'status="other",worker="{w}",'
+                        f'tenant="{tenant}"}} {other}'
                     )
-            other = int(ring.req_counts[w, r_i, len(RING_STATUSES)])
-            if other:
-                lines.append(
-                    f'mlops_tpu_requests_total{{route="{route}",'
-                    f'status="other",worker="{w}"}} {other}'
-                )
     lines.append("# TYPE mlops_tpu_request_latency_ms histogram")
     for w in range(ring.workers):
-        cumulative = 0
-        for edge, count in zip(buckets, ring.lat_counts[w]):
-            cumulative += int(count)
-            label = "+Inf" if edge == float("inf") else str(edge)
+        for t, tenant in enumerate(tenants):
+            cumulative = 0
+            for edge, count in zip(buckets, ring.lat_counts[w, t]):
+                cumulative += int(count)
+                label = "+Inf" if edge == float("inf") else str(edge)
+                lines.append(
+                    f'mlops_tpu_request_latency_ms_bucket{{le="{label}",'
+                    f'worker="{w}",tenant="{tenant}"}} {cumulative}'
+                )
             lines.append(
-                f'mlops_tpu_request_latency_ms_bucket{{le="{label}",'
-                f'worker="{w}"}} {cumulative}'
+                f'mlops_tpu_request_latency_ms_sum{{worker="{w}",'
+                f'tenant="{tenant}"}} {float(ring.lat_sum_ms[w, t])}'
             )
-        lines.append(
-            f'mlops_tpu_request_latency_ms_sum{{worker="{w}"}} '
-            f"{float(ring.lat_sum_ms[w])}"
-        )
-        lines.append(
-            f'mlops_tpu_request_latency_ms_count{{worker="{w}"}} '
-            f"{int(ring.lat_n[w])}"
-        )
+            lines.append(
+                f'mlops_tpu_request_latency_ms_count{{worker="{w}",'
+                f'tenant="{tenant}"}} {int(ring.lat_n[w, t])}'
+            )
+    # Ring depth / shed per tenant: the per-tenant cells ARE the
+    # partition occupancy (a slot is always held by exactly one tenant),
+    # so summing the tenant label away reproduces the pre-tenancy
+    # per-worker-per-class values dashboards already graph.
     lines.append("# TYPE mlops_tpu_ring_depth gauge")
     for w in range(ring.workers):
         for c_i, cls in enumerate(RING_CLASSES):
-            lines.append(
-                f'mlops_tpu_ring_depth{{worker="{w}",class="{cls}"}} '
-                f"{int(ring.inflight[w, c_i])}"
-            )
+            for t, tenant in enumerate(tenants):
+                lines.append(
+                    f'mlops_tpu_ring_depth{{worker="{w}",class="{cls}",'
+                    f'tenant="{tenant}"}} {int(ring.inflight[w, t, c_i])}'
+                )
     lines.append("# TYPE mlops_tpu_shed_total counter")
     for w in range(ring.workers):
         for c_i, cls in enumerate(RING_CLASSES):
+            for t, tenant in enumerate(tenants):
+                lines.append(
+                    f'mlops_tpu_shed_total{{worker="{w}",class="{cls}",'
+                    f'tenant="{tenant}"}} {int(ring.shed[w, t, c_i])}'
+                )
+    # Per-tenant quota sheds: the subset of sheds rejected by the
+    # tenant's own weighted max-min quota (its floor was exhausted) as
+    # opposed to physical slot exhaustion — the fairness contract's
+    # observable (docs/operations.md "Multi-tenant serving").
+    lines.append("# TYPE mlops_tpu_tenant_quota_shed_total counter")
+    for w in range(ring.workers):
+        for t, tenant in enumerate(tenants):
             lines.append(
-                f'mlops_tpu_shed_total{{worker="{w}",class="{cls}"}} '
-                f"{int(ring.shed[w, c_i])}"
+                f'mlops_tpu_tenant_quota_shed_total{{worker="{w}",'
+                f'tenant="{tenant}"}} {int(ring.quota_shed[w, t])}'
             )
     lines.append("# TYPE mlops_tpu_rows_scored_total counter")
-    lines.append(
-        f"mlops_tpu_rows_scored_total {int(ring.mon_vals[MON_ROWS])}"
-    )
+    for t, tenant in enumerate(tenants):
+        lines.append(
+            f'mlops_tpu_rows_scored_total{{tenant="{tenant}"}} '
+            f"{int(ring.mon_vals[t, MON_ROWS])}"
+        )
     lines.append("# TYPE mlops_tpu_outliers_total counter")
-    lines.append(
-        f"mlops_tpu_outliers_total {int(ring.mon_vals[MON_OUTLIERS])}"
-    )
-    if ring.mon_vals[MON_HAS]:
+    for t, tenant in enumerate(tenants):
+        lines.append(
+            f'mlops_tpu_outliers_total{{tenant="{tenant}"}} '
+            f"{int(ring.mon_vals[t, MON_OUTLIERS])}"
+        )
+    if any(ring.mon_vals[t, MON_HAS] for t in range(len(tenants))):
         lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
-        for feature, score in zip(SCHEMA.feature_names, ring.mon_drift_last):
-            lines.append(
-                f'mlops_tpu_feature_drift_score{{feature="{feature}"}} '
-                f"{float(score)}"
-            )
+        for t, tenant in enumerate(tenants):
+            if not ring.mon_vals[t, MON_HAS]:
+                continue
+            for feature, score in zip(
+                SCHEMA.feature_names, ring.mon_drift_last[t]
+            ):
+                lines.append(
+                    f'mlops_tpu_feature_drift_score{{feature="{feature}",'
+                    f'tenant="{tenant}"}} {float(score)}'
+                )
         # Mean drift exists only on the device-accumulator path (written
         # by RequestRing.write_monitor, which also counts fetches); the
         # host-side fold for non-accumulating engines tracks no mean, and
         # rendering zeros would read as "no drift" where the
         # single-process server correctly emits no series at all.
-        if int(ring.mon_vals[MON_FETCHES]):
+        if any(
+            int(ring.mon_vals[t, MON_FETCHES]) for t in range(len(tenants))
+        ):
             lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
-            for feature, score in zip(
-                SCHEMA.feature_names, ring.mon_drift_mean
-            ):
-                lines.append(
-                    f'mlops_tpu_feature_drift_mean{{feature="{feature}"}} '
-                    f"{float(score)}"
-                )
-    fetches = int(ring.mon_vals[MON_FETCHES])
+            for t, tenant in enumerate(tenants):
+                if not int(ring.mon_vals[t, MON_FETCHES]):
+                    continue
+                for feature, score in zip(
+                    SCHEMA.feature_names, ring.mon_drift_mean[t]
+                ):
+                    lines.append(
+                        f'mlops_tpu_feature_drift_mean{{feature="{feature}",'
+                        f'tenant="{tenant}"}} {float(score)}'
+                    )
+    fetches = sum(
+        int(ring.mon_vals[t, MON_FETCHES]) for t in range(len(tenants))
+    )
     if fetches:
         lines.append("# TYPE mlops_tpu_monitor_fetches_total counter")
         lines.append(f"mlops_tpu_monitor_fetches_total {fetches}")
         lines.append("# TYPE mlops_tpu_monitor_batches_total counter")
-        lines.append(
-            f"mlops_tpu_monitor_batches_total {int(ring.mon_vals[MON_BATCHES])}"
-        )
-        age = time.monotonic() - float(ring.mon_vals[MON_FETCHED_AT])
-        lines.append("# TYPE mlops_tpu_monitor_fetch_age_seconds gauge")
-        lines.append(f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}")
+        for t, tenant in enumerate(tenants):
+            lines.append(
+                f'mlops_tpu_monitor_batches_total{{tenant="{tenant}"}} '
+                f"{int(ring.mon_vals[t, MON_BATCHES])}"
+            )
+        # The age is the OLDEST fetched tenant's (min over fetched
+        # rows): this gauge is the documented staleness ALARM, and a
+        # max would let any one healthy tenant's fresh fetch mask
+        # another tenant's stuck monitor indefinitely.
+        fetched = [
+            float(ring.mon_vals[t, MON_FETCHED_AT])
+            for t in range(len(tenants))
+            if float(ring.mon_vals[t, MON_FETCHED_AT]) > 0
+        ]
+        if fetched:
+            age = time.monotonic() - min(fetched)
+            lines.append(
+                "# TYPE mlops_tpu_monitor_fetch_age_seconds gauge"
+            )
+            lines.append(
+                f"mlops_tpu_monitor_fetch_age_seconds {age:.3f}"
+            )
     # Robustness counters, same series names as the single-process plane:
     # front-end dead-work sheds (per-worker single-writer cells) plus the
     # engine-side expired completions and degraded dispatches.
@@ -474,28 +636,36 @@ def render_ring_metrics(ring) -> str:
                 time.monotonic() - float(ring.shape_meta[0]),
             )
         )
-    if ring.life_vals[LIFE_HAS]:
+    for t, tenant in enumerate(tenants):
+        if not ring.life_vals[t, LIFE_HAS]:
+            continue
         # Lifecycle block, rebuilt as a snapshot dict so the SAME
         # formatter emits it (identical series names across planes; any
-        # front end renders the engine process's loop state from shm).
+        # front end renders the engine process's per-tenant loop state
+        # from shm).
         lines.extend(
             ServingMetrics.lifecycle_lines(
                 {
-                    "generation": int(ring.life_vals[LIFE_GENERATION]),
-                    "drift_triggers": int(ring.life_vals[LIFE_TRIGGERS]),
+                    "generation": int(ring.life_vals[t, LIFE_GENERATION]),
+                    "drift_triggers": int(ring.life_vals[t, LIFE_TRIGGERS]),
                     "shadow_auc_delta": (
-                        float(ring.life_vals[LIFE_AUC_DELTA])
-                        if ring.life_vals[LIFE_HAS_DELTA]
+                        float(ring.life_vals[t, LIFE_AUC_DELTA])
+                        if ring.life_vals[t, LIFE_HAS_DELTA]
                         else None
                     ),
                     "promotions": {
-                        outcome: int(ring.life_promos[i])
+                        outcome: int(ring.life_promos[t, i])
                         for i, outcome in enumerate(LIFE_OUTCOMES)
                     },
-                    "reservoir_rows": int(ring.life_vals[LIFE_RESERVOIR]),
-                    "breaker_open": bool(ring.life_vals[LIFE_BREAKER_OPEN]),
-                    "breaker_trips": int(ring.life_vals[LIFE_BREAKER_TRIPS]),
-                }
+                    "reservoir_rows": int(ring.life_vals[t, LIFE_RESERVOIR]),
+                    "breaker_open": bool(
+                        ring.life_vals[t, LIFE_BREAKER_OPEN]
+                    ),
+                    "breaker_trips": int(
+                        ring.life_vals[t, LIFE_BREAKER_TRIPS]
+                    ),
+                },
+                tenant,
             )
         )
     return "\n".join(lines) + "\n"

@@ -80,6 +80,26 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                                 "malformed."
                             ),
                         },
+                        {
+                            "name": "x-tenant",
+                            "in": "header",
+                            "required": False,
+                            "schema": {
+                                "type": "string",
+                                "pattern": "^[A-Za-z0-9_-]{1,64}$",
+                            },
+                            "description": (
+                                "Tenant name on a multi-tenant plane "
+                                "(serve --tenants tenants.toml): routes "
+                                "the request to that tenant's bundle, "
+                                "bills its admission quota, and labels "
+                                "its metrics/span records. Absent/empty "
+                                "= the config-declared default tenant; "
+                                "an UNKNOWN name answers 404 (never "
+                                "silently billed to the default "
+                                "tenant)."
+                            ),
+                        },
                     ],
                     "requestBody": {
                         "required": True,
@@ -93,6 +113,15 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                             "content": {
                                 "application/json": {"schema": response_schema}
                             },
+                        },
+                        "404": {
+                            "description": (
+                                "Unknown tenant: the x-tenant header "
+                                "names no declared tenant. Answered "
+                                "before validation or any scoring work "
+                                "— nothing was billed to any tenant's "
+                                "quota or monitors."
+                            )
                         },
                         "422": {"description": "Request body failed validation"},
                         "413": {"description": "Batch exceeds the serving cap"},

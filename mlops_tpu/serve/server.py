@@ -107,14 +107,36 @@ class HttpServer(HttpProtocol):
     instead."""
 
     def __init__(
-        self, engine: InferenceEngine, config: ServeConfig, lifecycle=None
+        self,
+        engine: InferenceEngine,
+        config: ServeConfig,
+        lifecycle=None,
+        registry=None,
     ):
         super().__init__(config.validate())
         self.engine = engine
-        # Optional lifecycle controller (mlops_tpu/lifecycle/): owned and
-        # started by _serve; the server's only jobs are exposing its
+        # Tenant fleet (mlops_tpu/tenancy/): ``registry`` (a
+        # TenantRegistry) installs N engines behind the one HTTP plane —
+        # requests route by the ``x-tenant`` header through the shared
+        # shell's TenantRouter; each tenant gets its OWN micro-batcher
+        # (tenants never share a grouped dispatch: one group = one
+        # tenant's compiled program + params + monitor fold) over the
+        # ONE shared predict thread pool. None = the 1-tenant fleet
+        # around ``engine`` — the pre-tenancy server, bit-identically.
+        self.registry = registry
+        self.engines = list(registry.engines) if registry else [engine]
+        if registry is not None:
+            from mlops_tpu.tenancy import TenantRouter
+
+            self.engine = registry.default_engine
+            self.tenants = TenantRouter(
+                registry.names, registry.default_index
+            )
+        # Optional lifecycle controllers (mlops_tpu/lifecycle/): owned
+        # and started by _serve — one per tenant (a bare controller is
+        # the 1-tenant form); the server's only jobs are exposing their
         # gauges on /metrics scrapes and keeping zero coupling on the
-        # request path (the controller observes through the engine tee).
+        # request path (each controller observes through its engine tee).
         self.lifecycle = lifecycle
         # The request cap can never exceed the largest warmed bucket, or
         # steady-state traffic would hit exact-shape recompiles. Clamps
@@ -125,13 +147,14 @@ class HttpServer(HttpProtocol):
         # error) because the bound is the ENGINE's bucket grid, which the
         # config layer cannot see.
         self.max_batch = config.max_batch
-        if config.max_batch > engine.max_bucket:
+        max_bucket = min(eng.max_bucket for eng in self.engines)
+        if config.max_batch > max_bucket:
             logger.warning(
                 "serve.max_batch=%d exceeds largest warmup bucket %d; clamping",
                 config.max_batch,
-                engine.max_bucket,
+                max_bucket,
             )
-            self.max_batch = engine.max_bucket
+            self.max_batch = max_bucket
         self.metrics = ServingMetrics()
         max_workers = max(1, config.max_workers)
         # validate() guarantees dispatch bound + fetch ring (>= 1) + one
@@ -152,31 +175,55 @@ class HttpServer(HttpProtocol):
         # is why none of them carries a lock. Work crossing into the
         # executor goes through run_in_executor and returns via awaited
         # futures; keep it that way rather than adding locks here.
-        self._monitor_accumulating = bool(
-            getattr(engine, "monitor_accumulating", False)
-        )
+        self._accumulating = [
+            bool(getattr(eng, "monitor_accumulating", False))
+            for eng in self.engines
+        ]
+        self._monitor_accumulating = any(self._accumulating)
         self._monitor_requests = 0  # predicts since the last fetch
         self._monitor_task: asyncio.Task | None = None
         self._monitor_timer_task: asyncio.Task | None = None
-        self.batcher = MicroBatcher(
-            engine,
-            self._executor,
-            window_ms=config.batch_window_ms,
-            max_group=config.max_group,
-            max_inflight=max_inflight,
-            # Dispatch bound + fetch ring occupy separate executor threads;
-            # size the ring so their sum stays inside the pool WITH one
-            # thread of headroom for the solo fast path and the monitor
-            # fetch — max_inflight dispatches + max_inflight fetches could
-            # otherwise saturate a max_workers == 2*max_inflight pool.
-            fetch_inflight=min(
-                max_inflight, max(1, max_workers - max_inflight - 1)
-            ),
+        # One micro-batcher per tenant over the ONE shared executor:
+        # grouping is a per-tenant affair (each grouped dispatch threads
+        # one tenant's monitor accumulator through one tenant's compiled
+        # program). The inflight/fetch bounds are DIVIDED across the
+        # fleet: validate()'s pool-sizing invariant (dispatch bound +
+        # fetch ring + one thread of headroom fit max_workers) assumes
+        # the bounds describe the whole plane, so N batchers each
+        # keeping the full bounds would admit N*(inflight+fetch)
+        # executor tasks and queue dispatches inside the pool — exactly
+        # the saturation the sizing exists to prevent. The division is
+        # also the plane's fairness mechanism: each tenant's slice of
+        # the pool is its own, so a hot tenant's flood queues in ITS
+        # batcher while every other tenant's dispatch capacity stays
+        # reserved. Floors at 1 keep tiny fleets serving (a fleet
+        # larger than the pool can still oversubscribe — size
+        # max_workers to the tenant count). The 1-tenant fleet keeps
+        # the undivided bounds, exactly the pre-tenancy batcher.
+        fetch_inflight = min(
+            max_inflight, max(1, max_workers - max_inflight - 1)
         )
+        n_tenants = len(self.engines)
+        t_inflight = max(1, max_inflight // n_tenants)
+        t_fetch = max(1, fetch_inflight // n_tenants)
+        self.batchers = [
+            MicroBatcher(
+                eng,
+                self._executor,
+                window_ms=config.batch_window_ms,
+                max_group=config.max_group,
+                max_inflight=t_inflight,
+                fetch_inflight=t_fetch,
+            )
+            for eng in self.engines
+        ]
+        self.batcher = self.batchers[
+            registry.default_index if registry else 0
+        ]
 
     # ------------------------------------------------------------- routes
     def _ready(self) -> bool:
-        return bool(self.engine.ready)
+        return all(bool(eng.ready) for eng in self.engines)
 
     async def _metrics_endpoint(self):
         # Idle replicas scrape free: once a fetch has drained the
@@ -211,16 +258,21 @@ class HttpServer(HttpProtocol):
                     asyncio.shield(self._spawn_monitor_fetch()),
                     timeout=timeout,
                 )
-        if self.lifecycle is not None:
+        for tenant_label, controller in self._tenant_lifecycles():
             # Pure host-dict read (the controller's leaf lock, no device
-            # work): scrapes always render the loop's current state.
+            # work): scrapes always render each loop's current state.
             with contextlib.suppress(Exception):
-                self.metrics.set_lifecycle(self.lifecycle.metrics_snapshot())
+                self.metrics.set_lifecycle(
+                    controller.metrics_snapshot(), tenant=tenant_label
+                )
         # Robustness counters (host-side reads, no device work): degraded
-        # dispatches live on the engine (`_dispatch_padded`), deadline
+        # dispatches live on the engines (`_dispatch_padded`), deadline
         # sheds accumulate in the metrics object itself.
         self.metrics.set_degraded(
-            getattr(self.engine, "degraded_dispatch_total", 0)
+            sum(
+                getattr(eng, "degraded_dispatch_total", 0)
+                for eng in self.engines
+            )
         )
         if self.tracer is not None:
             self.metrics.set_trace_dropped(self.tracer.dropped)
@@ -248,17 +300,37 @@ class HttpServer(HttpProtocol):
         status, err = self._profiler.control(action)
         return profile_payload(status, action, self.config.profile_dir, err)
 
+    def _tenant_lifecycles(self):
+        """(tenant label, controller) pairs: a per-tenant list when the
+        fleet attached one, else the pre-tenancy single controller on the
+        default tenant label."""
+        lifecycle = self.lifecycle
+        if lifecycle is None:
+            return []
+        if isinstance(lifecycle, (list, tuple)):
+            return [
+                (self.tenants.names[t], controller)
+                for t, controller in enumerate(lifecycle)
+                if controller is not None
+            ]
+        return [(self.tenants.names[self.tenants.default_index], lifecycle)]
+
     async def _score(
         self,
         record_dicts: list[dict],
         request_id: str,
         deadline: float | None = None,
         span=None,
+        tenant: int = 0,
     ):
         """The single-process scoring hook under the shared `_predict`
         shell (serve/httpcore.py): micro-batcher -> engine, with the
         deadline and failure contracts. ``span`` (tracewire) rides into
-        the batcher/engine for the queue/encode/dispatch/fetch stamps."""
+        the batcher/engine for the queue/encode/dispatch/fetch stamps.
+        ``tenant`` (resolved from ``x-tenant`` by the shell) picks the
+        batcher+engine pair — tenants share the thread pool and the HTTP
+        plane, never a grouped dispatch."""
+        batcher = self.batchers[tenant]
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
@@ -277,9 +349,9 @@ class HttpServer(HttpProtocol):
             # Disarmed call shape unchanged (test stubs pin it): the span
             # kwarg only appears when tracing armed it.
             if span is None:
-                call = self.batcher.predict(record_dicts, deadline=deadline)
+                call = batcher.predict(record_dicts, deadline=deadline)
             else:
-                call = self.batcher.predict(
+                call = batcher.predict(
                     record_dicts, deadline=deadline, span=span
                 )
             if timeout is not None:
@@ -318,7 +390,7 @@ class HttpServer(HttpProtocol):
             if span is not None:
                 span.abandoned = True  # a grouped dispatch may outlive us
             return 500, {"detail": "prediction failed"}, "application/json"
-        if self._monitor_accumulating:
+        if self._accumulating[tenant]:
             # Monitor totals are folded ON DEVICE inside the fused predict
             # (monitor/state.py MonitorAccumulator) — the hot path only
             # counts requests toward the K-trigger; no per-response host
@@ -326,7 +398,9 @@ class HttpServer(HttpProtocol):
             self._monitor_requests += 1
             self._maybe_fetch_monitor()
         else:
-            self.metrics.observe_prediction(response)
+            self.metrics.observe_prediction(
+                response, tenant=self.tenants.names[tenant]
+            )
         return response
 
     # ------------------------------------------------- monitor telemetry
@@ -368,13 +442,37 @@ class HttpServer(HttpProtocol):
         self._spawn_monitor_fetch()
 
     async def _fetch_monitor(self) -> None:
-        """One aggregate read: device -> host -> metrics gauges."""
+        """One aggregate read per accumulating tenant: device -> host ->
+        that tenant's metrics gauges (sequential on the one executor
+        slot — the fetches stay single-flight as a set). Failures are
+        isolated PER TENANT (same discipline as the ring plane's
+        telemetry loop): one tenant's failing device read must not
+        freeze every later tenant's gauges."""
         loop = asyncio.get_running_loop()
         self._monitor_requests = 0
-        snapshot = await loop.run_in_executor(
-            self._executor, self.engine.monitor_snapshot
-        )
-        self.metrics.set_monitor_aggregate(snapshot)
+        failed = None
+        for t, eng in enumerate(self.engines):
+            if not self._accumulating[t]:
+                continue
+            try:
+                snapshot = await loop.run_in_executor(
+                    self._executor, eng.monitor_snapshot
+                )
+                self.metrics.set_monitor_aggregate(
+                    snapshot, tenant=self.tenants.names[t]
+                )
+            except Exception as err:  # tpulint: disable=TPU201
+                # Gauges keep their last values; the fetch-age gauge
+                # (min over tenants) surfaces the staleness.
+                logger.error(
+                    "monitor fetch failed for tenant %r",
+                    self.tenants.names[t], exc_info=True,
+                )
+                failed = err
+        if failed is not None and len(self.engines) == 1:
+            # Pre-tenancy contract: a single-tenant fetch failure still
+            # propagates to the task's done-callback log.
+            raise failed
 
     async def _monitor_timer(self) -> None:
         """T-second cadence floor for the aggregate gauges: bounds their
@@ -408,14 +506,20 @@ class HttpServer(HttpProtocol):
 
 
 async def _serve(
-    engine: InferenceEngine, config: ServeConfig, lifecycle=None, trace=None
+    engine: InferenceEngine,
+    config: ServeConfig,
+    lifecycle=None,
+    trace=None,
+    registry=None,
 ) -> None:
-    server = HttpServer(engine, config, lifecycle=lifecycle)
+    server = HttpServer(engine, config, lifecycle=lifecycle, registry=registry)
     tracer = None
     if trace is not None and trace.enabled:
         # tracewire (mlops_tpu/trace/): spans to <trace.dir>/spans.jsonl,
-        # shape histograms on the engine, both gated here — a disabled
-        # trace section leaves every hot path at its is-None check.
+        # shape histograms on the engine(s) — ONE shared ShapeStats
+        # across the tenant fleet, since entries key by compiled shape —
+        # both gated here; a disabled trace section leaves every hot
+        # path at its is-None check.
         from pathlib import Path
 
         from mlops_tpu.trace import ShapeStats, TraceRecorder
@@ -427,7 +531,9 @@ async def _serve(
             flush_interval_s=trace.flush_interval_s,
         )
         server.tracer = tracer
-        engine.set_shape_stats(ShapeStats())
+        stats = ShapeStats()
+        for eng in server.engines:
+            eng.set_shape_stats(stats)
         logger.info("tracewire armed; spans -> %s", tracer.path)
     srv = await server.start()
     logger.info(
@@ -442,20 +548,30 @@ async def _serve(
 
     async def _warm() -> None:
         try:
-            await loop.run_in_executor(None, engine.warmup)
-            # warmup_stats carries the AOT compile-cache evidence: wall
-            # time, program count, and hit/miss/bypass counts with
-            # per-program compile vs deserialize seconds (engine.py).
-            logger.info(
-                "warmup complete; ready %s",
-                _LazyJson(getattr(engine, "warmup_stats", {})),
-            )
+            if registry is not None:
+                # Fleet warmup with architecture-level executable dedupe
+                # (tenancy/registry.py): distinct architectures compile
+                # once; twins adopt the donor's exec table by reference.
+                report = await loop.run_in_executor(None, registry.warmup)
+                logger.info("warmup complete; ready %s", _LazyJson(report))
+            else:
+                await loop.run_in_executor(None, engine.warmup)
+                # warmup_stats carries the AOT compile-cache evidence:
+                # wall time, program count, and hit/miss/bypass counts
+                # with per-program compile vs deserialize seconds
+                # (engine.py).
+                logger.info(
+                    "warmup complete; ready %s",
+                    _LazyJson(getattr(engine, "warmup_stats", {})),
+                )
+            for _, controller in server._tenant_lifecycles():
+                # Start each loop only once the live exec tables are
+                # fully warmed: candidate shadow warm-sharing snapshots
+                # them, and a pre-warmup trigger would have nothing to
+                # mirror into.
+                controller.start()
             if lifecycle is not None:
-                # Start the loop only once the live exec table is fully
-                # warmed: candidate shadow warm-sharing snapshots it, and
-                # a pre-warmup trigger would have nothing to mirror into.
-                lifecycle.start()
-                logger.info("lifecycle controller started")
+                logger.info("lifecycle controller(s) started")
         # Compile failure/OOM: die loudly so the orchestrator restarts the
         # pod instead of a forever-503 zombie. Not swallowed — the error is
         # stored and re-raised by _serve after the server closes.
@@ -477,7 +593,8 @@ async def _serve(
     def _drain(signum, frame=None) -> None:
         logger.info("SIGTERM: draining (no new connections)")
         server.draining = True
-        engine.ready = False  # /healthz/ready -> 503
+        for eng in server.engines:
+            eng.ready = False  # /healthz/ready -> 503
         draining.set()
         srv.close()
         for w in list(server._connections - server._busy):
@@ -500,17 +617,18 @@ async def _serve(
     finally:
         srv.close()
         server.stop_telemetry()
-        if lifecycle is not None:
+        for _, controller in server._tenant_lifecycles():
             # Controller drain (joins its worker thread, detaches the
             # engine tee, snapshots the reservoir) happens in the
             # executor: stop() joins a thread, which must not block the
             # event loop mid-drain.
-            await loop.run_in_executor(None, lifecycle.stop)
+            await loop.run_in_executor(None, controller.stop)
         await warm_task
         if draining.is_set():
             # Warmup may have finished AFTER the drain flip and
             # re-advertised readiness; a draining pod is never ready.
-            engine.ready = False
+            for eng in server.engines:
+                eng.ready = False
             # Busy exchanges get a bounded window to write their
             # responses (serve.drain_deadline_s; the kubelet's
             # terminationGracePeriodSeconds is the hard stop); whatever
@@ -534,12 +652,24 @@ async def _serve(
 
 
 def serve_forever(
-    engine: InferenceEngine, config: ServeConfig, lifecycle=None, trace=None
+    engine: InferenceEngine,
+    config: ServeConfig,
+    lifecycle=None,
+    trace=None,
+    registry=None,
 ) -> None:
     """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`).
-    ``lifecycle`` is an optional `LifecycleController`: started once
-    warmup completes, drained on shutdown, gauges on /metrics. ``trace``
-    is the optional `TraceConfig` section: enabled, every /predict
-    request records a stage span to <trace.dir>/spans.jsonl and the
-    engine exports shape histograms (mlops_tpu/trace/)."""
-    asyncio.run(_serve(engine, config, lifecycle=lifecycle, trace=trace))
+    ``lifecycle`` is an optional `LifecycleController` (or a per-tenant
+    list of them): started once warmup completes, drained on shutdown,
+    gauges on /metrics. ``trace`` is the optional `TraceConfig` section:
+    enabled, every /predict request records a stage span to
+    <trace.dir>/spans.jsonl and the engine exports shape histograms
+    (mlops_tpu/trace/). ``registry`` (a `TenantRegistry`) serves N
+    tenants from this one plane; None = the 1-tenant fleet around
+    ``engine``."""
+    asyncio.run(
+        _serve(
+            engine, config, lifecycle=lifecycle, trace=trace,
+            registry=registry,
+        )
+    )

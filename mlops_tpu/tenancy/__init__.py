@@ -1,0 +1,38 @@
+"""Multi-tenant model multiplexing: one serve plane, many portfolios.
+
+The subsystem that turns the single-model serving plane into a fleet
+(ROADMAP item 5): a bundle registry serving N named tenants from one
+engine process — architecture-identical tenants share compiled entries
+(params-as-args), each tenant owns its params/monitor/lifecycle — with
+tenant-tagged routing, weighted max-min admission quotas, and a
+``tenant`` label on every per-tenant Prometheus series and trace span.
+
+Import discipline mirrors ``serve/``: `config`, `quota`, and `router`
+are jax-free (front-end processes import them); `registry` pulls the
+engine (jax) and is imported lazily here so ``from mlops_tpu.tenancy
+import TenantRouter`` stays backend-free.
+"""
+
+from mlops_tpu.tenancy.config import (  # noqa: F401
+    DEFAULT_TENANT,
+    TenancyConfig,
+    TenancyConfigError,
+    TenantSpec,
+    load_tenants_toml,
+    single_tenant_config,
+)
+from mlops_tpu.tenancy.quota import QuotaGovernor  # noqa: F401
+from mlops_tpu.tenancy.router import (  # noqa: F401
+    UNKNOWN_TENANT_LABEL,
+    TenantRouter,
+)
+
+_LAZY = {"TenantRegistry", "tenant_scoped_config"}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from mlops_tpu.tenancy import registry
+
+        return getattr(registry, name)
+    raise AttributeError(name)

@@ -1,0 +1,125 @@
+"""Per-tenant admission quota: weighted max-min fair, by construction.
+
+The fairness contract (ROADMAP item 5 / ML-fleet goodput, arXiv
+2502.06982): a hot tenant at 10x its share must shed 503s against ITS OWN
+quota while every cold tenant's capacity stays reachable. The policy is
+weighted max-min with RESERVED FLOORS over a fixed capacity ``C`` — one
+governor PER SLOT CLASS of a ring front end's partition (the classes are
+separate physical pools, so fairness must hold in each; a partition-wide
+governor would let a hot tenant monopolize the small large-slab pool
+while under its combined floor). The single-process plane enforces its
+share of the same contract STRUCTURALLY instead: each tenant's
+micro-batcher gets its own divided slice of the shared executor's
+dispatch/fetch bounds (serve/server.py), so a flood queues in the hot
+tenant's own batcher and never consumes another tenant's dispatch
+capacity — no governor, and therefore no quota-shed 503s, on that plane.
+
+- tenant ``i``'s fractional floor is ``C * w_i / sum(w)``; the HARD
+  guarantee is its integer part: admission up to ``int(floor_i)``
+  always succeeds while capacity physically exists;
+- every admission (floor or borrow alike) must leave capacity for
+  every OTHER tenant's unmet INTEGER floor — one rule, no fast path.
+  Slots are integral, so reserving the exact fractions would deadlock
+  small pools (two tenants over one large slab would each reserve 0.5
+  and neither could ever take it), while letting a tenant overshoot
+  its fractional floor unchecked would let ``C/ceil(floor)`` flooders
+  fill the pool and physically starve a cold tenant whose 1.6-slot
+  "reservation" was never actually held back. Integer reservations
+  give both properties: the fractional remainders are borrowable
+  slack, the integer floors are inviolable, and reservations re-arm
+  as holds release. Deterministic reserved shares were chosen over
+  work-conserving borrowing on purpose: admitted holds cannot be
+  evicted, so lending a silent tenant's floor to a flood would make
+  that tenant's burst latency depend on the flood's dispatch time —
+  the exact starvation coupling this governor exists to forbid.
+
+The 1-tenant fleet bypasses the governor entirely (``reserved_others``
+is vacuously zero and the callers skip construction), which is what
+makes the single-tenant degeneration exactly the pre-tenancy admission
+check.
+
+Concurrency (tpulint Layer 3): NO LOCKS — every governor instance is
+single-owner state: each ring front-end worker owns one governor per
+slot class, touched only from that worker's event loop (the same
+confinement as `RingClient`'s free lists). Keep it that way rather than
+adding locks here.
+"""
+
+from __future__ import annotations
+
+# Declared lock-free (tpulint Layer 3 + lockcheck): every instance is
+# single-owner, event-loop-confined state. An empty order makes the
+# sanitizer's "no locks" observation an asserted contract, not an
+# accident.
+TPULINT_LOCK_ORDER: dict[str, tuple[str, ...]] = {"QuotaGovernor": ()}
+
+
+class QuotaGovernor:
+    """Admission counters for one capacity pool (one slot class)."""
+
+    __slots__ = ("capacity", "floors", "_reserved", "used")
+
+    def __init__(self, capacity: int, weights: tuple[float, ...]) -> None:
+        if capacity < 1:
+            raise ValueError(f"quota capacity {capacity} must be >= 1")
+        if not weights or any(w <= 0 for w in weights):
+            raise ValueError(f"quota weights {weights} must all be > 0")
+        total = float(sum(weights))
+        self.capacity = int(capacity)
+        # Fractional floors are the exported shares; the RESERVED floors
+        # are their integer parts (slots are integral — see the module
+        # docstring for why neither rounding up nor reserving the exact
+        # fractions works on small pools).
+        self.floors = tuple(capacity * w / total for w in weights)
+        self._reserved = tuple(int(f) for f in self.floors)
+        self.used = [0] * len(weights)
+
+    @property
+    def total_used(self) -> int:
+        return sum(self.used)
+
+    def try_acquire(self, tenant: int) -> str:
+        """Admit one request for ``tenant``. Returns one of three
+        verdicts, because the caller's shed CONTRACT differs:
+
+        - ``"ok"``: admitted (the caller must `release` later);
+        - ``"quota"``: capacity physically exists but this tenant's
+          weighted max-min share does not cover it — the caller sheds
+          503 against the tenant's OWN quota and owns the per-tenant
+          rejection counter (one owner per event: the exported
+          mlops_tpu_tenant_quota_shed_total, never a duplicate here);
+        - ``"full"``: the pool is physically exhausted — NOT a quota
+          event (no per-tenant quota shed is counted): the caller falls
+          through to its physical-shed contract (the class/brownout 503
+          with its own counters and Retry-After semantics).
+
+        O(T)."""
+        used = self.used
+        total = sum(used)
+        if total >= self.capacity:
+            return "full"
+        # ONE admission rule, floor and borrow alike: idle capacity
+        # minus every OTHER tenant's unmet INTEGER reservation must
+        # cover this request. A floor fast-path that skipped this check
+        # would let flooders overshoot fractional floors by one slot
+        # each and fill the pool — a cold tenant's reservation only
+        # exists if every admission actually holds capacity back for
+        # it. For integral floors this is exactly "admission under the
+        # floor always succeeds"; an under-integer-floor tenant always
+        # passes by construction (its own unmet reservation is excluded
+        # and every admission preserved the others').
+        reserved_others = 0
+        for j, floor in enumerate(self._reserved):
+            if j != tenant and used[j] < floor:
+                reserved_others += floor - used[j]
+        if total + 1 + reserved_others <= self.capacity:
+            used[tenant] += 1
+            return "ok"
+        return "quota"
+
+    def release(self, tenant: int) -> None:
+        """Return one admitted request's capacity. Defensive floor at
+        zero: a release bug must clamp, never let a negative count
+        manufacture infinite quota."""
+        if self.used[tenant] > 0:
+            self.used[tenant] -= 1

@@ -1,0 +1,72 @@
+"""Tenant routing: the ``x-tenant`` header -> a tenant index, bounded.
+
+Jax-free (the HTTP front ends and the engine-free protocol layer both
+import it). The router is immutable after construction — no locks, safe
+to share across threads and to inherit across forks.
+"""
+
+from __future__ import annotations
+
+from mlops_tpu.tenancy.config import DEFAULT_TENANT, TenancyConfig
+
+# The catch-all Prometheus label for a request naming an unknown tenant
+# (the request itself answers 404): arbitrary header text must never
+# become an unbounded (and injectable) label value — the same closed-set
+# discipline as ServingMetrics.KNOWN_ROUTES.
+UNKNOWN_TENANT_LABEL = "<unknown>"
+
+# Declared lock-free (tpulint Layer 3 + lockcheck): immutable after
+# construction, shared across threads and inherited across forks.
+TPULINT_LOCK_ORDER: dict[str, tuple[str, ...]] = {"TenantRouter": ()}
+
+
+class TenantRouter:
+    """Name <-> index resolution for one plane's tenant fleet."""
+
+    __slots__ = ("names", "default_index", "_index")
+
+    def __init__(
+        self, names: tuple[str, ...], default_index: int = 0
+    ) -> None:
+        if not names:
+            names = (DEFAULT_TENANT,)
+        self.names = tuple(names)
+        self.default_index = int(default_index)
+        self._index = {name: i for i, name in enumerate(self.names)}
+
+    @classmethod
+    def from_config(cls, tenancy: TenancyConfig) -> "TenantRouter":
+        return cls(tenancy.names, tenancy.default_index)
+
+    def resolve(self, raw: str) -> int | None:
+        """Tenant index for a request's ``x-tenant`` header value; an
+        empty/absent header rides the config-declared default tenant;
+        an unknown name returns None (the caller answers 404 — routing a
+        stranger to the default tenant would silently bill one tenant's
+        quota and monitors for another's traffic)."""
+        if not raw:
+            return self.default_index
+        return self._index.get(raw)
+
+    def label(self, raw: str) -> str:
+        """The BOUNDED Prometheus/span label for a header value: the
+        tenant's declared name (the default tenant's for untagged
+        traffic) or the closed unknown marker."""
+        if not raw:
+            return self.names[self.default_index]
+        if raw in self._index:
+            return raw
+        return UNKNOWN_TENANT_LABEL
+
+    def bill_label(self, raw: str) -> str:
+        """The tenant name whose row a request's METRICS land on —
+        always a declared name. Strangers (404s) bill the default
+        tenant's row: the ring plane's shm counters have one fixed row
+        per declared tenant and nowhere else to put them, so the
+        single-process plane folds identically to keep every series
+        bit-compatible across planes (spans keep the distinct
+        `<unknown>` marker — they are records, not fixed-axis
+        counters)."""
+        if raw in self._index:
+            return raw
+        return self.names[self.default_index]

@@ -41,7 +41,7 @@ class Span:
     """Stamp accumulator for one traced request."""
 
     __slots__ = ("trace_id", "plane", "worker", "route", "rows", "entry",
-                 "t0", "stamps", "abandoned")
+                 "t0", "stamps", "abandoned", "tenant")
 
     def __init__(
         self,
@@ -50,11 +50,16 @@ class Span:
         worker: int = 0,
         route: str = "/predict",
         t0: float | None = None,
+        tenant: str = "default",
     ) -> None:
         self.trace_id = trace_id
         self.plane = plane
         self.worker = worker
         self.route = route
+        # Bounded tenant label (mlops_tpu/tenancy/router.py): rides every
+        # span record so trace-report can slice per tenant; "default" for
+        # untagged traffic keeps pre-tenancy reports parsing unchanged.
+        self.tenant = tenant
         self.rows = 0
         # Compiled-entry key ("bucket_8", "group_16x1") when the engine
         # told us which program served the request; None otherwise.
@@ -102,6 +107,7 @@ class Span:
             "plane": self.plane,
             "worker": self.worker,
             "route": self.route,
+            "tenant": self.tenant,
             "status": int(status),
             "rows": int(self.rows),
             "wall_ms": round((prev - self.t0) * 1e3, 4),

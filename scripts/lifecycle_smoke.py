@@ -193,8 +193,12 @@ def main() -> int:
         status, body = get(f"http://127.0.0.1:{port}/metrics", 30)
         text = body.decode()
         assert status == 200
-        assert metric_value(text, "mlops_tpu_bundle_generation") == 1.0
-        assert (metric_value(text, "mlops_tpu_drift_trigger_total") or 0) == 0
+        assert metric_value(
+            text, "mlops_tpu_bundle_generation", 'tenant="default"'
+        ) == 1.0
+        assert (metric_value(
+            text, "mlops_tpu_drift_trigger_total", 'tenant="default"'
+        ) or 0) == 0
 
         print("# lifecycle-smoke: injecting drifted traffic", flush=True)
         phase["drift"] = True
@@ -213,12 +217,17 @@ def main() -> int:
             print("\n".join(log_lines[-80:]))
             raise SystemExit(f"{name}{{{labels}}} never reached {minimum}")
 
-        wait_metric("mlops_tpu_drift_trigger_total", "", 1, 120)
+        wait_metric(
+            "mlops_tpu_drift_trigger_total", 'tenant="default"', 1, 120
+        )
         print("# lifecycle-smoke: auto-retrain fired", flush=True)
         wait_metric(
-            "mlops_tpu_promotions_total", 'outcome="promoted"', 1, 300
+            "mlops_tpu_promotions_total",
+            'tenant="default",outcome="promoted"', 1, 300
         )
-        generation = wait_metric("mlops_tpu_bundle_generation", "", 2, 60)
+        generation = wait_metric(
+            "mlops_tpu_bundle_generation", 'tenant="default"', 2, 60
+        )
         print(
             f"# lifecycle-smoke: hot swap landed (generation {generation:g})",
             flush=True,

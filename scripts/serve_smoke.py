@@ -161,7 +161,10 @@ def main() -> int:
         text = body.decode()
         assert status == 200
         for worker in (0, 1):
-            needle = f'mlops_tpu_ring_depth{{worker="{worker}",class="small"}}'
+            needle = (
+                f'mlops_tpu_ring_depth{{worker="{worker}",class="small",'
+                'tenant="default"}'
+            )
             assert needle in text, f"worker {worker} missing from /metrics"
         assert "mlops_tpu_requests_total" in text
         print("# serve-smoke: /metrics shows both workers", flush=True)

@@ -1,0 +1,340 @@
+"""CI live-server tenancy smoke: TWO tenants on one plane, drift ONE,
+assert per-tenant lifecycle ISOLATION with zero non-200s on the
+undrifted tenant.
+
+The end-to-end proof that multi-tenant multiplexing works as DEPLOYED
+(real CLI with ``--tenants``, real process, real HTTP with the
+``x-tenant`` header), not just under the in-process test harness:
+
+1. train a tiny bundle through the real CLI; tenant ``beta`` serves a
+   COPY of it (identical architecture — the fleet must log the
+   shared-compiled-entries adoption),
+2. write a tenants.toml (alpha default + beta) and launch
+   ``mlops-tpu serve --tenants`` single-process with
+   ``lifecycle.enabled=true`` and tight loop knobs — one lifecycle
+   controller PER TENANT on tenant-namespaced state dirs,
+3. hammer /predict for BOTH tenants from background threads, counting
+   every non-200 per tenant,
+4. phase 2: ALPHA's traffic turns DRIFTED (numerics x10) while beta's
+   stays normal; poll /metrics until
+   ``mlops_tpu_drift_trigger_total{tenant="alpha"}`` fires and
+   ``mlops_tpu_bundle_generation{tenant="alpha"}`` reaches 2 with a
+   promoted outcome,
+5. assert ISOLATION: beta's generation is still 1, beta's trigger count
+   is still 0, and beta saw ZERO non-200s across alpha's whole
+   trigger/retrain/shadow/swap window (alpha too — the swap is
+   zero-downtime per tenant),
+6. SIGTERM and assert a clean drain (exit 0, no leaked tasks).
+
+Run from the repo root: `python scripts/tenancy_smoke.py` (CI pins
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+
+
+def get(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def metric_value(text: str, name: str, labels: str = "") -> float | None:
+    pattern = (
+        re.escape(name + ("{" + labels + "}" if labels else ""))
+        + r" ([-0-9.e+]+)"
+    )
+    match = re.search(pattern, text)
+    return float(match.group(1)) if match else None
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="tenancy-smoke-")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    sys.path.insert(0, REPO)
+    from mlops_tpu.data import generate_synthetic, write_csv_columns
+    from mlops_tpu.schema import SCHEMA
+
+    columns, labels = generate_synthetic(1500, seed=3)
+    drifted = {k: list(v) for k, v in columns.items()}
+    for feat in SCHEMA.numeric:
+        drifted[feat.name] = [v * 10.0 for v in drifted[feat.name]]
+    labeled_csv = f"{tmp}/labeled.csv"
+    write_csv_columns(labeled_csv, drifted, labels)
+
+    def records(cols, n, offset=0):
+        names = [f.name for f in SCHEMA.categorical] + [
+            f.name for f in SCHEMA.numeric
+        ]
+        return [
+            {name: cols[name][offset + i] for name in names}
+            for i in range(n)
+        ]
+
+    normal_body = json.dumps(records(columns, 8)).encode()
+    drifted_body = json.dumps(records(drifted, 8, offset=16)).encode()
+
+    print("# tenancy-smoke: training tiny bundle", flush=True)
+    train = subprocess.run(
+        [
+            sys.executable, "-m", "mlops_tpu", "train",
+            "data.rows=3000",
+            "model.hidden_dims=32,32", "model.embed_dim=4",
+            "train.steps=100", "train.eval_every=100",
+            "train.batch_size=256",
+            f"registry.root={tmp}/registry", f"registry.run_root={tmp}/runs",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900,
+    )
+    if train.returncode != 0:
+        print(train.stdout[-2000:], train.stderr[-2000:], sep="\n")
+        raise SystemExit("train failed")
+    alpha_bundle = json.loads(train.stdout.strip().splitlines()[-1])["bundle"]
+    # Tenant beta: an architecture-identical copy — its own bundle ref,
+    # its own lifecycle, the incumbent's compiled entries (adopted).
+    beta_bundle = f"{tmp}/beta-bundle"
+    shutil.copytree(alpha_bundle, beta_bundle)
+
+    tenants_toml = f"{tmp}/tenants.toml"
+    with open(tenants_toml, "w") as f:
+        f.write(
+            'default_tenant = "alpha"\n'
+            "[[tenant]]\n"
+            'name = "alpha"\n'
+            f'bundle_dir = "{alpha_bundle}"\n'
+            "weight = 1.0\n"
+            "[[tenant]]\n"
+            'name = "beta"\n'
+            f'bundle_dir = "{beta_bundle}"\n'
+            "weight = 1.0\n"
+        )
+
+    port = free_port()
+    server = subprocess.Popen(
+        [
+            sys.executable, "-m", "mlops_tpu", "serve",
+            "--tenants", tenants_toml,
+            "serve.host=127.0.0.1", f"serve.port={port}",
+            "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
+            "serve.batch_window_ms=0",  # solo path: deterministic latency
+            "serve.monitor_fetch_every_s=0.5",
+            "lifecycle.enabled=true",
+            f"lifecycle.dir={tmp}/lifecycle",
+            f"lifecycle.labeled_path={labeled_csv}",
+            "lifecycle.retrain_steps=50",
+            "lifecycle.min_labeled_rows=500",
+            "lifecycle.min_window_rows=32",
+            "lifecycle.hysteresis_windows=2",
+            "lifecycle.cooldown_s=2",
+            "lifecycle.tick_s=0.25",
+            "lifecycle.mirror_fraction=1.0",
+            "lifecycle.shadow_min_mirrors=4",
+            "lifecycle.max_ece=0.3",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    log_lines: list[str] = []
+    pump = threading.Thread(
+        target=lambda: log_lines.extend(iter(server.stdout.readline, "")),
+        daemon=True,
+    )
+    pump.start()
+
+    counts = {"alpha": {"ok": 0, "bad": 0}, "beta": {"ok": 0, "bad": 0}}
+    bad_detail: list = []
+    phase = {"drift": False}
+    stop = threading.Event()
+
+    def hammer(tenant: str) -> None:
+        req_url = f"http://127.0.0.1:{port}/predict"
+        while not stop.is_set():
+            body = (
+                drifted_body
+                if tenant == "alpha" and phase["drift"]
+                else normal_body
+            )
+            req = urllib.request.Request(
+                req_url, data=body,
+                headers={
+                    "content-type": "application/json",
+                    "x-tenant": tenant,
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    status = resp.status
+                    resp.read()
+            except urllib.error.HTTPError as err:
+                status = err.code
+                err.read()
+            except (urllib.error.URLError, OSError) as err:
+                counts[tenant]["bad"] += 1
+                bad_detail.append((tenant, repr(err)))
+                continue
+            if status == 200:
+                counts[tenant]["ok"] += 1
+            else:
+                counts[tenant]["bad"] += 1
+                bad_detail.append((tenant, status))
+
+    try:
+        print("# tenancy-smoke: waiting for readiness", flush=True)
+        deadline = time.time() + 600
+        ready = False
+        while time.time() < deadline and not ready:
+            if server.poll() is not None:
+                print("\n".join(log_lines[-50:]))
+                raise SystemExit("server died before readiness")
+            try:
+                status, _ = get(f"http://127.0.0.1:{port}/healthz/ready", 5)
+                ready = status == 200
+            except (urllib.error.URLError, OSError, urllib.error.HTTPError):
+                pass
+            if not ready:
+                time.sleep(1.0)
+        if not ready:
+            raise SystemExit("server never became ready")
+        # Architecture-identical tenants share compiled entries: the
+        # registry logs the adoption at warmup.
+        assert any(
+            "shares compiled entries" in line for line in log_lines
+        ), "no shared-exec adoption logged for the twin tenants"
+        print("# tenancy-smoke: shared-exec adoption logged", flush=True)
+
+        clients = [
+            threading.Thread(target=hammer, args=(t,), daemon=True)
+            for t in ("alpha", "beta")
+        ]
+        for client in clients:
+            client.start()
+        time.sleep(2.0)  # phase 1: normal traffic on both tenants
+
+        status, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+        text = body.decode()
+        assert status == 200
+        for tenant in ("alpha", "beta"):
+            gen = metric_value(
+                text, "mlops_tpu_bundle_generation", f'tenant="{tenant}"'
+            )
+            assert gen == 1.0, (tenant, gen)
+            trig = metric_value(
+                text, "mlops_tpu_drift_trigger_total", f'tenant="{tenant}"'
+            )
+            assert (trig or 0) == 0, (tenant, trig)
+
+        print("# tenancy-smoke: drifting ALPHA's traffic only", flush=True)
+        phase["drift"] = True
+
+        def wait_metric(name: str, labels: str, minimum: float, budget: float):
+            deadline = time.time() + budget
+            while time.time() < deadline:
+                if server.poll() is not None:
+                    print("\n".join(log_lines[-80:]))
+                    raise SystemExit("server died mid-loop")
+                _, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+                value = metric_value(body.decode(), name, labels)
+                if value is not None and value >= minimum:
+                    return value
+                time.sleep(0.5)
+            print("\n".join(log_lines[-80:]))
+            raise SystemExit(f"{name}{{{labels}}} never reached {minimum}")
+
+        wait_metric(
+            "mlops_tpu_drift_trigger_total", 'tenant="alpha"', 1, 120
+        )
+        print("# tenancy-smoke: alpha auto-retrain fired", flush=True)
+        wait_metric(
+            "mlops_tpu_promotions_total",
+            'tenant="alpha",outcome="promoted"', 1, 300,
+        )
+        generation = wait_metric(
+            "mlops_tpu_bundle_generation", 'tenant="alpha"', 2, 60
+        )
+        print(
+            f"# tenancy-smoke: alpha hot swap landed (generation "
+            f"{generation:g})",
+            flush=True,
+        )
+        time.sleep(1.0)  # post-swap traffic on both tenants
+
+        # ISOLATION: beta's loop never moved while alpha's completed.
+        _, body = get(f"http://127.0.0.1:{port}/metrics", 30)
+        text = body.decode()
+        beta_gen = metric_value(
+            text, "mlops_tpu_bundle_generation", 'tenant="beta"'
+        )
+        assert beta_gen == 1.0, (
+            f"beta's bundle generation moved to {beta_gen} — per-tenant "
+            "lifecycle isolation broken"
+        )
+        beta_trig = metric_value(
+            text, "mlops_tpu_drift_trigger_total", 'tenant="beta"'
+        )
+        assert (beta_trig or 0) == 0, (
+            f"beta drift triggers {beta_trig} — alpha's drifted window "
+            "leaked into beta's monitor"
+        )
+
+        stop.set()
+        for client in clients:
+            client.join(timeout=60)
+        for tenant in ("alpha", "beta"):
+            assert counts[tenant]["ok"] > 0, (
+                f"{tenant} hammer never completed a request"
+            )
+        assert counts["beta"]["bad"] == 0, (
+            f"non-200s on the UNDRIFTED tenant: {counts['beta']['bad']} "
+            f"(first: {bad_detail[:5]})"
+        )
+        assert counts["alpha"]["bad"] == 0, (
+            f"non-200s on alpha during its own swap: "
+            f"{counts['alpha']['bad']} (first: {bad_detail[:5]})"
+        )
+        print(
+            f"# tenancy-smoke: alpha {counts['alpha']['ok']} / beta "
+            f"{counts['beta']['ok']} requests, zero non-200 on both "
+            "tenants across alpha's trigger/retrain/shadow/swap; draining",
+            flush=True,
+        )
+
+        server.send_signal(signal.SIGTERM)
+        rc = server.wait(timeout=90)
+        pump.join(timeout=10)
+        log = "\n".join(log_lines)
+        assert rc == 0, f"server exited {rc}"
+        assert "Task was destroyed" not in log, log[-2000:]
+        print("# tenancy-smoke: OK (clean drain)", flush=True)
+        return 0
+    finally:
+        stop.set()
+        if server.poll() is None:
+            server.kill()
+            server.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,16 @@ def test_shipped_config_files_load_and_are_consistent():
     files = sorted(root.glob("*.toml"))
     assert len(files) >= 3  # train_register, tune_architectures, long_context
     for path in files:
+        if path.name == "tenants.toml":
+            # The shipped tenant-fleet example is a tenants.toml document
+            # (mlops_tpu/tenancy/), not a Config: validate its OWN shape
+            # (bundle dirs are deployment-site paths, not checked here).
+            from mlops_tpu.tenancy import load_tenants_toml
+
+            fleet = load_tenants_toml(path).validate(check_bundles=False)
+            assert len(fleet.tenants) >= 2
+            assert fleet.default_tenant in fleet.names
+            continue
         config = load_config(path, env={})
         assert config.data.valid_fraction <= 0.5
         for spec in config.hpo.architectures:

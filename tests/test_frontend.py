@@ -442,8 +442,14 @@ def test_multiworker_metrics_show_every_worker_and_monitor_aggregate(
         text = body.decode()
     assert status == 200
     for worker in (0, 1):
-        assert f'mlops_tpu_ring_depth{{worker="{worker}",class="small"}}' in text
-        assert f'mlops_tpu_shed_total{{worker="{worker}",class="small"}}' in text
+        assert (
+            f'mlops_tpu_ring_depth{{worker="{worker}",class="small",'
+            'tenant="default"}' in text
+        )
+        assert (
+            f'mlops_tpu_shed_total{{worker="{worker}",class="small",'
+            'tenant="default"}' in text
+        )
     # request counters carry worker labels (at least one worker served)
     assert 'route="/predict",status="200",worker="' in text
     assert "mlops_tpu_rows_scored_total" in text
@@ -635,12 +641,12 @@ def test_respawned_client_counts_quarantined_slots_as_inflight():
         ring.worker_doorbells[0].ring(1)
         ring.worker_doorbells[0].drain()  # credit died with the worker
         client = RingClient(ring, 0)
-        assert int(ring.inflight[0, SMALL]) == 1
-        assert int(ring.inflight[0, LARGE]) == 0
+        assert int(ring.inflight[0, 0, SMALL]) == 1
+        assert int(ring.inflight[0, 0, LARGE]) == 0
         assert int(ring.parked[0]) == 0, "phantom parked gauge survived"
         assert client._credit == 1
         client.on_doorbell()
-        assert int(ring.inflight[0, SMALL]) == 0
+        assert int(ring.inflight[0, 0, SMALL]) == 0
         assert busy in client._free[SMALL]
     finally:
         ring.close()

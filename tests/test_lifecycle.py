@@ -511,12 +511,18 @@ def test_lifecycle_gauges_single_process_render():
         }
     )
     text = metrics.render()
-    assert "mlops_tpu_bundle_generation 3" in text
-    assert "mlops_tpu_drift_trigger_total 2" in text
-    assert "mlops_tpu_shadow_auc_delta 0.012300" in text
-    assert 'mlops_tpu_promotions_total{outcome="promoted"} 1' in text
-    assert 'mlops_tpu_promotions_total{outcome="rolled_back"} 0' in text
-    assert "mlops_tpu_lifecycle_reservoir_rows 77" in text
+    assert 'mlops_tpu_bundle_generation{tenant="default"} 3' in text
+    assert 'mlops_tpu_drift_trigger_total{tenant="default"} 2' in text
+    assert 'mlops_tpu_shadow_auc_delta{tenant="default"} 0.012300' in text
+    assert (
+        'mlops_tpu_promotions_total{tenant="default",outcome="promoted"} 1'
+        in text
+    )
+    assert (
+        'mlops_tpu_promotions_total{tenant="default",outcome="rolled_back"}'
+        " 0" in text
+    )
+    assert 'mlops_tpu_lifecycle_reservoir_rows{tenant="default"} 77' in text
 
 
 def test_lifecycle_gauges_ring_render():
@@ -537,12 +543,17 @@ def test_lifecycle_gauges_ring_render():
             }
         )
         text = render_ring_metrics(ring)
-        assert "mlops_tpu_bundle_generation 2" in text
-        assert "mlops_tpu_drift_trigger_total 1" in text
+        assert 'mlops_tpu_bundle_generation{tenant="default"} 2' in text
+        assert 'mlops_tpu_drift_trigger_total{tenant="default"} 1' in text
         # None delta: the series is withheld, not rendered as 0.
         assert "mlops_tpu_shadow_auc_delta" not in text
-        assert 'mlops_tpu_promotions_total{outcome="rolled_back"} 1' in text
-        assert "mlops_tpu_lifecycle_reservoir_rows 5" in text
+        assert (
+            'mlops_tpu_promotions_total{tenant="default",'
+            'outcome="rolled_back"} 1' in text
+        )
+        assert (
+            'mlops_tpu_lifecycle_reservoir_rows{tenant="default"} 5' in text
+        )
     finally:
         ring.close()
 
@@ -611,8 +622,11 @@ def test_circuit_breaker_opens_on_repeated_retrain_failures(lc, tmp_path):
         lines = "\n".join(
             ServingMetrics.lifecycle_lines(ctrl.metrics_snapshot())
         )
-        assert "mlops_tpu_lifecycle_breaker_open 1" in lines
-        assert "mlops_tpu_lifecycle_breaker_trips_total 1" in lines
+        assert 'mlops_tpu_lifecycle_breaker_open{tenant="default"} 1' in lines
+        assert (
+            'mlops_tpu_lifecycle_breaker_trips_total{tenant="default"} 1'
+            in lines
+        )
         # Past the cooldown the loop re-arms (half-open): the next breach
         # triggers again, and one more failure does NOT instantly re-trip
         # (the streak restarted at zero when the breaker opened).

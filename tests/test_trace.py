@@ -460,8 +460,14 @@ def test_latency_histogram_bucket_series_on_both_planes(
         assert http_exchange(port, "POST", "/predict", sample_request)[0] == 200
         _, _, body = http_exchange(port, "GET", "/metrics")
     text = body.decode()
-    assert 'mlops_tpu_request_latency_ms_bucket{le="0.5"}' in text
-    assert 'mlops_tpu_request_latency_ms_bucket{le="+Inf"}' in text
+    assert (
+        'mlops_tpu_request_latency_ms_bucket{le="0.5",tenant="default"}'
+        in text
+    )
+    assert (
+        'mlops_tpu_request_latency_ms_bucket{le="+Inf",tenant="default"}'
+        in text
+    )
     assert "mlops_tpu_request_latency_ms_sum" in text
     assert "mlops_tpu_request_latency_ms_count" in text
 
@@ -469,10 +475,22 @@ def test_latency_histogram_bucket_series_on_both_planes(
         assert http_exchange(port, "POST", "/predict", sample_request)[0] == 200
         _, _, body = http_exchange(port, "GET", "/metrics")
     text = body.decode()
-    assert 'mlops_tpu_request_latency_ms_bucket{le="0.5",worker="0"}' in text
-    assert 'mlops_tpu_request_latency_ms_bucket{le="+Inf",worker="1"}' in text
-    assert 'mlops_tpu_request_latency_ms_sum{worker="0"}' in text
-    assert 'mlops_tpu_request_latency_ms_count{worker="1"}' in text
+    assert (
+        'mlops_tpu_request_latency_ms_bucket{le="0.5",worker="0",'
+        'tenant="default"}' in text
+    )
+    assert (
+        'mlops_tpu_request_latency_ms_bucket{le="+Inf",worker="1",'
+        'tenant="default"}' in text
+    )
+    assert (
+        'mlops_tpu_request_latency_ms_sum{worker="0",tenant="default"}'
+        in text
+    )
+    assert (
+        'mlops_tpu_request_latency_ms_count{worker="1",tenant="default"}'
+        in text
+    )
 
 
 # ----------------------------------------------------------- trace-report
