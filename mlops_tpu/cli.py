@@ -87,12 +87,33 @@ def build_parser() -> argparse.ArgumentParser:
                 "instead), and every per-tenant series and span "
                 "carries a tenant label",
             )
+        if name == "serve":
+            p.add_argument(
+                "--replicas",
+                type=int,
+                default=None,
+                help="engine replica set (sugar for "
+                "serve.engine_replicas=E): E engine processes behind "
+                "the one shared-memory ring — front ends fan "
+                "descriptors out least-loaded with small-class "
+                "affinity, every replica warms from the same AOT "
+                "cache, and a kill -9 of one replica is a brownout of "
+                "1/E capacity (needs --workers >= 2)",
+            )
         if name == "trace-report":
             p.add_argument(
                 "--tenant",
                 default=None,
                 help="only aggregate spans whose tenant label matches "
                 "(multi-tenant planes stamp every span with its tenant)",
+            )
+            p.add_argument(
+                "--replica",
+                type=int,
+                default=None,
+                help="only aggregate spans served by this engine "
+                "replica (the ring plane stamps every span with the "
+                "router's choice; pre-replica spans count as 0)",
             )
     # `analyze` takes paths + flags, not config overrides: static analysis
     # must run identically with zero configuration (CI, pre-commit).

@@ -39,6 +39,14 @@ def run(args: argparse.Namespace) -> int:
     # `trace-report --tenant NAME` is sugar for `trace.tenant=NAME`.
     if getattr(args, "tenant", None):
         config.trace.tenant = args.tenant
+    # `trace-report --replica N` is sugar for `trace.replica=N` (the
+    # engine-replica slice, ISSUE 13).
+    if getattr(args, "replica", None) is not None:
+        config.trace.replica = args.replica
+    # `serve --replicas E` is sugar for `serve.engine_replicas=E` (the
+    # engine replica set, ISSUE 13).
+    if getattr(args, "replicas", None) is not None:
+        config.serve.engine_replicas = args.replicas
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
@@ -574,6 +582,7 @@ def _serve(config) -> int:
             enable_grouping=config.serve.batch_window_ms > 0,
             compile_cache=from_config(config),
             warmup_workers=config.cache.warmup_workers,
+            model_shards=config.serve.model_shards,
         )
         engine = registry.default_engine
     else:
@@ -589,6 +598,7 @@ def _serve(config) -> int:
             # seconds, not minutes.
             compile_cache=from_config(config),
             warmup_workers=config.cache.warmup_workers,
+            model_shards=config.serve.model_shards,
         )
     lifecycle = None
     if config.lifecycle.enabled:
@@ -754,6 +764,14 @@ def _trace_report(config) -> int:
         spans = [
             span for span in spans
             if span.get("tenant", "default") == config.trace.tenant
+        ]
+    if config.trace.replica >= 0:
+        # Per-replica slice (`--replica` / trace.replica): the ring
+        # plane stamps every span with the engine replica that served
+        # it (ISSUE 13); pre-replica spans count as replica 0.
+        spans = [
+            span for span in spans
+            if int(span.get("replica", 0)) == config.trace.replica
         ]
     report = stage_report(spans)
     print(format_report(report), file=sys.stderr)

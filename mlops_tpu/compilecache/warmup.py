@@ -87,6 +87,50 @@ def _acc_zeros():
     return jax.device_get(init_accumulator())
 
 
+def _serve_avals(variables, monitor, batch_shape, mesh, placement=None):
+    """The 7-arg serving signature's avals, optionally PLACEMENT-PINNED
+    (ISSUE 13): with a ('model',) mesh (``serve.model_shards``) the
+    param/monitor avals carry the engine's live committed shardings and
+    the accumulator/temperature/batch avals pin to full replication;
+    with a single-device ``placement`` (a replica's own device) every
+    aval pins there. AOT lowering then bakes the layout into the
+    artifact, and the cache key's mesh_shape/device_tag axes keep
+    differently-placed binaries apart."""
+    import jax
+
+    var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
+    acc_aval, temp_aval = _acc_aval(), _temp_aval()
+    batch_avals = _schema_avals(batch_shape)
+    if mesh is None and placement is None:
+        return (var_avals, mon_avals, acc_aval, temp_aval, *batch_avals)
+    from mlops_tpu.parallel.sharding import replicated_avals, sharded_avals
+
+    if mesh is not None:
+        return (
+            sharded_avals(variables),
+            sharded_avals(monitor),
+            replicated_avals(acc_aval, mesh),
+            replicated_avals(temp_aval, mesh),
+            *replicated_avals(batch_avals, mesh),
+        )
+
+    def pin(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=placement
+            ),
+            tree,
+        )
+
+    return (
+        sharded_avals(variables),  # committed leaves carry the placement
+        sharded_avals(monitor),
+        pin(acc_aval),
+        pin(temp_aval),
+        *pin(batch_avals),
+    )
+
+
 def serve_predict_jobs(
     model,
     model_config,
@@ -94,6 +138,9 @@ def serve_predict_jobs(
     monitor,
     buckets: tuple[int, ...],
     temperature: float = 1.0,
+    mesh=None,
+    placement=None,
+    device_tag: str = "",
 ) -> list[CacheJob]:
     """One job per warmup bucket of the PACKED serving predict (entry
     ``serve-predict-packed``: one flat f32 output buffer + the device
@@ -101,16 +148,25 @@ def serve_predict_jobs(
     `ops/predict.py make_packed_predict_base`). ``variables``/``monitor``
     may be concrete (the engine: jobs also execute once to pay
     first-dispatch allocation) or ShapeDtypeStruct trees (the warmup CLI:
-    compile+persist only)."""
+    compile+persist only). ``mesh`` (a ('model',) serve mesh) requires
+    CONCRETE committed trees — their live shardings become the lowered
+    layout and the cache key grows the mesh shape. ``placement``/
+    ``device_tag`` pin an engine replica's own device into the lowering
+    and the key (serve.engine_replicas on a shared-visibility host)."""
     import jax
     import numpy as np
 
     from mlops_tpu.ops.predict import _acc_donation, make_packed_predict_base
 
-    var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
     concrete = _is_concrete(variables)
-    config_hash = model_fingerprint(model_config)
+    if (mesh is not None or placement is not None) and not concrete:
+        raise ValueError(
+            "placed serve warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    config_hash = model_fingerprint(model_config) + device_tag
     donate = _acc_donation()
+    mesh_shape = tuple(mesh.devices.shape) if mesh is not None else None
     jobs = []
     for bucket in buckets:
         jobs.append(
@@ -122,11 +178,11 @@ def serve_predict_jobs(
                 jitted=jax.jit(
                     make_packed_predict_base(model), donate_argnums=donate
                 ),
-                abstract_args=(
-                    var_avals, mon_avals, _acc_aval(), _temp_aval(),
-                    *_schema_avals((bucket,)),
+                abstract_args=_serve_avals(
+                    variables, monitor, (bucket,), mesh, placement
                 ),
                 config_hash=config_hash,
+                mesh_shape=mesh_shape,
                 donated=bool(donate),
                 label=f"serve-predict-packed/b{bucket}",
                 meta={"bucket": bucket},
@@ -148,18 +204,27 @@ def serve_group_jobs(
     monitor,
     grid: list[tuple[int, int]],
     temperature: float = 1.0,
+    mesh=None,
+    placement=None,
+    device_tag: str = "",
 ) -> list[CacheJob]:
     """One job per (slots, rows) shape of the micro-batcher's PACKED
-    vmapped dispatch (entry ``serve-predict-group-packed``)."""
+    vmapped dispatch (entry ``serve-predict-group-packed``).
+    ``mesh``/``placement``/``device_tag``: see `serve_predict_jobs`."""
     import jax
     import numpy as np
 
     from mlops_tpu.ops.predict import _acc_donation, make_packed_grouped_base
 
-    var_avals, mon_avals = tree_avals(variables), tree_avals(monitor)
     concrete = _is_concrete(variables)
-    config_hash = model_fingerprint(model_config)
+    if (mesh is not None or placement is not None) and not concrete:
+        raise ValueError(
+            "placed serve warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    config_hash = model_fingerprint(model_config) + device_tag
     donate = _acc_donation()
+    mesh_shape = tuple(mesh.devices.shape) if mesh is not None else None
     jobs = []
     for slots, rows in grid:
         jobs.append(
@@ -168,11 +233,11 @@ def serve_group_jobs(
                 jitted=jax.jit(
                     make_packed_grouped_base(model), donate_argnums=donate
                 ),
-                abstract_args=(
-                    var_avals, mon_avals, _acc_aval(), _temp_aval(),
-                    *_schema_avals((slots, rows)),
+                abstract_args=_serve_avals(
+                    variables, monitor, (slots, rows), mesh, placement
                 ),
                 config_hash=config_hash,
+                mesh_shape=mesh_shape,
                 donated=bool(donate),
                 label=f"serve-predict-group-packed/g{slots}x{rows}",
                 meta={"slots": slots, "rows": rows},

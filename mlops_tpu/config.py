@@ -258,6 +258,29 @@ class ServeConfig:
     # re-attach on the deployment box (bench: engine_respawn_gap_ms);
     # too low hammers retries into the still-full parking lot, too high
     # parks well-behaved clients longer than the outage
+    engine_replicas: int = 1  # engine replica set (ISSUE 13,
+    # mlops_tpu/replicaset/): E engine PROCESSES behind one shm ring on
+    # the multi-worker plane — the front ends' ReplicaRouter fans
+    # descriptors out least-loaded with small-class affinity, every
+    # replica AOT-warms from the SAME compile cache (E deserializes, not
+    # E compiles), and a kill -9 of one replica is a brownout of 1/E
+    # capacity (its busy slots replay on the respawned incarnation while
+    # the router routes around the hole). 1 (default) = the single
+    # supervised engine child. Requires serve.workers >= 2 (the ring
+    # plane); size E to the device budget, not the worker count
+    # (docs/operations.md "Engine replica set")
+    replica_affinity_slack: int = 4  # how many slots of extra live depth
+    # the small-class sticky replica may carry before the router re-picks
+    # least-loaded: low values spread faster (less coalescing company),
+    # high values batch better (lumpier load) — see the runbook
+    model_shards: int = 1  # partition-rule model sharding (ISSUE 13,
+    # parallel/sharding.py match-style regex rules): >1 lays each
+    # engine's params out over a ('model',) mesh of that many devices —
+    # large families (moe experts, bert/ft_transformer projections)
+    # SHARD instead of replicating, and the compile-cache key carries
+    # the mesh shape so sharded and unsharded artifacts can never mix.
+    # Requires at least that many visible jax devices in the engine
+    # process
     tenants_path: str = ""  # multi-tenant fleet declaration
     # (mlops_tpu/tenancy/): a tenants.toml naming N tenants (name,
     # bundle_dir, quota weight, default tenant) served from ONE engine
@@ -336,6 +359,26 @@ class ServeConfig:
                     " must be > 0 (the brownout 503 contract promises a "
                     "positive respawn-ETA Retry-After)"
                 )
+        if self.engine_replicas < 1:
+            problems.append(
+                f"serve.engine_replicas={self.engine_replicas} must be "
+                ">= 1"
+            )
+        if self.engine_replicas > 1 and self.workers < 2:
+            problems.append(
+                f"serve.engine_replicas={self.engine_replicas} needs the "
+                "multi-worker ring plane (serve.workers >= 2): the "
+                "single-process server has no descriptor ring to fan out"
+            )
+        if self.replica_affinity_slack < 0:
+            problems.append(
+                f"serve.replica_affinity_slack={self.replica_affinity_slack}"
+                " must be >= 0"
+            )
+        if self.model_shards < 1:
+            problems.append(
+                f"serve.model_shards={self.model_shards} must be >= 1"
+            )
         if problems:
             raise ServeConfigError("; ".join(problems))
         return self
@@ -566,6 +609,10 @@ class TraceConfig:
     # only aggregate spans carrying this tenant label — multi-tenant
     # planes (mlops_tpu/tenancy/) stamp every span with its tenant;
     # pre-tenancy spans count as "default". Empty = all tenants
+    replica: int = -1  # `trace-report` filter (`--replica` flag sugar):
+    # only aggregate spans served by this engine replica (the ring
+    # plane stitches the router's choice into every span; pre-replica
+    # spans count as replica 0). -1 = all replicas
 
     def validate(self) -> "TraceConfig":
         problems: list[str] = []

@@ -35,6 +35,55 @@ PARAM_RULES: tuple[tuple[str, P], ...] = (
 )
 
 
+def serve_mesh(shards: int, offset: int = 0) -> Mesh:
+    """A ('model',)-only mesh over ``shards`` devices starting at
+    ``offset`` — the serving-side tensor/expert-parallel layout
+    (ISSUE 13 ``serve.model_shards``): params shard by `PARAM_RULES`,
+    activations and the monitor accumulator replicate, and XLA inserts
+    the psums the Megatron column/row cuts imply. No 'data' axis:
+    request fan-out is the ENGINE REPLICA SET's job (process-level DP)
+    — ``offset`` is how replica r takes ITS device slice
+    (``devices[r*S : (r+1)*S]``) when one process's visibility spans
+    the whole fleet's devices."""
+    import numpy as np
+
+    devices = jax.devices()
+    if offset + shards > len(devices):
+        raise ValueError(
+            f"serve.model_shards={shards} at device offset {offset} "
+            f"exceeds the {len(devices)} visible devices in this engine "
+            "process"
+        )
+    return Mesh(np.asarray(devices[offset : offset + shards]), ("model",))
+
+
+def sharded_avals(tree: Any) -> Any:
+    """Concrete COMMITTED pytree -> ShapeDtypeStruct pytree carrying each
+    leaf's live sharding: AOT warmup lowers against these so the cached
+    executable bakes the same layout the engine's resident state has —
+    a sharded engine deserializing an unsharded artifact (or vice versa)
+    is excluded by the cache key's mesh_shape axis before it could even
+    mismatch here."""
+
+    def aval(leaf):
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=leaf.sharding
+        )
+
+    return jax.tree_util.tree_map(aval, tree)
+
+
+def replicated_avals(tree: Any, mesh: Mesh) -> Any:
+    """Abstract pytree -> the same avals pinned to full replication over
+    ``mesh`` (batch inputs, the temperature scalar, the accumulator)."""
+    sharding = replicated(mesh)
+
+    def aval(leaf):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sharding)
+
+    return jax.tree_util.tree_map(aval, tree)
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Shard the leading (batch) axis over 'data'; trailing axes replicated."""
     return NamedSharding(mesh, P("data", *([None] * (ndim - 1))))

@@ -1,0 +1,89 @@
+"""ReplicaRouter: per-submit engine-replica choice for the ring plane.
+
+Policy (ISSUE 13): least-loaded by LIVE ring depth — the per-(worker,
+replica) ``rep_inflight`` gauge cells, summed per replica — with a
+deterministic lowest-index tie-break, plus PER-(TENANT, CLASS) AFFINITY
+on the coalescable small class: grouped coalescing only pays when
+concurrent batch-1 requests of one tenant land on the SAME replica's
+collector inside one pop window, so the small class sticks to its last
+choice until that replica dies, un-readies, or falls more than
+``affinity_slack`` slots behind the least-loaded candidate. The large
+(solo-dispatch) class has nothing to coalesce and always takes the
+least-loaded live replica.
+
+Dead replicas are routed AROUND (readiness words in shm, cleared by the
+supervisor at death): their busy slots replay on the respawned
+incarnation while fresh admissions spread over the survivors — a kill
+-9 of one replica is a brownout of 1/E capacity, never a wedge. When NO
+replica is ready (full outage, or first boot), the router still returns
+the least-loaded index so admissions PARK on a concrete queue and the
+first replica to attach replays/answers its share.
+
+Event-loop confined per front-end worker (one router per RingClient):
+the sticky map is plain worker-local state, and the only shared reads
+are single-cell gauge loads — no locks, declared below.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# tpulint Layer-3 manifest: lock-free by design — worker-local sticky
+# state plus torn-read-tolerant shm gauge loads (a stale depth read
+# costs one suboptimal routing choice, never correctness: every replica
+# answers every descriptor it is handed).
+TPULINT_LOCK_ORDER: dict[str, tuple[str, ...]] = {"ReplicaRouter": ()}
+
+# Slot classes (serve/wire.py geometry; serve/ipc.py SMALL/LARGE): class
+# 0 is the coalescable small class the affinity policy targets. Kept as
+# a local constant — this module must stay importable without serve.ipc
+# (which imports it back).
+_SMALL = 0
+
+
+class ReplicaRouter:
+    def __init__(self, ring: Any, affinity_slack: int = 4) -> None:
+        self._ring = ring
+        self._replicas = int(ring.replicas)
+        self._slack = max(0, int(affinity_slack))
+        # (tenant, class) -> sticky replica for the coalescable class.
+        self._sticky: dict[tuple[int, int], int] = {}
+
+    # ------------------------------------------------------------- signals
+    def depth(self, replica: int) -> int:
+        """Live ring depth of one replica: slots routed to it and not yet
+        released, summed over every front-end worker's gauge cell."""
+        return int(self._ring.rep_inflight[:, replica].sum())
+
+    def candidates(self) -> list[int]:
+        """Replicas eligible for fresh work: the READY set, or — full
+        outage / first boot, when nothing is ready — every replica (the
+        submit then parks on a concrete queue and the first replica to
+        attach answers it)."""
+        ready = [
+            r for r in range(self._replicas) if self._ring.rep_ready[r]
+        ]
+        return ready if ready else list(range(self._replicas))
+
+    # -------------------------------------------------------------- policy
+    def route(self, tenant: int, slot_class: int) -> int:
+        """The replica index for one submit. Deterministic given the
+        gauge state: equal depths break toward the LOWEST index, so unit
+        tests (and two workers observing the same state) agree."""
+        if self._replicas == 1:
+            return 0
+        candidates = self.candidates()
+        depths = {r: self.depth(r) for r in candidates}
+        least = min(candidates, key=lambda r: (depths[r], r))
+        if slot_class != _SMALL:
+            return least
+        key = (int(tenant), int(slot_class))
+        sticky = self._sticky.get(key)
+        if (
+            sticky is not None
+            and sticky in depths
+            and depths[sticky] <= depths[least] + self._slack
+        ):
+            return sticky
+        self._sticky[key] = least
+        return least

@@ -148,6 +148,8 @@ class InferenceEngine:
         enable_grouping: bool = True,
         compile_cache=None,
         warmup_workers: int = 0,
+        model_shards: int = 1,
+        device_index: int | None = None,
     ):
         self.bundle = bundle
         # Bundle turnover (mlops_tpu/lifecycle/): the generation counts
@@ -189,6 +191,21 @@ class InferenceEngine:
         # compile on demand — exactly the pre-cache behavior.
         self._exec: dict[tuple, Any] = {}
         temperature = bundle.temperature  # calibration (train/calibrate.py)
+        # Defaults shared by every flavor (the flax branch below builds
+        # the real mesh when model_shards > 1; sklearn has no device
+        # params to shard and ignores the knobs). ``device_index`` is
+        # the engine replica set's in-process placement (ISSUE 13):
+        # when one engine process's visibility spans the whole fleet's
+        # devices (a dev box, the forced-host-device sim), replica r
+        # pins its state to ITS device slice instead of everyone
+        # sharing device 0 — production multi-chip deployments scope
+        # visibility per process instead (each replica's device 0 IS
+        # its chip) and leave this None.
+        self.model_shards = max(1, int(model_shards))
+        self.device_index = device_index
+        self._mesh = None
+        self._replicated = None
+        self._placement = None
         if bundle.flavor == "sklearn":
             # CPU tree-ensemble floor: host classifier + device monitors.
             # No grouped path — trees run on host threads anyway (and no
@@ -201,13 +218,64 @@ class InferenceEngine:
             self._predict_group = None
             self._accumulate = False
         else:
-            # device_put ONCE: params/monitor/temperature are per-call
-            # ARGUMENTS of the cached programs — host numpy trees would
-            # re-pay the full host->device param transfer on every
-            # request; committed device arrays pass by reference.
-            self._variables = jax.device_put(bundle.variables)
-            self._monitor = jax.device_put(bundle.monitor)
-            self._temperature = jax.device_put(np.float32(temperature))
+            # Partition-rule model sharding (ISSUE 13,
+            # parallel/sharding.py): model_shards > 1 lays the params
+            # out over a ('model',) mesh via the same regex rules the
+            # TP train step uses — large families (moe experts,
+            # transformer projections) SHARD instead of replicating,
+            # while monitor/accumulator/temperature and the batch
+            # inputs replicate. The packed programs are unchanged: jit
+            # follows the committed shardings, and warmup bakes them
+            # into the AOT artifacts (keyed by mesh shape, so sharded
+            # and unsharded executables can never mix).
+            if self.model_shards > 1:
+                from mlops_tpu.parallel.sharding import (
+                    param_shardings,
+                    replicated,
+                    serve_mesh,
+                )
+
+                self._mesh = serve_mesh(
+                    self.model_shards, offset=device_index or 0
+                )
+                self._replicated = replicated(self._mesh)
+                self._variables = jax.device_put(
+                    bundle.variables,
+                    param_shardings(self._mesh, bundle.variables),
+                )
+                self._monitor = jax.device_put(
+                    bundle.monitor, self._replicated
+                )
+                self._temperature = jax.device_put(
+                    np.float32(temperature), self._replicated
+                )
+            elif device_index is not None:
+                # Unsharded but PINNED: the whole serving state lives on
+                # this replica's own device (committed placement — jit
+                # and the AOT artifacts follow it).
+                from jax.sharding import SingleDeviceSharding
+
+                self._placement = SingleDeviceSharding(
+                    jax.devices()[device_index]
+                )
+                self._variables = jax.device_put(
+                    bundle.variables, self._placement
+                )
+                self._monitor = jax.device_put(
+                    bundle.monitor, self._placement
+                )
+                self._temperature = jax.device_put(
+                    np.float32(temperature), self._placement
+                )
+            else:
+                # device_put ONCE: params/monitor/temperature are
+                # per-call ARGUMENTS of the cached programs — host numpy
+                # trees would re-pay the full host->device param
+                # transfer on every request; committed device arrays
+                # pass by reference.
+                self._variables = jax.device_put(bundle.variables)
+                self._monitor = jax.device_put(bundle.monitor)
+                self._temperature = jax.device_put(np.float32(temperature))
             # Base-form packed programs, jitted with the same 7-arg
             # convention as the AOT table entries — `_dispatch_fused`
             # AOT-lowers these for any shape warmup missed.
@@ -234,7 +302,7 @@ class InferenceEngine:
             from mlops_tpu.monitor.state import init_accumulator
 
             self._accumulate = True
-            self._acc = jax.device_put(init_accumulator())
+            self._acc = self._place_replicated(init_accumulator())
             self._acc_lock = threading.Lock()
             # Novel-shape compiles serialize here, never on _acc_lock: a
             # synchronous XLA compile under the accumulator lock would
@@ -260,6 +328,20 @@ class InferenceEngine:
             # failure — exported as mlops_tpu_degraded_dispatch_total.
             self._degraded = 0
         self.ready = False
+
+    def _place_replicated(self, tree: Any) -> Any:
+        """Device-put a host tree onto the engine's committed placement:
+        replicated over the serve mesh when sharding is on, this
+        replica's pinned device when one was assigned (every fresh
+        accumulator must land on the SAME device set as the committed
+        params, or the fused dispatch would mix committed device sets),
+        plain default-device placement otherwise."""
+        sharding = getattr(self, "_replicated", None) or getattr(
+            self, "_placement", None
+        )
+        if sharding is not None:
+            return jax.device_put(tree, sharding)
+        return jax.device_put(tree)
 
     @property
     def supports_grouping(self) -> bool:
@@ -323,6 +405,15 @@ class InferenceEngine:
         )
 
         bundle = self.bundle
+        # Replica placement rides into the AOT artifacts: lowered
+        # layouts follow the committed shardings, and a pinned/offset
+        # device assignment joins the CACHE KEY (device_tag) — an
+        # executable compiled for replica 0's device must never be
+        # deserialized against params committed to replica 1's.
+        device_tag = (
+            f"@dev{self.device_index}" if self.device_index is not None
+            else ""
+        )
         jobs = serve_predict_jobs(
             bundle.model,
             bundle.model_config,
@@ -330,6 +421,9 @@ class InferenceEngine:
             self._monitor,  # and the execute-once pass skips a transfer
             tuple(self.buckets),
             temperature=bundle.temperature,
+            mesh=self._mesh,  # sharded layouts bake into the artifacts
+            placement=self._placement,
+            device_tag=device_tag,
         )
         if self._predict_group is not None:
             grid = [
@@ -344,6 +438,9 @@ class InferenceEngine:
                 self._monitor,
                 grid,
                 temperature=bundle.temperature,
+                mesh=self._mesh,
+                placement=self._placement,
+                device_tag=device_tag,
             )
         for job, fn in run_jobs(
             jobs, cache=self.compile_cache, workers=self.warmup_workers
@@ -631,7 +728,7 @@ class InferenceEngine:
 
         with self._acc_lock:
             window = self._acc
-            self._acc = jax.device_put(init_accumulator())
+            self._acc = self._place_replicated(init_accumulator())
         try:
             host = jax.device_get(window)  # blocks OUTSIDE the dispatch lock
         except Exception:

@@ -165,7 +165,9 @@ class FrontendServer(HttpProtocol):
             if ring.tenants > 1
             else None
         )
-        self.client = RingClient(ring, worker_id)
+        self.client = RingClient(
+            ring, worker_id, affinity_slack=config.replica_affinity_slack
+        )
         self.metrics = ShmWorkerMetrics(
             ring, worker_id, default_tenant=default_index
         )
@@ -205,15 +207,30 @@ class FrontendServer(HttpProtocol):
     def _ready(self) -> bool:
         return self.ring.engine_ready and not self.draining
 
+    def _outage_stamped(self) -> bool:
+        """True when the supervisor has stamped at least one engine
+        replica's death AND no replica is ready — a real FULL outage
+        (every replica down), not a cold boot and not the partial-outage
+        brownout the router absorbs by routing around the hole."""
+        return not self.ring.engine_ready and bool(
+            (self.ring.eng_vals[:, ENG_DOWN_SINCE] > 0).any()
+        )
+
     def _respawn_retry_after(self) -> int:
-        """Retry-After seconds for a BROWNOUT 503 (engine down, parking
-        full): the configured respawn ETA minus how long the engine has
-        already been down — a well-behaved client's retry lands just
-        after the replacement's replay finishes, instead of hammering
-        into the same full parking lot. Never below 1 s (the header is
-        integer seconds, and 0 invites an immediate retry)."""
+        """Retry-After seconds for a BROWNOUT 503 (every engine replica
+        down, parking full): the configured respawn ETA minus how long
+        the outage has been running — a well-behaved client's retry
+        lands just after the first replacement's replay finishes,
+        instead of hammering into the same full parking lot. The outage
+        clock starts at the EARLIEST still-down replica's stamp (the
+        furthest-along respawn is what ends a full outage). Never below
+        1 s (the header is integer seconds, and 0 invites an immediate
+        retry)."""
         eta = self.config.engine_respawn_eta_s
-        down_since = float(self.ring.eng_vals[ENG_DOWN_SINCE])
+        stamps = [
+            float(v) for v in self.ring.eng_vals[:, ENG_DOWN_SINCE] if v > 0
+        ]
+        down_since = min(stamps) if stamps else 0.0
         remaining = eta - (time.monotonic() - down_since) if down_since else eta
         if remaining <= 0:
             # The ETA estimate is already blown (a respawn slower than
@@ -353,9 +370,7 @@ class FrontendServer(HttpProtocol):
             # separately — shed latency stays flat either way.
             self.client.count_shed(n, tenant)
             cls = "small" if n <= self.ring.small_rows else "large"
-            if not self.ring.engine_ready and (
-                float(self.ring.eng_vals[ENG_DOWN_SINCE]) > 0
-            ):
+            if self._outage_stamped():
                 # A real OUTAGE (the supervisor stamped the engine's
                 # death), not a cold boot: first-boot warmup can take
                 # minutes and its sheds must advertise the steady-state
@@ -429,9 +444,7 @@ class FrontendServer(HttpProtocol):
             # brownout shed above it requires a supervisor-stamped
             # OUTAGE, so routine first-boot warmup waits never read as
             # outage evidence on dashboards.
-            parked = not self.ring.engine_ready and (
-                float(self.ring.eng_vals[ENG_DOWN_SINCE]) > 0
-            )
+            parked = self._outage_stamped()
             if parked:
                 self.ring.parked[self.worker_id] += 1
             try:
@@ -507,6 +520,10 @@ class FrontendServer(HttpProtocol):
         span.stamp_at("engine_queue", jobstart)
         span.stamp_at("dispatch", dispatched)
         span.stamp_at("device_fetch", fetched)
+        # Which engine replica served (the router's choice, read from the
+        # slot tag inside the same ownership window): trace-report
+        # --replica slices per-replica latency pictures from this.
+        span.replica = int(self.ring.slot_replica[slot]) % self.ring.replicas
         kind, geom = int(stamps[4]), int(stamps[5])
         if kind == 1:
             span.entry = f"bucket_{geom}"
@@ -562,24 +579,32 @@ class FrontendServer(HttpProtocol):
         completion doorbell into the event loop."""
         sock = reuseport_socket(self.config.host, self.config.port)
         loop = asyncio.get_running_loop()
-        loop.add_reader(
-            self.ring.worker_doorbells[self.worker_id].fileno(),
-            self.client.on_doorbell,
-        )
-        # One unconditional kick: a respawned client may have seeded
-        # credit for completions whose doorbell the DEAD incarnation
-        # already drained — the eventfd sits at 0, so add_reader alone
-        # would never fire, and with every slot quarantined no new
-        # traffic could ring it either (permanent 503s). A spurious call
-        # is harmless (zero credit pops nothing).
-        loop.call_soon(self.client.on_doorbell)
+        for replica in range(self.ring.replicas):
+            # One reader per engine replica's completion doorbell: each
+            # (worker, replica) queue has its own counted-credit fence.
+            loop.add_reader(
+                self.ring.worker_doorbell(self.worker_id, replica).fileno(),
+                self.client.on_doorbell,
+                replica,
+            )
+            # One unconditional kick per replica: a respawned client may
+            # have seeded credit for completions whose doorbell the DEAD
+            # incarnation already drained — the eventfd sits at 0, so
+            # add_reader alone would never fire, and with every slot
+            # quarantined no new traffic could ring it either (permanent
+            # 503s). A spurious call is harmless (zero credit pops
+            # nothing).
+            loop.call_soon(self.client.on_doorbell, replica)
         return await asyncio.start_server(self.handle_connection, sock=sock)
 
     def stop_doorbell(self) -> None:
-        with contextlib.suppress(Exception):
-            asyncio.get_running_loop().remove_reader(
-                self.ring.worker_doorbells[self.worker_id].fileno()
-            )
+        for replica in range(self.ring.replicas):
+            with contextlib.suppress(Exception):
+                asyncio.get_running_loop().remove_reader(
+                    self.ring.worker_doorbell(
+                        self.worker_id, replica
+                    ).fileno()
+                )
 
 
 # --------------------------------------------------------------- children
@@ -706,19 +731,21 @@ def start_frontends(
     ]
 
 
-def _write_pid_files(engine_pid: int | None) -> None:
+def _write_pid_files(engine_pids: list[int | None]) -> None:
     """Operator convenience (ISSUE 11 satellite): pid files live under
     ``runs/`` (gitignored), never at the repo root — ``serve.pid`` is the
     supervisor (SIGTERM target for a drain), ``engine.pid`` the current
-    engine incarnation (SIGKILL target for a survivability drill).
-    Best-effort: a read-only working directory must not fail serving."""
+    engine incarnations ONE PID PER LINE, replica order (SIGKILL targets
+    for a survivability drill — line k is replica k). Best-effort: a
+    read-only working directory must not fail serving."""
     try:
         os.makedirs("runs", exist_ok=True)
         with open(os.path.join("runs", "serve.pid"), "w") as f:
             f.write(f"{os.getpid()}\n")
-        if engine_pid is not None:
+        pids = [pid for pid in engine_pids if pid is not None]
+        if pids:
             with open(os.path.join("runs", "engine.pid"), "w") as f:
-                f.write(f"{engine_pid}\n")
+                f.write("".join(f"{pid}\n" for pid in pids))
     except OSError:
         logger.warning(
             "could not write pid files under runs/", exc_info=True
@@ -731,6 +758,7 @@ def _engine_main(
     bundle_dir: str,
     trace: Any = None,
     tenancy: Any = None,
+    replica: int = 0,
 ) -> None:
     """Engine child process entry (forked from the jax-free supervisor —
     ring, doorbells, and locks arrive by inheritance; jax imports happen
@@ -758,6 +786,27 @@ def _engine_main(
 
     if tenancy is None:
         tenancy = single_tenant_config(bundle_dir)
+    # Per-replica device assignment (post-review fix): when THIS
+    # process's jax visibility spans enough devices for the whole fleet
+    # (a dev box, the forced-host-device sim — production multi-chip
+    # deployments scope visibility per process instead, making each
+    # replica's device 0 its own chip), replica r takes its own
+    # S-device slice so replicas actually occupy E·S devices instead of
+    # all stacking on device 0. The slice index rides into the AOT
+    # cache key (device_tag), so differently-placed artifacts never
+    # cross-load. With too few visible devices, replicas share the
+    # default device — still useful when dispatches are
+    # latency/transport-bound (the bench's simulated-device framing).
+    import jax
+
+    shards = serve_cfg.model_shards
+    device_index: int | None = None
+    if ring.replicas > 1 and jax.device_count() >= ring.replicas * shards:
+        device_index = replica * shards
+        logger.info(
+            "engine replica %d pinned to device slice [%d, %d)",
+            replica, device_index, device_index + shards,
+        )
     registry = TenantRegistry(
         tenancy,
         buckets=tuple(serve_cfg.warmup_batch_sizes),
@@ -765,6 +814,8 @@ def _engine_main(
         enable_grouping=serve_cfg.batch_window_ms > 0,
         compile_cache=from_config(config),
         warmup_workers=config.cache.warmup_workers,
+        model_shards=serve_cfg.model_shards,
+        device_index=device_index,
     )
     engines = registry.engines
     if trace is not None:
@@ -786,27 +837,32 @@ def _engine_main(
         monitor_fetch_every_s=serve_cfg.monitor_fetch_every_s,
         monitor_fetch_every_requests=serve_cfg.monitor_fetch_every_requests,
         engines=engines,
+        replica=replica,
     )
-    if serve_cfg.profile_dir:
+    if serve_cfg.profile_dir and replica == 0:
         # /debug/profile: front ends forward start/stop through the
-        # ring's control word to THIS process, which owns the device.
+        # ring's single control word, answered by the LEAD replica (one
+        # device trace at a time).
         from mlops_tpu.serve.server import JaxProfiler
 
         service.profiler = JaxProfiler(serve_cfg.profile_dir).control
     # Warmup -> re-attach (incarnation bump + busy-slot replay) -> serve:
-    # parked requests are re-answered by the replay BEFORE the ready
-    # flag flips, so "ready" means "the outage is fully healed".
+    # parked requests are re-answered by the replay BEFORE this
+    # replica's ready flag flips, so "ready" means "this replica's share
+    # of the outage is fully healed". Replicas warm from the SAME
+    # compile cache — replica 0's cold boot compiles, every sibling (and
+    # every respawn) deserializes.
     warm_report = registry.warmup()
     attach = service.reattach()
     service.start()
-    ring.set_ready(True)
-    ring.eng_vals[ENG_DOWN_SINCE] = 0.0
+    ring.set_ready(True, replica)
+    ring.eng_vals[replica, ENG_DOWN_SINCE] = 0.0
     logger.info("warmup complete; ready %s", _LazyJson(warm_report))
     logger.info(
-        "engine incarnation %d attached %s",
-        attach["incarnation"], _LazyJson(attach),
+        "engine replica %d incarnation %d attached %s",
+        replica, attach["incarnation"], _LazyJson(attach),
     )
-    if config.lifecycle.enabled:
+    if config.lifecycle.enabled and replica == 0:
         # The closed loops run ENGINE-SIDE (the only process with the
         # device, the exec tables, and the compile cache) — ONE
         # controller PER TENANT, each on a tenant-namespaced state dir,
@@ -874,14 +930,15 @@ def _spawn_engine(
     bundle_dir: str,
     trace: Any = None,
     tenancy: Any = None,
+    replica: int = 0,
 ) -> multiprocessing.Process:
-    """Fork the engine child from the (thread-free, jax-free) supervisor
-    — first boot and every respawn run the identical path."""
+    """Fork one engine replica child from the (thread-free, jax-free)
+    supervisor — first boot and every respawn run the identical path."""
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_engine_main,
-        args=(config, ring, bundle_dir, trace, tenancy),
-        name="mlops-tpu-engine",
+        args=(config, ring, bundle_dir, trace, tenancy, replica),
+        name=f"mlops-tpu-engine-{replica}",
     )
     proc.start()
     return proc
@@ -894,6 +951,12 @@ def _spawn_engine(
 # orchestrator restarts the pod instead of brownout-flapping forever.
 _ENGINE_STORM_DEATHS = 5
 _ENGINE_STORM_WINDOW_S = 60.0
+
+
+class _DrainNow(Exception):
+    """Internal control flow: a replica crash-loop verdict inside the
+    per-replica supervision loop must break out of BOTH loops into the
+    drain path (a bare ``break`` would only leave the replica scan)."""
 
 
 def serve_multi_worker(config: Config, bundle_dir: str) -> int:
@@ -943,6 +1006,21 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             raise SystemExit(str(err))
     else:
         tenancy = single_tenant_config(bundle_dir)
+    # Engine replica set (ISSUE 13): E supervised engine children behind
+    # one ring. The lifecycle loop is single-writer machinery (one
+    # controller hot-swaps ONE engine's bundle); running it against a
+    # replica fleet would promote replica 0 alone and silently serve
+    # mixed generations — refuse at startup until the fleet-wide
+    # promotion protocol (ROADMAP item 2's regrid/swap plane) lands.
+    replicas = serve_cfg.engine_replicas
+    if replicas > 1 and config.lifecycle.enabled:
+        raise SystemExit(
+            "serve.engine_replicas > 1 is incompatible with "
+            "lifecycle.enabled: the lifecycle controller hot-swaps one "
+            "engine process's bundle, and a replica fleet would serve "
+            "mixed generations — run E=1 with the lifecycle loop, or "
+            "the replica set without it"
+        )
     preprocess_paths: list[str] = []
     for spec in tenancy.tenants:
         path = str(Path(spec.bundle_dir) / "preprocess.npz")
@@ -975,6 +1053,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         slots_large=serve_cfg.ring_slots_large,
         large_rows=max_batch,
         tenant_names=tenancy.names,
+        replicas=replicas,
     )
     trace_cfg = getattr(config, "trace", None)
     if trace_cfg is not None and trace_cfg.enabled:
@@ -1003,14 +1082,28 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         os.getpid(), len(procs), [p.pid for p in procs],
         len(tenancy.tenants), list(tenancy.names),
     )
-    engine_proc = _spawn_engine(config, ring, bundle_dir, trace_cfg, tenancy)
+    # STAGGERED spawn (post-review fix): replica 0 boots FIRST and the
+    # siblings fork only once its ready word flips — on a cold cache
+    # every replica would otherwise compile the full warmup grid
+    # simultaneously (E× the multi-minute compile bill; the tmp+rename
+    # persist keeps it correct but wasteful). Replica 0 pays the
+    # compiles once, persists them, and the siblings deserialize — the
+    # "E deserializes, not E compiles" math, made true on cold boots
+    # too. (Per-device-pinned artifacts still compile per slice; the
+    # shared-device case — and every respawn — deserializes.)
+    engine_procs: list[multiprocessing.Process | None] = [
+        _spawn_engine(
+            config, ring, bundle_dir, trace_cfg, tenancy, replica=0
+        )
+    ] + [None] * (replicas - 1)
     logger.info(
         "serving %s on %s:%s with %d SO_REUSEPORT front ends "
         "(engine pid %s)",
         serve_cfg.service_name, child_cfg.host, child_cfg.port,
-        serve_cfg.workers, engine_proc.pid,
+        serve_cfg.workers, engine_procs[0].pid,
     )
-    _write_pid_files(engine_proc.pid)
+    logger.info("engine replica 0 started (pid %s)", engine_procs[0].pid)
+    _write_pid_files([p.pid if p else None for p in engine_procs])
 
     stopping = {"sigterm": False}
 
@@ -1020,12 +1113,17 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     signal.signal(signal.SIGTERM, _sigterm)
     signal.signal(signal.SIGINT, _sigterm)
 
-    engine_deaths: list[float] = []
+    # Per-replica crash-loop windows: replica k flapping must drain the
+    # pod exactly as the single engine did, and sibling deaths must not
+    # pool into one shared storm counter (two replicas each dying twice
+    # is two brownouts, not one crash loop).
+    engine_deaths: list[list[float]] = [[] for _ in range(replicas)]
     rc = 0
     try:
-        # ---- supervise: front ends respawn in-place; the engine
-        # respawns as a BROWNOUT (ready drops, requests park, the
-        # replacement re-attaches + replays) ----
+        # ---- supervise: front ends respawn in-place; an engine replica
+        # respawns as a 1/E BROWNOUT (its ready word drops, the router
+        # routes around it, its busy slots park and replay when the
+        # replacement re-attaches) ----
         while not stopping["sigterm"]:
             time.sleep(0.5)
             for i, proc in enumerate(procs):
@@ -1039,44 +1137,66 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
                 procs[i] = _respawn(
                     child_cfg, ring, preprocess_paths, i, trace_cfg, tenancy
                 )
-            if not engine_proc.is_alive() and not stopping["sigterm"]:
+            if engine_procs[-1] is None and ring.rep_ready[0]:
+                # Replica 0 is warm: its compiles are persisted, so the
+                # siblings' warmups deserialize — spawn the rest of the
+                # fleet now (the staggered cold-boot contract above).
+                for r in range(1, replicas):
+                    engine_procs[r] = _spawn_engine(
+                        config, ring, bundle_dir, trace_cfg, tenancy,
+                        replica=r,
+                    )
+                    logger.info(
+                        "engine replica %d started (pid %s)",
+                        r, engine_procs[r].pid,
+                    )
+                _write_pid_files([p.pid if p else None for p in engine_procs])
+            for r, engine_proc in enumerate(engine_procs):
+                if engine_proc is None:
+                    continue
+                if engine_proc.is_alive() or stopping["sigterm"]:
+                    continue
                 now = time.monotonic()
-                engine_deaths = [
-                    t for t in engine_deaths
+                engine_deaths[r] = [
+                    t for t in engine_deaths[r]
                     if now - t < _ENGINE_STORM_WINDOW_S
                 ] + [now]
-                if len(engine_deaths) > _ENGINE_STORM_DEATHS:
+                if len(engine_deaths[r]) > _ENGINE_STORM_DEATHS:
                     logger.error(
-                        "engine died %d times inside %.0f s — crash "
-                        "loop, not a blip; draining for an orchestrator "
-                        "restart",
-                        len(engine_deaths), _ENGINE_STORM_WINDOW_S,
+                        "engine replica %d died %d times inside %.0f s "
+                        "— crash loop, not a blip; draining for an "
+                        "orchestrator restart",
+                        r, len(engine_deaths[r]), _ENGINE_STORM_WINDOW_S,
                     )
                     rc = 1
-                    break
+                    raise _DrainNow
                 logger.error(
-                    "engine process (pid %s) died with exit code %s; "
+                    "engine replica %d (pid %s) died with exit code %s; "
                     "respawning",
-                    engine_proc.pid, engine_proc.exitcode,
+                    r, engine_proc.pid, engine_proc.exitcode,
                 )
-                # Brownout begins: readiness drops (new admissions park
-                # until the partition fills, then shed 503 with the
-                # respawn ETA), the supervisor stamps the outage start
-                # for the Retry-After math and counts the respawn.
-                ring.set_ready(False)
-                ring.eng_vals[ENG_DOWN_SINCE] = now
-                ring.eng_vals[ENG_RESPAWNS] += 1
-                engine_proc = _spawn_engine(
-                    config, ring, bundle_dir, trace_cfg, tenancy
+                # Brownout begins for THIS replica: its ready word drops
+                # (the router routes fresh admissions around it; only a
+                # full outage parks), the supervisor stamps the outage
+                # start for the Retry-After math and counts the respawn
+                # in the replica's own row.
+                ring.set_ready(False, r)
+                ring.eng_vals[r, ENG_DOWN_SINCE] = now
+                ring.eng_vals[r, ENG_RESPAWNS] += 1
+                engine_procs[r] = _spawn_engine(
+                    config, ring, bundle_dir, trace_cfg, tenancy, replica=r
                 )
                 logger.info(
-                    "engine process started (pid %s)", engine_proc.pid
+                    "engine replica %d started (pid %s)",
+                    r, engine_procs[r].pid,
                 )
-                _write_pid_files(engine_proc.pid)
+                _write_pid_files([p.pid if p else None for p in engine_procs])
+        return rc
+    except _DrainNow:
         return rc
     finally:
         # ---- graceful drain: front ends FIRST (their in-flight slots
-        # need a live engine to land), then the engine ----
+        # need live engines to land), then the engine replicas ----
         ring.set_draining()
         ring.set_ready(False)
         for proc in procs:
@@ -1094,16 +1214,24 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             if proc.is_alive():  # pragma: no cover - stuck child
                 proc.kill()
                 proc.join(timeout=5)
-        if engine_proc.is_alive() and engine_proc.pid:
-            with contextlib.suppress(ProcessLookupError):
-                os.kill(engine_proc.pid, signal.SIGTERM)
-        # The engine drains its ring service (final monitor write,
-        # in-flight jobs) on SIGTERM; serve.engine_zygote_join_s bounds
-        # the wait before SIGKILL escalation.
-        engine_proc.join(timeout=serve_cfg.engine_zygote_join_s)
-        if engine_proc.is_alive():  # pragma: no cover - stuck engine
-            engine_proc.kill()
-            engine_proc.join(timeout=5)
+        live_engines = [p for p in engine_procs if p is not None]
+        for engine_proc in live_engines:
+            if engine_proc.is_alive() and engine_proc.pid:
+                with contextlib.suppress(ProcessLookupError):
+                    os.kill(engine_proc.pid, signal.SIGTERM)
+        # The engines drain their ring services (final monitor write,
+        # in-flight jobs) on SIGTERM, concurrently; one shared
+        # serve.engine_zygote_join_s budget bounds the waits before
+        # SIGKILL escalation.
+        deadline = time.monotonic() + serve_cfg.engine_zygote_join_s
+        for engine_proc in live_engines:
+            engine_proc.join(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+        for engine_proc in live_engines:
+            if engine_proc.is_alive():  # pragma: no cover - stuck engine
+                engine_proc.kill()
+                engine_proc.join(timeout=5)
         placeholder.close()
         ring.close()
         logger.info("multi-worker plane drained; exiting")

@@ -1,13 +1,31 @@
-"""Zero-copy shared-memory IPC: N HTTP front ends -> ONE engine process.
+"""Zero-copy shared-memory IPC: N HTTP front ends -> E engine replicas.
 
 The multi-worker server plane's transport (ROADMAP item 1): front-end
 processes validate + encode requests and place the feature arrays
-directly into fixed-slot shared-memory slabs; the engine process scores
+directly into fixed-slot shared-memory slabs; an engine replica scores
 them (coalescing concurrent small requests into one grouped device
 dispatch, exactly like the in-process micro-batcher) and writes the raw
 response arrays back into the same slot. Only 8-byte descriptors cross a
 queue — the arrays never serialize, never copy through a pipe, and never
 touch a pickle.
+
+ENGINE REPLICA SET (ISSUE 13, mlops_tpu/replicaset/): the ring fans
+descriptors out across E engine REPLICA processes instead of exactly
+one. Every queue/doorbell/lock that an engine consumes or produces is
+PER REPLICA — replica r owns submission queue r (its own doorbell, its
+own credit), pushes completions into its own per-worker completion
+queues under its own completion lock, and mirrors its telemetry into
+its own row of every engine-written stats block. The front ends'
+`ReplicaRouter` (replicaset/router.py) picks the replica per submit:
+least-loaded by live ring depth, sticky per (tenant, class) for the
+coalescable small class so grouped batching keeps finding same-replica
+company. A kill -9 of replica k is therefore a brownout of 1/E
+capacity: only k's queues stall, the router routes new admissions
+around the hole, and k's respawned incarnation replays exactly the
+busy slots tagged ``slot_replica == k`` — no other replica ever blocks
+on k's locks, because no replica ever takes another replica's locks.
+``replicas=1`` (every pre-replica caller) is the degenerate fleet with
+identical layout semantics.
 
 Topology and ownership:
 
@@ -119,21 +137,27 @@ logger = logging.getLogger("mlops_tpu.serve")
 # both halves of tpulint Layer 3 (static: analysis/concurrency.py TPU401;
 # runtime: analysis/lockcheck.py in the perturbed stress tests).
 #
-# RequestRing._submit_lock and ._complete_lock are the two cross-process
-# locks (one per descriptor queue's head index). Beyond mutual exclusion
-# they order the producers' stores: plain numpy stores alone would only
-# be ordered under x86 TSO, and a weakly-ordered CPU (aarch64) could
-# otherwise observe a head bump before the slab bytes it advertises.
-# BOTH consumers are LOCK-FREE and credit-fenced instead
-# (`Doorbell.ring(count)` / credit-limited `pop_submissions` /
-# `pop_completions`): only front ends ever acquire ``_submit_lock`` and
-# only engine threads ever acquire ``_complete_lock``, so a kill -9 on
-# either side can never orphan the lock the OTHER side needs (ISSUE 11 —
-# engine death must be a brownout, not a wedge; the one residual case,
-# a dead engine's own ``_complete_lock``, is recovered by its serialized
-# successor in `recover_engine_locks`). Both locks are leaves — nothing
-# is ever acquired under them, and neither is held across slab writes,
-# doorbells, or blocking work.
+# RequestRing._submit_locks[r] and ._complete_locks[r] are the
+# cross-process locks (one per descriptor queue's head index, PER ENGINE
+# REPLICA r). Beyond mutual exclusion they order the producers' stores:
+# plain numpy stores alone would only be ordered under x86 TSO, and a
+# weakly-ordered CPU (aarch64) could otherwise observe a head bump
+# before the slab bytes it advertises. BOTH consumers are LOCK-FREE and
+# credit-fenced instead (`Doorbell.ring(count)` / credit-limited
+# `pop_submissions` / `pop_completions`): only front ends ever acquire
+# a ``_submit_locks`` entry and only replica r's engine threads ever
+# acquire ``_complete_locks[r]``, so a kill -9 on either side can never
+# orphan a lock any OTHER process needs (ISSUE 11/13 — engine-replica
+# death must be a 1/E brownout, not a wedge; the one residual case, a
+# dead replica's own completion lock, is recovered by its serialized
+# successor in `recover_engine_locks` — the supervisor runs at most one
+# incarnation of each replica, and no replica ever takes another
+# replica's lock). All queue locks are leaves — nothing is ever
+# acquired under them, and none is held across slab writes, doorbells,
+# or blocking work. (The per-replica lists are invisible to the static
+# TPU401 walk — subscripted locks have no lexical attribute name — so
+# the runtime sanitizer in tests/test_replicaset.py wraps each list
+# entry explicitly under the names declared here.)
 #
 # RingService: ``_inflight`` is the dispatch bound, acquired by the
 # collector thread and released by the pool thread that finishes the job
@@ -144,12 +168,14 @@ logger = logging.getLogger("mlops_tpu.serve")
 # a leaf, which the declared order permits.
 TPULINT_LOCK_ORDER = {
     # _profile_lock: serializes the /debug/profile claim-LEASE word's
-    # read-check-write only (front ends only — never the engine, never
+    # read-check-write only (front ends only — never an engine, never
     # the request hot path, never held across the ack poll: channel
     # ownership itself is the shm lease, which expires if its claimant
     # dies); a leaf like the queue locks (nothing is ever acquired under
     # it, and it is never taken while a queue lock is held).
-    "RequestRing": ("_submit_lock", "_complete_lock", "_profile_lock"),
+    # _submit_locks/_complete_locks are PER-REPLICA lists; every entry
+    # carries its list's name for order purposes (all leaves anyway).
+    "RequestRing": ("_submit_locks", "_complete_locks", "_profile_lock"),
     "RingService": ("_inflight", "_mon_lock"),
 }
 TPULINT_CROSS_METHOD_SEMAPHORES = {"RingService": ("_inflight",)}
@@ -256,13 +282,16 @@ class RequestRing:
     All multi-word data races are excluded by ownership (a slot belongs
     to exactly one side between claim and completion; stats blocks have
     one writer each); the descriptor queues use 8-byte aligned
-    head/tail counters. Submissions: producers and the consumer share
-    ``_submit_lock``, whose acquire/release pairing orders the slab
-    stores against the head bump on weakly-ordered CPUs. Completions:
-    producers (engine threads only) share ``_complete_lock``; the
-    consumer is lock-free and is fenced by the counted doorbell credit
-    instead (see `pop_completions`) — front ends never take this lock,
-    so front-end crashes can never orphan it.
+    head/tail counters, one queue per engine replica. Submissions:
+    producers share replica r's ``_submit_locks[r]``, whose
+    acquire/release pairing orders the slab stores against the head bump
+    on weakly-ordered CPUs. Completions: producers (replica r's engine
+    threads only) share ``_complete_locks[r]``; every consumer is
+    lock-free and is fenced by its queue's counted doorbell credit
+    instead (see `pop_completions`) — front ends never take a completion
+    lock and no replica takes a sibling's, so neither front-end crashes
+    nor sibling-replica crashes can ever orphan a lock this process
+    needs.
     """
 
     def __init__(
@@ -273,12 +302,20 @@ class RequestRing:
         large_rows: int,
         small_rows: int = GROUP_ROW_BUCKET,
         tenant_names: tuple[str, ...] = ("default",),
+        replicas: int = 1,
     ) -> None:
         if workers < 1:
             raise ValueError("workers must be >= 1")
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
         if not tenant_names:
             raise ValueError("tenant_names must name at least one tenant")
         self.workers = workers
+        # Engine replica set (ISSUE 13): replica INDEX is the position on
+        # every per-replica queue/stats axis, fixed for the plane's
+        # lifetime — the shm slot tag (``slot_replica``) names which
+        # replica owns a submitted slot's dispatch and replay.
+        self.replicas = R = replicas
         # Tenant fleet (mlops_tpu/tenancy/): tenant INDEX — the shm slot
         # tag, every per-tenant stats row — is the position in this tuple,
         # fixed for the plane's lifetime (the names themselves are plain
@@ -305,7 +342,8 @@ class RequestRing:
         )
 
         plan: list[tuple[str, np.dtype, tuple[int, ...]]] = [
-            # control flags: [0] engine_ready, [1] draining, [2] tracing
+            # control flags: [0] reserved (readiness moved to the
+            # per-replica rep_ready words), [1] draining, [2] tracing
             # armed (tracewire — gates every per-slot stamp store)
             ("ctl", np.dtype(np.uint64), (3,)),
             # /debug/profile control words (front end -> engine): [0] the
@@ -322,15 +360,30 @@ class RequestRing:
             # same micro-window residual-leak class as the slot busy
             # flag, vs an unbounded one if it spanned the ack poll).
             ("prof_claim", np.dtype(np.float64), (1,)),
-            # submission queue (MPSC: front ends -> engine collector)
-            ("sub_entries", np.dtype(np.uint64), (self.n_slots,)),
-            ("sub_head", np.dtype(np.uint64), (1,)),
-            ("sub_tail", np.dtype(np.uint64), (1,)),
-            # per-worker completion queues (engine -> one front end)
+            # Per-replica readiness flags (ISSUE 13): replica r's engine
+            # flips its own word at warm/attach; the supervisor clears it
+            # when r dies. Plane readiness = ANY replica ready (the
+            # router routes around the holes).
+            ("rep_ready", np.dtype(np.uint64), (R,)),
+            # Per-(worker, replica) live ring depth — slots this worker
+            # routed to replica r and has not released yet. Single writer
+            # (that worker's event loop, the inflight-cell discipline);
+            # the router sums a replica's column for its load signal.
+            ("rep_inflight", np.dtype(np.uint64), (workers, R)),
+            # submission queues, ONE PER REPLICA (MPSC: front ends ->
+            # replica r's collector)
+            ("sub_entries", np.dtype(np.uint64), (R, self.n_slots)),
+            ("sub_head", np.dtype(np.uint64), (R,)),
+            ("sub_tail", np.dtype(np.uint64), (R,)),
+            # per-(replica, worker) completion queues (replica r -> one
+            # front end). Capacity stays one worker's slot count: a slot
+            # completes on exactly the replica it was routed to, so even
+            # one replica holding every slot of a worker cannot overflow
+            # its row.
             ("comp_entries", np.dtype(np.uint64),
-             (workers, slots_small + slots_large)),
-            ("comp_head", np.dtype(np.uint64), (workers,)),
-            ("comp_tail", np.dtype(np.uint64), (workers,)),
+             (R, workers, slots_small + slots_large)),
+            ("comp_head", np.dtype(np.uint64), (R, workers)),
+            ("comp_tail", np.dtype(np.uint64), (R, workers)),
             # per-slot headers. slot_busy marks submitted-but-not-released
             # slots IN SHM so the state survives a front-end crash: a
             # respawned incarnation must quarantine those slots (the
@@ -346,6 +399,13 @@ class RequestRing:
             # — the tag survives both front-end and engine crashes
             # because it lives in shm with the busy flag.
             ("slot_tenant", np.dtype(np.uint32), (self.n_slots,)),
+            # Replica index the router assigned the slot to (stamped by
+            # the front end at submit, BEFORE the busy flag): replica
+            # r's dispatch, completion, and — after a kill -9 — its
+            # respawned incarnation's replay all key off this tag, so a
+            # dead replica's busy slots are replayed by exactly its own
+            # successor and never double-answered by a sibling.
+            ("slot_replica", np.dtype(np.uint32), (self.n_slots,)),
             # Absolute request deadline (time.monotonic seconds — the same
             # CLOCK_MONOTONIC the front ends' event loops read, so values
             # compare across processes on one host; 0 = no deadline). The
@@ -424,35 +484,38 @@ class RequestRing:
             # fixed table so ANY front end renders the _bucket series on
             # a scrape. shape_meta[0] = the stats' armed-at monotonic
             # time (0 = tracing off), the useful_rows_per_s rate base.
-            ("shape_meta", np.dtype(np.float64), (1,)),
-            ("shape_keys", np.dtype(np.uint8), (TABLE_ROWS, TABLE_KEY_BYTES)),
-            ("shape_vals", np.dtype(np.float64), (TABLE_ROWS, TABLE_VALS)),
+            ("shape_meta", np.dtype(np.float64), (R,)),
+            ("shape_keys", np.dtype(np.uint8),
+             (R, TABLE_ROWS, TABLE_KEY_BYTES)),
+            ("shape_vals", np.dtype(np.float64), (R, TABLE_ROWS, TABLE_VALS)),
             # robustness counters with ENGINE-PROCESS writers (pool
             # threads under RingService._mon_lock): ROB_EXPIRED_ENGINE =
             # descriptors completed RESP_EXPIRED without a dispatch,
             # ROB_DEGRADED = the engine's degraded-dispatch total
             # (mirrored by the telemetry loop)
-            ("rob_vals", np.dtype(np.float64), (2,)),
-            # monitor aggregate, ONE ROW PER TENANT (single writer: the
-            # engine process — each tenant engine owns its own device
-            # accumulator and exact host totals, mirrored here per
-            # telemetry tick). mon_drift_sum carries the UNROUNDED
-            # cumulative sums so a respawned engine can seed each
-            # tenant's exact host totals (ISSUE 11) — reconstructing
-            # them from the rounded means would inject up to
-            # 5e-7 * batches of drift error per respawn.
-            ("mon_vals", np.dtype(np.float64), (T, 8)),
-            ("mon_drift_last", np.dtype(np.float64), (T, D)),
-            ("mon_drift_mean", np.dtype(np.float64), (T, D)),
-            ("mon_drift_sum", np.dtype(np.float64), (T, D)),
-            # engine-supervision block (ISSUE 11; serve/metrics.py ENG_*
-            # indices): incarnation, down-since stamp, respawn/replay/
-            # rows-lost counters, rows-dispatched telemetry baseline
-            # (the eng_vals ROWS_DISPATCHED cell keeps the fleet sum;
-            # eng_rows_tenant carries the per-tenant baselines the
-            # respawn's per-tenant rows-lost accounting differences).
-            ("eng_vals", np.dtype(np.float64), (6,)),
-            ("eng_rows_tenant", np.dtype(np.float64), (T,)),
+            ("rob_vals", np.dtype(np.float64), (R, 2)),
+            # monitor aggregate, ONE ROW PER (REPLICA, TENANT) — single
+            # writer: that replica's engine process (each tenant engine
+            # owns its own device accumulator and exact host totals,
+            # mirrored here per telemetry tick); the /metrics render
+            # FOLDS the replica axis into one per-tenant aggregate.
+            # mon_drift_sum carries the UNROUNDED cumulative sums so a
+            # respawned replica can seed each tenant's exact host totals
+            # (ISSUE 11) — and so the render's cross-replica drift mean
+            # is an exact weighted fold, not a mean of rounded means.
+            ("mon_vals", np.dtype(np.float64), (R, T, 8)),
+            ("mon_drift_last", np.dtype(np.float64), (R, T, D)),
+            ("mon_drift_mean", np.dtype(np.float64), (R, T, D)),
+            ("mon_drift_sum", np.dtype(np.float64), (R, T, D)),
+            # engine-supervision block, ONE ROW PER REPLICA (ISSUE 11/13;
+            # serve/metrics.py ENG_* indices): incarnation, down-since
+            # stamp, respawn/replay/rows-lost counters, rows-dispatched
+            # telemetry baseline (a row's ROWS_DISPATCHED cell keeps that
+            # replica's fleet-wide sum; eng_rows_tenant carries the
+            # per-tenant baselines its respawn's rows-lost accounting
+            # differences). One writer per cell, per row.
+            ("eng_vals", np.dtype(np.float64), (R, 6)),
+            ("eng_rows_tenant", np.dtype(np.float64), (R, T)),
             # lifecycle loop state, ONE ROW PER TENANT (single writer:
             # the engine process's per-tenant controller telemetry —
             # serve/metrics.py LIFE_* indices), so ANY front end renders
@@ -475,27 +538,58 @@ class RequestRing:
             ).reshape(shape)
             setattr(self, name, view)
 
-        # The two cross-process locks (one per descriptor queue); "fork"
-        # context — the whole plane is built on inheritance.
+        # The cross-process queue locks, PER REPLICA (one per descriptor
+        # queue's head index); "fork" context — the whole plane is built
+        # on inheritance. ``_submit_locks[r]`` is producers-only (front
+        # ends); ``_complete_locks[r]`` belongs to replica r's engine
+        # threads alone — so no process's death can orphan a lock any
+        # OTHER process needs.
         ctx = multiprocessing.get_context("fork")
-        self._submit_lock = ctx.Lock()
-        self._complete_lock = ctx.Lock()
+        self._submit_locks = [ctx.Lock() for _ in range(R)]
+        self._complete_locks = [ctx.Lock() for _ in range(R)]
         # Serializes updates to the profile claim-lease word (one
         # outstanding /debug/profile request at a time). Never taken by
-        # the engine, never on any request hot path, held only across
+        # an engine, never on any request hot path, held only across
         # the microsecond lease update (busy/orphaned -> 409) — so it
         # can neither wedge the plane nor order against the queue locks.
         self._profile_lock = ctx.Lock()
-        self.engine_doorbell = Doorbell()
-        self.worker_doorbells = [Doorbell() for _ in range(workers)]
+        self.engine_doorbells = [Doorbell() for _ in range(R)]
+        # Flat [worker * replicas + replica] so a 1-replica plane's
+        # ``worker_doorbells[w]`` stays exactly the pre-replica object
+        # (every existing caller and test indexes it that way).
+        self.worker_doorbells = [Doorbell() for _ in range(workers * R)]
+
+    # ---------------------------------------------------------- doorbells
+    @property
+    def engine_doorbell(self) -> Doorbell:
+        """Replica 0's submission doorbell — the pre-replica name."""
+        return self.engine_doorbells[0]
+
+    def worker_doorbell(self, worker: int, replica: int = 0) -> Doorbell:
+        """The doorbell replica ``replica`` rings for ``worker``'s
+        completions (one per pair: the counted credit is a per-queue
+        fence, and queues are per (replica, worker))."""
+        return self.worker_doorbells[worker * self.replicas + replica]
 
     # ------------------------------------------------------ control flags
     @property
     def engine_ready(self) -> bool:
-        return bool(self.ctl[0])
+        """ANY replica ready: the plane serves as long as one engine is
+        up (the router routes around the rest — a partial outage is a
+        capacity brownout, not unreadiness)."""
+        return bool(self.rep_ready.any())
 
-    def set_ready(self, ready: bool) -> None:
-        self.ctl[0] = 1 if ready else 0
+    def set_ready(self, ready: bool, replica: int | None = None) -> None:
+        """Flip one replica's readiness word (its engine at attach, the
+        supervisor at death), or — replica None, the pre-replica caller
+        shape — the whole fleet's."""
+        if replica is None:
+            self.rep_ready[:] = 1 if ready else 0
+        else:
+            self.rep_ready[replica] = 1 if ready else 0
+
+    def ready_replicas(self) -> list[int]:
+        return [r for r in range(self.replicas) if self.rep_ready[r]]
 
     @property
     def draining(self) -> bool:
@@ -550,115 +644,127 @@ class RequestRing:
         return resp[:n], resp[rows : rows + n], resp[2 * rows :]
 
     # ------------------------------------------------------- descriptors
-    def submit(self, slot: int, gen: int) -> None:
-        """Front-end side: enqueue a filled slot for the engine. The lock
-        (PRODUCERS only — the engine never takes it, so an engine kill -9
-        can never orphan it) guards the head bump; the doorbell rings
-        outside it and carries one unit of the consumer's credit."""
+    def submit(self, slot: int, gen: int, replica: int = 0) -> None:
+        """Front-end side: enqueue a filled slot for engine replica
+        ``replica``. The lock (PRODUCERS only — no engine ever takes it,
+        so an engine kill -9 can never orphan it) guards the head bump;
+        the doorbell rings outside it and carries one unit of the
+        consumer's credit."""
         entry = _pack(slot, gen)
-        with self._submit_lock:
-            head = int(self.sub_head[0])
-            self.sub_entries[head % self.n_slots] = entry
-            self.sub_head[0] = head + 1
-        self.engine_doorbell.ring()
+        with self._submit_locks[replica]:
+            head = int(self.sub_head[replica])
+            self.sub_entries[replica, head % self.n_slots] = entry
+            self.sub_head[replica] = head + 1
+        self.engine_doorbells[replica].ring()
 
     def pop_submissions(
-        self, limit: int | None = None
+        self, limit: int | None = None, replica: int = 0
     ) -> list[tuple[int, int]]:
-        """Engine side (single consumer): LOCK-FREE, the mirror of
-        `pop_completions` — the tail has one writer (this consumer) and
-        the consumer never touches the producers' lock, so a kill -9'd
-        engine cannot wedge front-end submits and a kill -9'd front end
-        cannot wedge the engine. Ordering safety comes from ``limit``:
-        the collector passes the credit accumulated from the counted
-        engine doorbell (seeded with the already-queued entry count at
-        attach — a dead incarnation takes drained credit to its grave);
-        entries beyond the credit wait for their ring."""
+        """Engine side (single consumer per replica, on its OWN queue):
+        LOCK-FREE, the mirror of `pop_completions` — the tail has one
+        writer (this consumer) and the consumer never touches the
+        producers' lock, so a kill -9'd replica cannot wedge front-end
+        submits and a kill -9'd front end cannot wedge any replica.
+        Ordering safety comes from ``limit``: the collector passes the
+        credit accumulated from its counted doorbell (seeded with the
+        already-queued entry count at attach — a dead incarnation takes
+        drained credit to its grave); entries beyond the credit wait for
+        their ring."""
         out: list[tuple[int, int]] = []
-        head = int(self.sub_head[0])
-        tail = int(self.sub_tail[0])
+        head = int(self.sub_head[replica])
+        tail = int(self.sub_tail[replica])
         if limit is not None:
             head = min(head, tail + limit)
         while tail < head:
-            out.append(_unpack(int(self.sub_entries[tail % self.n_slots])))
+            out.append(
+                _unpack(int(self.sub_entries[replica, tail % self.n_slots]))
+            )
             tail += 1
-        self.sub_tail[0] = tail
+        self.sub_tail[replica] = tail
         return out
 
-    def pending_submissions(self) -> set[int]:
-        """Slot ids with a descriptor currently queued (published, not yet
-        popped) — the re-attach replay scan excludes these: they reach the
-        new engine through the normal pop path. Lock-free snapshot (the
-        engine must never take the producers' lock); a submit racing the
-        scan lands either in this set or as a visible busy flag with its
-        doorbell credit still pending — both paths answer it exactly once
-        in the common case, and the worst-case race is one redundant
-        idempotent dispatch, never a lost or corrupt response."""
-        head = int(self.sub_head[0])
-        tail = int(self.sub_tail[0])
+    def pending_submissions(self, replica: int = 0) -> set[int]:
+        """Slot ids with a descriptor currently queued for ``replica``
+        (published, not yet popped) — the re-attach replay scan excludes
+        these: they reach the new engine through the normal pop path.
+        Lock-free snapshot (no engine may take the producers' lock); a
+        submit racing the scan lands either in this set or as a visible
+        busy flag with its doorbell credit still pending — both paths
+        answer it exactly once in the common case, and the worst-case
+        race is one redundant idempotent dispatch, never a lost or
+        corrupt response."""
+        head = int(self.sub_head[replica])
+        tail = int(self.sub_tail[replica])
         return {
-            _unpack(int(self.sub_entries[i % self.n_slots]))[0]
+            _unpack(int(self.sub_entries[replica, i % self.n_slots]))[0]
             for i in range(tail, head)
         }
 
-    def recover_engine_locks(self) -> None:
-        """Engine-side re-attach step (ISSUE 11): free ``_complete_lock``
-        if the dead incarnation was killed while holding it (pushing a
-        completion is microseconds of index arithmetic, but kill -9 has
-        no grace). Safe by serialization: only engine processes ever take
-        this lock, the supervisor runs at most one engine at a time, and
-        this runs before the new engine starts any pool thread — so a
-        failed non-blocking acquire can only mean an orphaned hold, and
-        releasing an unheld semaphore-backed mp.Lock just frees it."""
-        if self._complete_lock.acquire(block=False):
-            self._complete_lock.release()
+    def recover_engine_locks(self, replica: int = 0) -> None:
+        """Engine-side re-attach step (ISSUE 11): free replica
+        ``replica``'s completion lock if its dead incarnation was killed
+        while holding it (pushing a completion is microseconds of index
+        arithmetic, but kill -9 has no grace). Safe by serialization:
+        only replica r's engine incarnations ever take lock r, the
+        supervisor runs at most one incarnation of each replica at a
+        time, and this runs before the new engine starts any pool thread
+        — so a failed non-blocking acquire can only mean an orphaned
+        hold, and releasing an unheld semaphore-backed mp.Lock just
+        frees it. Sibling replicas' locks are untouched — their owners
+        are alive and a recovery here would corrupt THEIR exclusion."""
+        lock = self._complete_locks[replica]
+        if lock.acquire(block=False):
+            lock.release()
             return
         try:
-            self._complete_lock.release()
+            lock.release()
             logger.warning(
-                "recovered completion lock orphaned by a dead engine "
-                "incarnation"
+                "recovered completion lock orphaned by dead engine "
+                "replica %d", replica,
             )
         except ValueError:  # pragma: no cover - platform-dependent guard
             logger.exception("completion-lock recovery failed")
 
-    def push_completion(self, slot: int, gen: int) -> None:
-        """Engine side: hand a finished slot back to its owner. The lock
-        (acquired by ENGINE threads only — a crashed front end can never
-        orphan it and wedge the plane) serializes producing pool threads;
-        its acquisition order IS the queue order, so the counted doorbell
-        rung after a batch's last push fences every earlier-queued entry
-        too (the push of a later entry acquires the lock after the
-        earlier push released it). Capacity equals the worker's slot
-        count, so the queue can never overflow."""
+    def push_completion(self, slot: int, gen: int, replica: int = 0) -> None:
+        """Engine side: hand a finished slot back to its owner through
+        ``replica``'s own queue row. The lock (acquired by THAT replica's
+        engine threads only — neither a crashed front end nor a sibling
+        replica can orphan it and wedge this replica) serializes its
+        producing pool threads; its acquisition order IS the queue order,
+        so the counted doorbell rung after a batch's last push fences
+        every earlier-queued entry too. Per-row capacity equals the
+        worker's slot count, so no row can ever overflow."""
         worker = self.slot_owner(slot)
-        cap = self.comp_entries.shape[1]
-        with self._complete_lock:
-            head = int(self.comp_head[worker])
-            self.comp_entries[worker, head % cap] = _pack(slot, gen)
-            self.comp_head[worker] = head + 1
+        cap = self.comp_entries.shape[2]
+        with self._complete_locks[replica]:
+            head = int(self.comp_head[replica, worker])
+            self.comp_entries[replica, worker, head % cap] = _pack(slot, gen)
+            self.comp_head[replica, worker] = head + 1
 
     def pop_completions(
-        self, worker: int, limit: int | None = None
+        self, worker: int, limit: int | None = None, replica: int = 0
     ) -> list[tuple[int, int]]:
-        """Front-end side (single consumer per worker): LOCK-FREE — the
-        tail has one writer (this consumer) and the consumer never
-        touches a cross-process lock, so a kill -9'd front end cannot
-        wedge the ring. Ordering safety comes from ``limit``: callers
-        pass the credit accumulated from the counted doorbell, and an
-        entry is only consumed once a doorbell rung AFTER its publication
-        has been drained (the eventfd syscall pair is the fence). Entries
-        beyond the credit wait for their ring."""
+        """Front-end side (single consumer per (worker, replica) queue):
+        LOCK-FREE — the tail has one writer (this consumer) and the
+        consumer never touches a cross-process lock, so a kill -9'd
+        front end cannot wedge the ring. Ordering safety comes from
+        ``limit``: callers pass the credit accumulated from that PAIR's
+        counted doorbell, and an entry is only consumed once a doorbell
+        rung AFTER its publication has been drained (the eventfd syscall
+        pair is the fence). Entries beyond the credit wait for their
+        ring."""
         out: list[tuple[int, int]] = []
-        cap = self.comp_entries.shape[1]
-        head = int(self.comp_head[worker])
-        tail = int(self.comp_tail[worker])
+        cap = self.comp_entries.shape[2]
+        head = int(self.comp_head[replica, worker])
+        tail = int(self.comp_tail[replica, worker])
         if limit is not None:
             head = min(head, tail + limit)
         while tail < head:
-            out.append(_unpack(int(self.comp_entries[worker, tail % cap])))
+            out.append(
+                _unpack(int(self.comp_entries[replica, worker, tail % cap]))
+            )
             tail += 1
-        self.comp_tail[worker] = tail
+        self.comp_tail[replica, worker] = tail
         return out
 
     # ---------------------------------------------------- profile control
@@ -741,23 +847,24 @@ class RequestRing:
 
     # ----------------------------------------------------------- monitor
     def write_monitor(
-        self, snapshot: dict[str, Any], tenant: int = 0
+        self, snapshot: dict[str, Any], tenant: int = 0, replica: int = 0
     ) -> None:
-        """Engine-process single writer: install one tenant's
-        `monitor_snapshot` aggregate for the front ends' /metrics
-        renders. Field-at-a-time f64 stores are individually atomic; a
-        scrape racing this write can see a mid-update mix, which
-        Prometheus gauges tolerate (same contract as a scrape racing the
-        single-process fetch)."""
+        """Engine-process single writer (one row per (replica, tenant)):
+        install one tenant's `monitor_snapshot` aggregate for the front
+        ends' /metrics renders. Field-at-a-time f64 stores are
+        individually atomic; a scrape racing this write can see a
+        mid-update mix, which Prometheus gauges tolerate (same contract
+        as a scrape racing the single-process fetch)."""
         if not snapshot:
             return
-        self.mon_vals[tenant, MON_ROWS] = float(snapshot["rows"])
-        self.mon_vals[tenant, MON_OUTLIERS] = float(snapshot["outliers"])
-        self.mon_vals[tenant, MON_BATCHES] = float(snapshot["batches"])
-        self.mon_drift_last[tenant, :] = np.fromiter(
+        row = self.mon_vals[replica, tenant]
+        row[MON_ROWS] = float(snapshot["rows"])
+        row[MON_OUTLIERS] = float(snapshot["outliers"])
+        row[MON_BATCHES] = float(snapshot["batches"])
+        self.mon_drift_last[replica, tenant, :] = np.fromiter(
             snapshot["drift_last"].values(), np.float64, self.n_features
         )
-        self.mon_drift_mean[tenant, :] = np.fromiter(
+        self.mon_drift_mean[replica, tenant, :] = np.fromiter(
             snapshot["drift_mean"].values(), np.float64, self.n_features
         )
         # Unrounded cumulative sums (monitor_snapshot exports them for
@@ -765,10 +872,12 @@ class RequestRing:
         # engine restart never injects rounding error into the totals.
         drift_sum = snapshot.get("drift_sum")
         if drift_sum is not None:
-            self.mon_drift_sum[tenant, :] = np.asarray(drift_sum, np.float64)
-        self.mon_vals[tenant, MON_FETCHES] += 1
-        self.mon_vals[tenant, MON_FETCHED_AT] = time.monotonic()
-        self.mon_vals[tenant, MON_HAS] = 1.0
+            self.mon_drift_sum[replica, tenant, :] = np.asarray(
+                drift_sum, np.float64
+            )
+        row[MON_FETCHES] += 1
+        row[MON_FETCHED_AT] = time.monotonic()
+        row[MON_HAS] = 1.0
 
     def write_lifecycle(
         self, snapshot: dict[str, Any], tenant: int = 0
@@ -797,8 +906,7 @@ class RequestRing:
         row[LIFE_HAS] = 1.0
 
     def close(self) -> None:
-        self.engine_doorbell.close()
-        for bell in self.worker_doorbells:
+        for bell in (*self.engine_doorbells, *self.worker_doorbells):
             bell.close()
         # The mmap itself is left to the garbage collector / process exit:
         # numpy views pin the buffer, and the kernel reclaims the pages
@@ -857,9 +965,20 @@ class RingClient:
     inflight gauges) — the only shared mutations go through
     `RequestRing.submit` (locked) and the slabs (exclusively owned)."""
 
-    def __init__(self, ring: RequestRing, worker: int) -> None:
+    def __init__(
+        self, ring: RequestRing, worker: int, affinity_slack: int = 4
+    ) -> None:
+        from mlops_tpu.replicaset.router import ReplicaRouter
+
         self.ring = ring
         self.worker = worker
+        # Engine replica set (ISSUE 13): the per-submit replica choice —
+        # least-loaded by live ring depth, sticky per (tenant, class) on
+        # the coalescable small class (``affinity_slack`` =
+        # serve.replica_affinity_slack on the production plane).
+        # Event-loop confined like the free lists (its only
+        # cross-process reads are gauge cells).
+        self.router = ReplicaRouter(ring, affinity_slack=affinity_slack)
         small, large = ring.worker_slots(worker)
         # Restart-safe: generations AND the busy flags persist in shm. A
         # slot the DEAD incarnation submitted but never released
@@ -890,27 +1009,39 @@ class RingClient:
         # a worker crash. Quarantined slots keep their shm tenant tag, so
         # the per-tenant depth cells stay attributed correctly too.
         ring.inflight[worker, :, :] = 0
+        # Slots the dead incarnation had SUBMITTED keep counting toward
+        # their replica's live depth until the completion frees them —
+        # the router must keep seeing a crashed worker's in-flight load,
+        # or it would pile fresh traffic onto an already-occupied
+        # replica. Rebuilt from the shm replica tags, like the per-class
+        # gauge below.
+        self._routed: set[int] = set(self._quarantined)
+        ring.rep_inflight[worker, :] = 0
         for slot in self._quarantined:
             tenant = int(ring.slot_tenant[slot]) % ring.tenants
             ring.inflight[worker, tenant, ring.slot_class(slot)] += 1
+            replica = int(ring.slot_replica[slot]) % ring.replicas
+            ring.rep_inflight[worker, replica] += 1
         # The parked gauge's decrements lived in the dead incarnation's
         # event loop: any requests it had parked died with their
         # connections, so the respawned worker's cell restarts at zero —
         # otherwise a front-end crash during an engine outage would
         # report phantom parked requests for the life of the pod.
         ring.parked[worker] = 0
-        # Completion-consumption CREDIT (see pop_completions): normally
-        # accumulated from the counted doorbell; seeded here with the
-        # entries already queued, whose doorbell credit a dead
-        # incarnation may have drained and taken to its grave. A push
-        # racing this exact read could hand over a half-published entry —
-        # the gen/pending checks in on_doorbell drop it, costing at most
-        # one quarantined slot of capacity until the pod restarts (the
-        # same documented leak class as a crash between busy-flag and
-        # descriptor push), never a corrupt response.
-        self._credit = int(ring.comp_head[worker]) - int(
-            ring.comp_tail[worker]
-        )
+        # Completion-consumption CREDIT, one cell per replica queue (see
+        # pop_completions): normally accumulated from the counted
+        # doorbell; seeded here with the entries already queued, whose
+        # doorbell credit a dead incarnation may have drained and taken
+        # to its grave. A push racing this exact read could hand over a
+        # half-published entry — the gen/pending checks in on_doorbell
+        # drop it, costing at most one quarantined slot of capacity
+        # until the pod restarts (the same documented leak class as a
+        # crash between busy-flag and descriptor push), never a corrupt
+        # response.
+        self._credit = [
+            int(ring.comp_head[r, worker]) - int(ring.comp_tail[r, worker])
+            for r in range(ring.replicas)
+        ]
         # slot -> (generation, future). A future that died waiting (the
         # request deadline) leaves its entry as a ZOMBIE: the slot is NOT
         # reusable until the engine's completion arrives — reusing it
@@ -971,31 +1102,46 @@ class RingClient:
         cat: np.ndarray,
         num: np.ndarray,
         deadline: float | None = None,
+        replica: int | None = None,
     ):
-        """Write the encoded arrays into the slot's slab and enqueue it.
-        Returns the asyncio future the completion resolves (with the
-        engine's response status). ``deadline`` — absolute
-        ``time.monotonic`` seconds (the event loop's clock) — rides in
-        the slot header so the engine can complete an already-expired
-        descriptor as RESP_EXPIRED instead of dispatching dead work."""
+        """Write the encoded arrays into the slot's slab and enqueue it
+        on one engine replica's submission queue — ``replica`` None (the
+        default) lets the `ReplicaRouter` pick (least-loaded live depth,
+        small-class tenant affinity). Returns the asyncio future the
+        completion resolves (with the engine's response status).
+        ``deadline`` — absolute ``time.monotonic`` seconds (the event
+        loop's clock) — rides in the slot header so the engine can
+        complete an already-expired descriptor as RESP_EXPIRED instead
+        of dispatching dead work."""
         import asyncio
 
         n = cat.shape[0]
         ring = self.ring
+        if replica is None:
+            replica = self.router.route(
+                int(ring.slot_tenant[slot]), ring.slot_class(slot)
+            )
         slab_cat, slab_num = ring.request_views(slot)
         slab_cat[:n] = cat
         slab_num[:n] = num
         ring.slot_n[slot] = n
         ring.slot_deadline[slot] = deadline if deadline is not None else 0.0
+        # Replica tag BEFORE busy (which is BEFORE the descriptor push):
+        # whatever window this process dies in, the slot's owner replica
+        # is already named in shm, so the quarantine depth rebuild and
+        # the replica's replay both see a consistent tag.
+        ring.slot_replica[slot] = replica
         gen = (int(ring.slot_gen[slot]) + 1) & 0xFFFFFFFF
         ring.slot_gen[slot] = gen
         # Busy BEFORE the descriptor push: if this process dies anywhere
         # past here, the next incarnation quarantines the slot instead of
         # racing the engine for its slab.
         ring.slot_busy[slot] = 1
+        self._routed.add(slot)
+        ring.rep_inflight[self.worker, replica] += 1
         future = asyncio.get_running_loop().create_future()
         self._pending[slot] = (gen, future)
-        ring.submit(slot, gen)
+        ring.submit(slot, gen, replica)
         return future
 
     def release(self, slot: int) -> None:
@@ -1007,6 +1153,13 @@ class RingClient:
         tenant = int(self.ring.slot_tenant[slot]) % self.ring.tenants
         self._free[cls].append(slot)
         self.ring.inflight[self.worker, tenant, cls] -= 1
+        if slot in self._routed:
+            # Submitted slots counted toward their replica's live depth
+            # at submit; a claim released un-submitted (deadline before
+            # encode, error paths) never incremented it.
+            self._routed.discard(slot)
+            replica = int(self.ring.slot_replica[slot]) % self.ring.replicas
+            self.ring.rep_inflight[self.worker, replica] -= 1
 
     def abandon(self, slot: int) -> None:
         """Deadline/error path after a successful submit: if the response
@@ -1035,22 +1188,26 @@ class RingClient:
         return self.ring.response_views(slot)
 
     # -------------------------------------------------------- completions
-    def on_doorbell(self) -> None:
-        """Event-loop reader callback for this worker's doorbell: drain
-        completion descriptors, resolve live futures, release zombies,
-        and drain the quarantine (slots inherited busy from a crashed
-        incarnation — the engine answering them is the proof their slabs
-        are quiescent)."""
+    def on_doorbell(self, replica: int = 0) -> None:
+        """Event-loop reader callback for this worker's per-replica
+        doorbell (one registered fd per engine replica): drain that
+        replica's completion descriptors, resolve live futures, release
+        zombies, and drain the quarantine (slots inherited busy from a
+        crashed incarnation — the engine answering them is the proof
+        their slabs are quiescent)."""
         ring = self.ring
-        credit = self._credit + ring.worker_doorbells[self.worker].drain()
-        self._credit = 0
+        credit = self._credit[replica] + ring.worker_doorbell(
+            self.worker, replica
+        ).drain()
+        self._credit[replica] = 0
         # Any credit beyond what pops is SURPLUS, not a future
         # entitlement (entries are always published before their ring,
         # and a respawn's seeded credit can overlap the dead
         # incarnation's still-undrained doorbell) — discard it rather
         # than let a later consume run ahead of the fence; un-credited
         # entries always arrive with their own ring.
-        popped = ring.pop_completions(self.worker, limit=credit)
+        popped = ring.pop_completions(self.worker, limit=credit,
+                                      replica=replica)
         for slot, gen in popped:
             entry = self._pending.get(slot)
             if entry is None or entry[0] != gen:
@@ -1064,6 +1221,10 @@ class RingClient:
                     tenant = int(ring.slot_tenant[slot]) % ring.tenants
                     self._free[cls].append(slot)
                     ring.inflight[self.worker, tenant, cls] -= 1
+                    if slot in self._routed:
+                        self._routed.discard(slot)
+                        owner = int(ring.slot_replica[slot]) % ring.replicas
+                        ring.rep_inflight[self.worker, owner] -= 1
                 continue
             _, future = entry
             if future.cancelled():
@@ -1078,20 +1239,21 @@ class RingClient:
                 # requests sharing one slab). Drop the duplicate.
                 continue
             elif int(ring.resp_incarnation[slot]) != int(
-                ring.eng_vals[ENG_INCARNATION]
+                ring.eng_vals[replica, ENG_INCARNATION]
             ):
                 # Incarnation guard (ISSUE 11): this completion was
-                # produced by a DEAD engine incarnation (it may have died
-                # mid-batch; nothing about its leftovers is trusted).
-                # Leave the future pending — the respawned engine's
-                # replay re-answers this slot with a fresh completion, or
-                # the request's deadline budget turns it into a 504 and
-                # the zombie path reclaims the slot.
+                # produced by a DEAD incarnation of this replica (it may
+                # have died mid-batch; nothing about its leftovers is
+                # trusted). Leave the future pending — the respawned
+                # replica's replay re-answers this slot with a fresh
+                # completion, or the request's deadline budget turns it
+                # into a 504 and the zombie path reclaims the slot.
                 logger.info(
                     "dropping completion for slot %d from dead engine "
-                    "incarnation %d (current %d); replay will re-answer",
-                    slot, int(ring.resp_incarnation[slot]),
-                    int(ring.eng_vals[ENG_INCARNATION]),
+                    "replica %d incarnation %d (current %d); replay will "
+                    "re-answer",
+                    slot, replica, int(ring.resp_incarnation[slot]),
+                    int(ring.eng_vals[replica, ENG_INCARNATION]),
                 )
             elif int(ring.resp_gen[slot]) != gen:
                 # Descriptor/slab mismatch: the slab does not carry THIS
@@ -1134,10 +1296,22 @@ class RingService:
         monitor_fetch_every_s: float = 2.0,
         monitor_fetch_every_requests: int = 512,
         engines: list[Any] | None = None,
+        replica: int = 0,
     ) -> None:
         import concurrent.futures
 
         self.engine = engine
+        # Engine replica set (ISSUE 13): this service consumes submission
+        # queue ``replica``, pushes completions through ITS queue rows
+        # under ITS completion lock, and mirrors telemetry into ITS rows
+        # of every engine-written stats block. 0 — the pre-replica call
+        # shape — is the lead replica (profile forwarding, lifecycle).
+        self.replica = int(replica)
+        if not 0 <= self.replica < ring.replicas:
+            raise ValueError(
+                f"replica {replica} outside the ring's {ring.replicas} "
+                "replica rows"
+            )
         # Tenant fleet (mlops_tpu/tenancy/): ``engines[t]`` serves slot
         # tenant index ``t``. The single-engine call shape (every
         # pre-tenancy caller, the test stubs) is the degenerate 1-tenant
@@ -1220,7 +1394,8 @@ class RingService:
         """Drain: stop collecting, finish in-flight jobs, final monitor
         write. Safe to call twice."""
         self._stop.set()
-        self.ring.engine_doorbell.ring()  # wake the collector's select
+        # wake the collector's select
+        self.ring.engine_doorbells[self.replica].ring()
         for thread in (self._collector, self._telemetry):
             if thread is not None:
                 thread.join(timeout=10)
@@ -1229,7 +1404,9 @@ class RingService:
             if not self._accumulating[t]:
                 continue
             try:
-                self.ring.write_monitor(eng.monitor_snapshot(), t)
+                self.ring.write_monitor(
+                    eng.monitor_snapshot(), t, self.replica
+                )
             except Exception:  # tpulint: disable=TPU201
                 logger.exception("final monitor snapshot failed on drain")
         self._write_lifecycle()
@@ -1247,13 +1424,25 @@ class RingService:
         # never banked — un-credited entries always arrive with their own
         # ring, and banking surplus would let a later consume run ahead
         # of the eventfd fence.
-        credit = int(ring.sub_head[0]) - int(ring.sub_tail[0])
+        credit = int(ring.sub_head[self.replica]) - int(
+            ring.sub_tail[self.replica]
+        )
         while not self._stop.is_set():
-            self._handle_profile()
-            descs = ring.pop_submissions(limit=credit) if credit else []
+            if self.replica == 0:
+                # /debug/profile rides the single shm control word and is
+                # answered by the LEAD replica only (one device trace at
+                # a time; the channel has one seq space).
+                self._handle_profile()
+            descs = (
+                ring.pop_submissions(limit=credit, replica=self.replica)
+                if credit
+                else []
+            )
             credit = 0
             if not descs:
-                credit = ring.engine_doorbell.wait(timeout_s=1.0)
+                credit = ring.engine_doorbells[self.replica].wait(
+                    timeout_s=1.0
+                )
                 continue
             if ring.tracing:
                 # Engine-half span stamp 1: the descriptor left the ring
@@ -1340,16 +1529,17 @@ class RingService:
         # raise = a failed re-attach — this engine process exits nonzero
         # and the supervisor retries with a fresh fork.
         faults.fire("serve.ring.reattach")
-        incarnation = int(ring.eng_vals[ENG_INCARNATION]) + 1
-        ring.eng_vals[ENG_INCARNATION] = incarnation
-        ring.recover_engine_locks()
+        rep = self.replica
+        incarnation = int(ring.eng_vals[rep, ENG_INCARNATION]) + 1
+        ring.eng_vals[rep, ENG_INCARNATION] = incarnation
+        ring.recover_engine_locks(rep)
         # Monotone-counter seeding for the ABSOLUTE mirrors: degraded
         # dispatches, lifecycle counters, and shape histograms all mirror
         # in-process totals that restart at zero with this process —
         # without bases/seeding, the first telemetry tick after a respawn
         # would regress the exported counters (a Prometheus counter
         # reset, and a chaos-smoke monotonicity failure).
-        self._degraded_base = float(ring.rob_vals[ROB_DEGRADED])
+        self._degraded_base = float(ring.rob_vals[rep, ROB_DEGRADED])
         for t in range(len(self.engines)):
             if float(ring.life_vals[t, LIFE_HAS]):
                 self._life_base[t] = {
@@ -1363,28 +1553,36 @@ class RingService:
                     },
                 }
         stats = getattr(self.engine, "shape_stats", None)
-        if stats is not None and float(ring.shape_meta[0]) > 0:
+        if stats is not None and float(ring.shape_meta[rep]) > 0:
             from mlops_tpu.trace.shapes import read_table
 
             stats.seed(
-                read_table(ring.shape_keys, ring.shape_vals),
-                t0=float(ring.shape_meta[0]),
+                read_table(ring.shape_keys[rep], ring.shape_vals[rep]),
+                t0=float(ring.shape_meta[rep]),
             )
         rows_lost = 0.0
         for t, eng in enumerate(self.engines):
-            if self._accumulating[t] and float(ring.mon_vals[t, MON_HAS]):
+            if self._accumulating[t] and float(
+                ring.mon_vals[rep, t, MON_HAS]
+            ):
                 eng.seed_monitor_totals(
-                    float(ring.mon_vals[t, MON_ROWS]),
-                    float(ring.mon_vals[t, MON_OUTLIERS]),
-                    float(ring.mon_vals[t, MON_BATCHES]),
-                    np.asarray(ring.mon_drift_sum[t], np.float64),
-                    np.asarray(ring.mon_drift_last[t], np.float64),
+                    float(ring.mon_vals[rep, t, MON_ROWS]),
+                    float(ring.mon_vals[rep, t, MON_OUTLIERS]),
+                    float(ring.mon_vals[rep, t, MON_BATCHES]),
+                    np.asarray(ring.mon_drift_sum[rep, t], np.float64),
+                    np.asarray(ring.mon_drift_last[rep, t], np.float64),
                 )
-        pending = ring.pending_submissions()
+        pending = ring.pending_submissions(rep)
+        # Only THIS replica's busy slots replay: a sibling replica's
+        # in-flight slots are its own live work (or its own successor's
+        # replay) — re-answering them here would double-serve a slab a
+        # live process may be writing.
         replay = [
             (slot, int(ring.slot_gen[slot]))
             for slot in range(ring.n_slots)
-            if int(ring.slot_busy[slot]) and slot not in pending
+            if int(ring.slot_busy[slot])
+            and int(ring.slot_replica[slot]) % ring.replicas == rep
+            and slot not in pending
         ]
         replay_rows = sum(int(ring.slot_n[slot]) for slot, _ in replay)
         replay_rows_by_tenant: dict[int, int] = {}
@@ -1405,17 +1603,17 @@ class RingService:
             # re-anchors to the fetched totals so the replayed rows land
             # exactly once — per tenant, so one tenant's loss can never
             # hide inside another tenant's surplus.
-            dispatched = float(ring.eng_rows_tenant[t])
-            fetched = float(ring.mon_vals[t, MON_ROWS])
+            dispatched = float(ring.eng_rows_tenant[rep, t])
+            fetched = float(ring.mon_vals[rep, t, MON_ROWS])
             fetched_total += fetched
             rows_lost += max(
                 0.0,
                 dispatched - fetched - replay_rows_by_tenant.get(t, 0),
             )
-            ring.eng_rows_tenant[t] = fetched
+            ring.eng_rows_tenant[rep, t] = fetched
         if rows_lost:
-            ring.eng_vals[ENG_ROWS_LOST] += rows_lost
-        ring.eng_vals[ENG_ROWS_DISPATCHED] = fetched_total
+            ring.eng_vals[rep, ENG_ROWS_LOST] += rows_lost
+        ring.eng_vals[rep, ENG_ROWS_DISPATCHED] = fetched_total
         if replay:
             import concurrent.futures
 
@@ -1434,18 +1632,19 @@ class RingService:
                 exc = job_future.exception()
                 if exc is not None:
                     raise exc
-            ring.eng_vals[ENG_REPLAYED] += len(replay)
+            ring.eng_vals[rep, ENG_REPLAYED] += len(replay)
             self._requests_since_fetch += len(replay)
         # Generous credit flush, replay or not: any completion entry
-        # still queued (stranded by the death window between a push and
-        # its doorbell ring, or published for a worker that has not
-        # drained yet) gets credited; consumers discard the surplus.
+        # still queued in THIS replica's rows (stranded by the death
+        # window between a push and its doorbell ring, or published for
+        # a worker that has not drained yet) gets credited; consumers
+        # discard the surplus.
         for worker in range(ring.workers):
-            outstanding = int(ring.comp_head[worker]) - int(
-                ring.comp_tail[worker]
+            outstanding = int(ring.comp_head[rep, worker]) - int(
+                ring.comp_tail[rep, worker]
             )
             if outstanding > 0:
-                ring.worker_doorbells[worker].ring(outstanding)
+                ring.worker_doorbell(worker, rep).ring(outstanding)
         return {
             "incarnation": incarnation,
             "replayed_slots": len(replay),
@@ -1518,7 +1717,9 @@ class RingService:
                     live.append((slot, gen))
             if expired:
                 with self._mon_lock:
-                    ring.rob_vals[ROB_EXPIRED_ENGINE] += len(expired)
+                    ring.rob_vals[self.replica, ROB_EXPIRED_ENGINE] += len(
+                        expired
+                    )
             raws, status = None, RESP_OK
             tenant = self._slot_tenant(job[0][0]) if job else 0
             if live:
@@ -1541,9 +1742,9 @@ class RingService:
                 # 11). The eng_vals cell keeps the fleet sum.
                 rows = sum(int(ring.slot_n[s]) for s, _ in live)
                 with self._mon_lock:
-                    ring.eng_rows_tenant[tenant] += rows
-                    ring.eng_vals[ENG_ROWS_DISPATCHED] += rows
-            incarnation = int(ring.eng_vals[ENG_INCARNATION])
+                    ring.eng_rows_tenant[self.replica, tenant] += rows
+                    ring.eng_vals[self.replica, ENG_ROWS_DISPATCHED] += rows
+            incarnation = int(ring.eng_vals[self.replica, ENG_INCARNATION])
             for i, (slot, gen) in enumerate(live):
                 # Stale-generation write guard: if the slot has moved on
                 # (its front end crashed and the respawned incarnation
@@ -1574,11 +1775,11 @@ class RingService:
             # AFTER the pushes with how many landed, per owner.
             owners: dict[int, int] = {}
             for slot, gen in job:
-                ring.push_completion(slot, gen)
+                ring.push_completion(slot, gen, self.replica)
                 owner = ring.slot_owner(slot)
                 owners[owner] = owners.get(owner, 0) + 1
             for worker, count in owners.items():
-                ring.worker_doorbells[worker].ring(count)
+                ring.worker_doorbell(worker, self.replica).ring(count)
         finally:
             self._inflight.release()
 
@@ -1662,13 +1863,13 @@ class RingService:
         rows = sum(len(pred) for pred, _, _ in raws)
         outliers = float(sum(float(out.sum()) for _, out, _ in raws))
         last = raws[-1][2]
-        ring = self.ring
+        ring, rep = self.ring, self.replica
         with self._mon_lock:
-            ring.mon_vals[tenant, MON_ROWS] += rows
-            ring.mon_vals[tenant, MON_OUTLIERS] += outliers
-            ring.mon_vals[tenant, MON_BATCHES] += len(raws)
-            ring.mon_drift_last[tenant, :] = last
-            ring.mon_vals[tenant, MON_HAS] = 1.0
+            ring.mon_vals[rep, tenant, MON_ROWS] += rows
+            ring.mon_vals[rep, tenant, MON_OUTLIERS] += outliers
+            ring.mon_vals[rep, tenant, MON_BATCHES] += len(raws)
+            ring.mon_drift_last[rep, tenant, :] = last
+            ring.mon_vals[rep, tenant, MON_HAS] = 1.0
 
     # ----------------------------------------------------------- telemetry
     def _telemetry_loop(self) -> None:
@@ -1692,7 +1893,7 @@ class RingService:
             )
             never = any(
                 self._accumulating[t]
-                and self.ring.mon_vals[t, MON_HAS] == 0.0
+                and self.ring.mon_vals[self.replica, t, MON_HAS] == 0.0
                 for t in range(len(self.engines))
             )
             if not (due_k or due_t or never):
@@ -1703,7 +1904,9 @@ class RingService:
                 if not self._accumulating[t]:
                     continue
                 try:
-                    self.ring.write_monitor(eng.monitor_snapshot(), t)
+                    self.ring.write_monitor(
+                        eng.monitor_snapshot(), t, self.replica
+                    )
                 # A transient device fetch failure keeps the last-written
                 # gauges; the next tick retries (same contract as the
                 # single-process fetch task's done-callback).
@@ -1723,19 +1926,22 @@ class RingService:
             for eng in self.engines
         )
         with self._mon_lock:
-            self.ring.rob_vals[ROB_DEGRADED] = (
+            self.ring.rob_vals[self.replica, ROB_DEGRADED] = (
                 self._degraded_base + float(degraded)
             )
 
     def _write_shapes(self) -> None:
-        """Mirror the engine's tracewire shape histograms into the ring's
-        fixed table (host counter reads + f64 stores, no device work) so
-        every front end's /metrics renders the _bucket series."""
+        """Mirror the engine's tracewire shape histograms into this
+        replica's rows of the ring's fixed table (host counter reads +
+        f64 stores, no device work) so every front end's /metrics renders
+        the _bucket series — the render MERGES the replica tables by
+        entry key."""
         stats = getattr(self.engine, "shape_stats", None)
         if stats is None:
             return
-        stats.write_table(self.ring.shape_keys, self.ring.shape_vals)
-        self.ring.shape_meta[0] = stats.t0
+        rep = self.replica
+        stats.write_table(self.ring.shape_keys[rep], self.ring.shape_vals[rep])
+        self.ring.shape_meta[rep] = stats.t0
 
     def _tenant_lifecycles(self) -> list[tuple[int, Any]]:
         """(tenant index, controller) pairs: the per-tenant list when the

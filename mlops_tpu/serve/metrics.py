@@ -526,25 +526,46 @@ def render_ring_metrics(ring) -> str:
                 f'mlops_tpu_tenant_quota_shed_total{{worker="{w}",'
                 f'tenant="{tenant}"}} {int(ring.quota_shed[w, t])}'
             )
+    # Monitor aggregates FOLD the replica axis (ISSUE 13): totals sum
+    # across replica rows; the cross-replica drift mean is recomputed
+    # from the unrounded per-replica sums (an exact weighted fold — a
+    # mean of per-replica rounded means would drift with skewed load);
+    # drift_last comes from the most recently fetched replica row.
+    R = int(getattr(ring, "replicas", 1))
+    T = len(tenants)
     lines.append("# TYPE mlops_tpu_rows_scored_total counter")
     for t, tenant in enumerate(tenants):
         lines.append(
             f'mlops_tpu_rows_scored_total{{tenant="{tenant}"}} '
-            f"{int(ring.mon_vals[t, MON_ROWS])}"
+            f"{int(ring.mon_vals[:, t, MON_ROWS].sum())}"
         )
     lines.append("# TYPE mlops_tpu_outliers_total counter")
     for t, tenant in enumerate(tenants):
         lines.append(
             f'mlops_tpu_outliers_total{{tenant="{tenant}"}} '
-            f"{int(ring.mon_vals[t, MON_OUTLIERS])}"
+            f"{int(ring.mon_vals[:, t, MON_OUTLIERS].sum())}"
         )
-    if any(ring.mon_vals[t, MON_HAS] for t in range(len(tenants))):
+
+    def _last_replica(t: int) -> int | None:
+        """The replica whose drift_last row is freshest for tenant t:
+        latest fetch stamp among rows that HAVE data, falling back to
+        the lowest such row (host-fold engines never stamp fetches)."""
+        has = [r for r in range(R) if ring.mon_vals[r, t, MON_HAS]]
+        if not has:
+            return None
+        return max(
+            has, key=lambda r: (float(ring.mon_vals[r, t, MON_FETCHED_AT]),
+                                -r),
+        )
+
+    if any(ring.mon_vals[:, t, MON_HAS].any() for t in range(T)):
         lines.append("# TYPE mlops_tpu_feature_drift_score gauge")
         for t, tenant in enumerate(tenants):
-            if not ring.mon_vals[t, MON_HAS]:
+            r_last = _last_replica(t)
+            if r_last is None:
                 continue
             for feature, score in zip(
-                SCHEMA.feature_names, ring.mon_drift_last[t]
+                SCHEMA.feature_names, ring.mon_drift_last[r_last, t]
             ):
                 lines.append(
                     f'mlops_tpu_feature_drift_score{{feature="{feature}",'
@@ -556,22 +577,26 @@ def render_ring_metrics(ring) -> str:
         # rendering zeros would read as "no drift" where the
         # single-process server correctly emits no series at all.
         if any(
-            int(ring.mon_vals[t, MON_FETCHES]) for t in range(len(tenants))
+            int(ring.mon_vals[:, t, MON_FETCHES].sum()) for t in range(T)
         ):
             lines.append("# TYPE mlops_tpu_feature_drift_mean gauge")
             for t, tenant in enumerate(tenants):
-                if not int(ring.mon_vals[t, MON_FETCHES]):
+                if not int(ring.mon_vals[:, t, MON_FETCHES].sum()):
                     continue
-                for feature, score in zip(
-                    SCHEMA.feature_names, ring.mon_drift_mean[t]
-                ):
+                if R == 1:
+                    mean = ring.mon_drift_mean[0, t]
+                else:
+                    batches = float(ring.mon_vals[:, t, MON_BATCHES].sum())
+                    mean = (
+                        ring.mon_drift_sum[:, t, :].sum(axis=0)
+                        / max(batches, 1.0)
+                    ).round(6)
+                for feature, score in zip(SCHEMA.feature_names, mean):
                     lines.append(
                         f'mlops_tpu_feature_drift_mean{{feature="{feature}",'
                         f'tenant="{tenant}"}} {float(score)}'
                     )
-    fetches = sum(
-        int(ring.mon_vals[t, MON_FETCHES]) for t in range(len(tenants))
-    )
+    fetches = int(ring.mon_vals[:, :, MON_FETCHES].sum())
     if fetches:
         lines.append("# TYPE mlops_tpu_monitor_fetches_total counter")
         lines.append(f"mlops_tpu_monitor_fetches_total {fetches}")
@@ -579,16 +604,17 @@ def render_ring_metrics(ring) -> str:
         for t, tenant in enumerate(tenants):
             lines.append(
                 f'mlops_tpu_monitor_batches_total{{tenant="{tenant}"}} '
-                f"{int(ring.mon_vals[t, MON_BATCHES])}"
+                f"{int(ring.mon_vals[:, t, MON_BATCHES].sum())}"
             )
-        # The age is the OLDEST fetched tenant's (min over fetched
-        # rows): this gauge is the documented staleness ALARM, and a
-        # max would let any one healthy tenant's fresh fetch mask
-        # another tenant's stuck monitor indefinitely.
+        # The age is the OLDEST fetched (replica, tenant) row's (min
+        # over fetched stamps): this gauge is the documented staleness
+        # ALARM, and a max would let any one healthy row's fresh fetch
+        # mask another row's stuck monitor indefinitely.
         fetched = [
-            float(ring.mon_vals[t, MON_FETCHED_AT])
-            for t in range(len(tenants))
-            if float(ring.mon_vals[t, MON_FETCHED_AT]) > 0
+            float(ring.mon_vals[r, t, MON_FETCHED_AT])
+            for r in range(R)
+            for t in range(T)
+            if float(ring.mon_vals[r, t, MON_FETCHED_AT]) > 0
         ]
         if fetched:
             age = time.monotonic() - min(fetched)
@@ -600,42 +626,97 @@ def render_ring_metrics(ring) -> str:
             )
     # Robustness counters, same series names as the single-process plane:
     # front-end dead-work sheds (per-worker single-writer cells) plus the
-    # engine-side expired completions and degraded dispatches.
+    # engine-side expired completions and degraded dispatches, summed
+    # over the replica rows.
     lines.extend(
         ServingMetrics.robustness_lines(
-            int(ring.expired.sum()) + int(ring.rob_vals[ROB_EXPIRED_ENGINE]),
-            int(ring.rob_vals[ROB_DEGRADED]),
+            int(ring.expired.sum())
+            + int(ring.rob_vals[:, ROB_EXPIRED_ENGINE].sum()),
+            int(ring.rob_vals[:, ROB_DEGRADED].sum()),
             int(ring.trace_dropped.sum()),
         )
     )
-    # Engine-survivability block (ISSUE 11): supervisor/engine cells plus
-    # the per-worker parking/brownout cells summed into plane totals —
-    # identical series names to the single-process render's zero baseline.
+    # Engine-survivability block (ISSUE 11): per-replica rows summed
+    # into plane totals plus the per-worker parking/brownout cells —
+    # identical series names to the single-process render's zero
+    # baseline (and numerically identical to pre-replica planes at E=1).
     lines.extend(
         ServingMetrics.survivability_lines(
-            int(ring.eng_vals[ENG_RESPAWNS]),
-            int(ring.eng_vals[ENG_REPLAYED]),
-            float(ring.eng_vals[ENG_ROWS_LOST]),
+            int(ring.eng_vals[:, ENG_RESPAWNS].sum()),
+            int(ring.eng_vals[:, ENG_REPLAYED].sum()),
+            float(ring.eng_vals[:, ENG_ROWS_LOST].sum()),
             int(ring.parked.sum()),
             int(ring.brownout_shed.sum()),
-            incarnation=int(ring.eng_vals[ENG_INCARNATION]),
+            incarnation=int(ring.eng_vals[:, ENG_INCARNATION].sum()),
         )
     )
-    if float(ring.shape_meta[0]) > 0:
-        # tracewire shape histograms, mirrored from the engine process's
-        # ShapeStats by the telemetry loop (shape_meta[0] = the stats'
-        # armed-at monotonic time, the useful_rows_per_s rate base) —
-        # identical series names to the single-process render
-        # (trace/shapes.py `_lines` is the one formatter).
-        from mlops_tpu.trace.shapes import render_table_lines
-
-        lines.extend(
-            render_table_lines(
-                ring.shape_keys,
-                ring.shape_vals,
-                time.monotonic() - float(ring.shape_meta[0]),
-            )
+    # Per-replica fleet block (ISSUE 13). EVERY configured replica gets
+    # EVERY series on EVERY scrape — a never-dispatched replica exports
+    # zeros, because "no series" is indistinguishable from "dead
+    # replica" on a dashboard (the same always-emit contract PR 6 pinned
+    # for the per-worker depth/shed series).
+    lines.append("# TYPE mlops_tpu_replica_ready gauge")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_ready{{replica="{r}"}} '
+            f"{1 if ring.rep_ready[r] else 0}"
         )
+    lines.append("# TYPE mlops_tpu_replica_ring_depth gauge")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_ring_depth{{replica="{r}"}} '
+            f"{int(ring.rep_inflight[:, r].sum())}"
+        )
+    lines.append("# TYPE mlops_tpu_replica_incarnation gauge")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_incarnation{{replica="{r}"}} '
+            f"{int(ring.eng_vals[r, ENG_INCARNATION])}"
+        )
+    lines.append("# TYPE mlops_tpu_replica_respawn_total counter")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_respawn_total{{replica="{r}"}} '
+            f"{int(ring.eng_vals[r, ENG_RESPAWNS])}"
+        )
+    lines.append("# TYPE mlops_tpu_replica_replayed_slots_total counter")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_replayed_slots_total{{replica="{r}"}} '
+            f"{int(ring.eng_vals[r, ENG_REPLAYED])}"
+        )
+    # Per-replica goodput: rows this replica scored (its monitor rows
+    # summed over tenants) — with replica_ring_depth, the router's two
+    # observables and the scaling-efficiency denominators.
+    lines.append("# TYPE mlops_tpu_replica_rows_scored_total counter")
+    for r in range(R):
+        lines.append(
+            f'mlops_tpu_replica_rows_scored_total{{replica="{r}"}} '
+            f"{int(ring.mon_vals[r, :, MON_ROWS].sum())}"
+        )
+    metas = [float(ring.shape_meta[r]) for r in range(R)]
+    if any(m > 0 for m in metas):
+        # tracewire shape histograms, mirrored from each replica's
+        # ShapeStats by its telemetry loop (shape_meta[r] = that stats'
+        # armed-at monotonic time) — MERGED by entry key (replicas warm
+        # identical grids) into the same series names as the
+        # single-process render (trace/shapes.py `_lines` is the one
+        # formatter); the rate base is the oldest armed clock.
+        from mlops_tpu.trace.shapes import (
+            merge_entries,
+            read_table,
+            render_entries_lines,
+        )
+
+        armed = [r for r in range(R) if metas[r] > 0]
+        entries = merge_entries(
+            [
+                read_table(ring.shape_keys[r], ring.shape_vals[r])
+                for r in armed
+            ]
+        )
+        elapsed = time.monotonic() - min(metas[r] for r in armed)
+        lines.extend(render_entries_lines(entries, elapsed))
     for t, tenant in enumerate(tenants):
         if not ring.life_vals[t, LIFE_HAS]:
             continue

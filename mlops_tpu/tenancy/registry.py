@@ -80,6 +80,8 @@ class TenantRegistry:
         enable_grouping: bool = True,
         compile_cache: Any = None,
         warmup_workers: int = 0,
+        model_shards: int = 1,
+        device_index: int | None = None,
     ) -> None:
         from mlops_tpu.bundle import load_bundle
         from mlops_tpu.serve.engine import InferenceEngine
@@ -90,6 +92,13 @@ class TenantRegistry:
         self.bundles = [
             load_bundle(spec.bundle_dir) for spec in self.tenancy.tenants
         ]
+        # ``model_shards`` is fleet-global (ISSUE 13): every tenant's
+        # params lay out over the same ('model',) serve mesh, so
+        # architecture twins still share executables — the mesh shape is
+        # part of the cache key, identical across the fleet, and N
+        # tenants × E replicas at K architectures still pay K warmups
+        # per replica process (each against the same persistent cache:
+        # one replica compiles, the rest deserialize).
         self.engines = [
             InferenceEngine(
                 bundle,
@@ -98,6 +107,8 @@ class TenantRegistry:
                 enable_grouping=enable_grouping,
                 compile_cache=compile_cache,
                 warmup_workers=warmup_workers,
+                model_shards=model_shards,
+                device_index=device_index,
             )
             for bundle in self.bundles
         ]

@@ -172,12 +172,39 @@ def read_table(keys: np.ndarray, vals: np.ndarray) -> dict[str, list[float]]:
     return entries
 
 
+def merge_entries(
+    tables: list[dict[str, list[float]]]
+) -> dict[str, list[float]]:
+    """Fold several replicas' entry tables into one (ISSUE 13): entries
+    are keyed by compiled shape, which every replica warms identically,
+    so the fold is a per-key elementwise sum — histogram counts,
+    requested/padded totals, and dispatch counts all add."""
+    merged: dict[str, list[float]] = {}
+    for table in tables:
+        for entry, vals in table.items():
+            row = merged.get(entry)
+            if row is None:
+                merged[entry] = [float(v) for v in vals]
+            else:
+                for i, v in enumerate(vals):
+                    row[i] += float(v)
+    return merged
+
+
 def render_table_lines(
     keys: np.ndarray, vals: np.ndarray, elapsed_s: float
 ) -> list[str]:
     """The ring renderer's half: same series as `ShapeStats.render_lines`
     but from the shm mirror (any front end serves the scrape)."""
-    entries = read_table(keys, vals)
+    return render_entries_lines(read_table(keys, vals), elapsed_s)
+
+
+def render_entries_lines(
+    entries: dict[str, list[float]], elapsed_s: float
+) -> list[str]:
+    """Format an already-merged entry table (the multi-replica render):
+    identical series to `render_table_lines`, rate base = the merged
+    fleet's oldest armed clock."""
     requested = sum(v[1] for v in entries.values())
     rate = round(requested / max(elapsed_s, 1e-9), 1)
     return _lines(entries, rate)

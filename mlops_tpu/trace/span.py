@@ -41,7 +41,7 @@ class Span:
     """Stamp accumulator for one traced request."""
 
     __slots__ = ("trace_id", "plane", "worker", "route", "rows", "entry",
-                 "t0", "stamps", "abandoned", "tenant")
+                 "t0", "stamps", "abandoned", "tenant", "replica")
 
     def __init__(
         self,
@@ -56,6 +56,10 @@ class Span:
         self.plane = plane
         self.worker = worker
         self.route = route
+        # Engine replica that served the request (ISSUE 13): stitched in
+        # from the shm slot tag on the ring plane; 0 everywhere else
+        # (the single-process plane has exactly one engine).
+        self.replica = 0
         # Bounded tenant label (mlops_tpu/tenancy/router.py): rides every
         # span record so trace-report can slice per tenant; "default" for
         # untagged traffic keeps pre-tenancy reports parsing unchanged.
@@ -108,6 +112,7 @@ class Span:
             "worker": self.worker,
             "route": self.route,
             "tenant": self.tenant,
+            "replica": int(self.replica),
             "status": int(status),
             "rows": int(self.rows),
             "wall_ms": round((prev - self.t0) * 1e3, 4),

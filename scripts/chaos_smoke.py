@@ -493,12 +493,12 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         os.kill(engine_pid, signal.SIGKILL)
         deadline = time.time() + 60
         while time.time() < deadline and not any(
-            "engine process (pid" in line and "respawning" in line
+            "engine replica" in line and "respawning" in line
             for line in log_lines
         ):
             time.sleep(0.2)
         assert any(
-            "engine process (pid" in line and "respawning" in line
+            "engine replica" in line and "respawning" in line
             for line in log_lines
         ), "supervisor never respawned the SIGKILLed engine"
         # Keep hammering until the respawned engine serves again.

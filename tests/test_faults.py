@@ -393,7 +393,7 @@ def test_ring_expired_descriptor_completes_without_dispatch():
             service.start()
             status = await asyncio.wait_for(future, timeout=10)
             assert status == RESP_EXPIRED
-            assert int(ring.rob_vals[ROB_EXPIRED_ENGINE]) == 1
+            assert int(ring.rob_vals[0, ROB_EXPIRED_ENGINE]) == 1
             client.release(slot)
             loop.remove_reader(ring.worker_doorbells[0].fileno())
         finally:

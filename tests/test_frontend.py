@@ -644,7 +644,7 @@ def test_respawned_client_counts_quarantined_slots_as_inflight():
         assert int(ring.inflight[0, 0, SMALL]) == 1
         assert int(ring.inflight[0, 0, LARGE]) == 0
         assert int(ring.parked[0]) == 0, "phantom parked gauge survived"
-        assert client._credit == 1
+        assert client._credit == [1]  # one cell per engine replica
         client.on_doorbell()
         assert int(ring.inflight[0, 0, SMALL]) == 0
         assert busy in client._free[SMALL]
@@ -725,7 +725,7 @@ def test_dead_incarnation_completion_is_dropped_not_double_served():
             workers=1, slots_small=2, slots_large=1, large_rows=8
         )
         try:
-            ring.eng_vals[ENG_INCARNATION] = 1  # incarnation 1 is live
+            ring.eng_vals[0, ENG_INCARNATION] = 1  # incarnation 1 is live
             client = RingClient(ring, 0)
             slot = client.claim(1)
             cat = np.zeros((1, SCHEMA.num_categorical), np.int32)
@@ -739,7 +739,7 @@ def test_dead_incarnation_completion_is_dropped_not_double_served():
             ring.resp_incarnation[slot] = 1
             ring.resp_gen[slot] = gen
             ring.push_completion(slot, gen)
-            ring.eng_vals[ENG_INCARNATION] = 2
+            ring.eng_vals[0, ENG_INCARNATION] = 2
             ring.worker_doorbells[0].ring(1)
             client.on_doorbell()
             assert not future.done(), (
@@ -819,7 +819,7 @@ def test_brownout_shed_advertises_respawn_eta_and_parks_admissions(
         # outage start is stamped (the stub RingService keeps running,
         # standing in for the respawned engine's replay).
         ring.set_ready(False)
-        ring.eng_vals[ENG_DOWN_SINCE] = time.monotonic()
+        ring.eng_vals[0, ENG_DOWN_SINCE] = time.monotonic()
         results: list = [None, None]
         threads = [
             threading.Thread(
