@@ -102,6 +102,14 @@ def build_parser() -> argparse.ArgumentParser:
             )
         if name == "trace-report":
             p.add_argument(
+                "--ledger",
+                action="store_true",
+                help="report the device-time cost ledger (slo.ledger_dir) "
+                "ranked by cost_ms_per_row instead of aggregating span "
+                "files — the measured per-entry cost model the "
+                "traffic-shape autotuner consumes",
+            )
+            p.add_argument(
                 "--tenant",
                 default=None,
                 help="only aggregate spans whose tenant label matches "
@@ -115,6 +123,19 @@ def build_parser() -> argparse.ArgumentParser:
                 "replica (the ring plane stamps every span with the "
                 "router's choice; pre-replica spans count as 0)",
             )
+    # `flightrec` takes dump paths, not config overrides: rendering a
+    # post-mortem must work on any box with just the dump files.
+    flightrec = sub.add_parser(
+        "flightrec",
+        help="render flight-recorder dumps (runs/flightrec-*.json — "
+        "written on burn-rate alerts, engine respawns, error spikes, "
+        "and incident-time drains) into a human timeline",
+    )
+    flightrec.add_argument(
+        "paths",
+        nargs="+",
+        help="dump files to render (e.g. runs/flightrec-*.json)",
+    )
     # `analyze` takes paths + flags, not config overrides: static analysis
     # must run identically with zero configuration (CI, pre-commit).
     analyze = sub.add_parser(

@@ -17,6 +17,11 @@ def run(args: argparse.Namespace) -> int:
         from mlops_tpu.analysis.cli import run_analyze
 
         return run_analyze(args)
+    if args.command == "flightrec":
+        # Flight-recorder timeline render (mlops_tpu/slo/flightrec.py):
+        # jax-free, takes dump paths rather than config — intercepted
+        # like `analyze` so a post-mortem box needs no backend at all.
+        return _flightrec_paths(list(getattr(args, "paths", [])))
     _honor_jax_platforms_env()
     # Multi-host launches (GKE JobSet / TPU pod) wire up DCN before any
     # backend use; single-host is a no-op (parallel/distributed.py).
@@ -47,6 +52,10 @@ def run(args: argparse.Namespace) -> int:
     # engine replica set, ISSUE 13).
     if getattr(args, "replicas", None) is not None:
         config.serve.engine_replicas = args.replicas
+    # `trace-report --ledger` is sugar for `trace.ledger=true` (the
+    # device-time cost ledger ranking, ISSUE 14).
+    if getattr(args, "ledger", False):
+        config.trace.ledger = True
     handler = _HANDLERS.get(args.command)
     if handler is None:
         raise SystemExit(f"subcommand {args.command!r} is not implemented yet")
@@ -534,11 +543,12 @@ def _serve(config) -> int:
     config.serve.service_name = os.environ.get(
         "SERVICE_NAME", config.serve.service_name
     )
-    # Inconsistent worker/ring geometry (or trace knobs) fails the
+    # Inconsistent worker/ring geometry (or trace/slo knobs) fails the
     # rollout HERE with the constraint named, before anything binds or
     # warms.
     config.serve.validate()
     config.trace.validate()
+    config.slo.validate()
     if config.serve.workers > 1:
         # Multi-worker plane: N SO_REUSEPORT front-end processes + one
         # ENGINE child process, all forked and supervised by this
@@ -633,7 +643,7 @@ def _serve(config) -> int:
             lifecycle = LifecycleController(engine, config)
     serve_forever(
         engine, config.serve, lifecycle=lifecycle, trace=config.trace,
-        registry=registry,
+        registry=registry, slo=config.slo,
     )
     return 0
 
@@ -756,6 +766,23 @@ def _trace_report(config) -> int:
 
     from mlops_tpu.trace import format_report, load_spans, stage_report
 
+    if config.trace.ledger:
+        # `--ledger`: rank the device-time cost ledger (slo.ledger_dir —
+        # mlops_tpu/slo/ledger.py) by cost_ms_per_row instead of
+        # aggregating span files. Same print discipline: human table on
+        # stderr, JSON on stdout, exit 2 when the ledger is empty.
+        from mlops_tpu.slo import ledger_report
+        from mlops_tpu.slo.ledger import format_ledger_report
+
+        if not config.slo.ledger_dir:
+            raise SystemExit(
+                "trace-report --ledger needs slo.ledger_dir (the "
+                "directory a served plane's cost ledger flushed into)"
+            )
+        report = ledger_report(config.slo.ledger_dir)
+        print(format_ledger_report(report), file=sys.stderr)
+        print(json.dumps(report))
+        return 0 if report["entries"] else 2
     spans = load_spans(config.trace.dir)
     if config.trace.tenant:
         # Per-tenant slice (`--tenant` / trace.tenant): multi-tenant
@@ -777,6 +804,46 @@ def _trace_report(config) -> int:
     print(format_report(report), file=sys.stderr)
     print(json.dumps(report))
     return 0 if spans else 2
+
+
+def _flightrec_paths(paths: list[str]) -> int:
+    """`mlops-tpu flightrec <dump.json>...`: render flight-recorder
+    dumps into human timelines (stderr) + a JSON summary (stdout — the
+    CLI's one-JSON-line discipline). Exit 2 with no readable dumps."""
+    import sys
+
+    from mlops_tpu.slo.flightrec import format_timeline, load_dump
+
+    summaries = []
+    for path in paths:
+        try:
+            dump = load_dump(path)
+        except (OSError, ValueError) as err:
+            print(f"flightrec: unreadable dump {path}: {err}",
+                  file=sys.stderr)
+            continue
+        print(format_timeline(dump), file=sys.stderr)
+        summaries.append(
+            {
+                "path": str(path),
+                "reason": dump.get("reason"),
+                "source": dump.get("source"),
+                "worker": dump.get("worker"),
+                "pid": dump.get("pid"),
+                "events": len(dump.get("events", [])),
+            }
+        )
+    print(json.dumps(summaries))
+    return 0 if summaries else 2
+
+
+def _flightrec(config) -> int:
+    """Handler-table entry for parser/handler sync (tests/test_cli.py);
+    ``run()`` intercepts `flightrec` before config loading (it takes
+    dump PATHS, not config), so this shim only runs when dispatched
+    directly — nothing to render without paths."""
+    raise SystemExit("flightrec takes dump paths: mlops-tpu flightrec "
+                     "runs/flightrec-*.json")
 
 
 def _analyze(config) -> int:
@@ -806,4 +873,5 @@ _HANDLERS = {
     "lifecycle": _lifecycle,
     "warmup": _warmup,
     "trace-report": _trace_report,
+    "flightrec": _flightrec,
 }

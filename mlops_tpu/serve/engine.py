@@ -23,6 +23,7 @@ from __future__ import annotations
 import bisect
 import logging
 import threading
+import time
 from typing import Any
 
 import jax
@@ -91,13 +92,19 @@ class _ArraysHandle:
     """In-flight padded dispatch: the device output plus everything the
     fetch side needs to slice the packed buffer back into the response."""
 
-    __slots__ = ("out", "n", "rows", "packed")
+    __slots__ = ("out", "n", "rows", "packed", "t0")
 
-    def __init__(self, out: Any, n: int, rows: int, packed: bool):
+    def __init__(
+        self, out: Any, n: int, rows: int, packed: bool, t0: float = 0.0
+    ):
         self.out = out
         self.n = n
         self.rows = rows  # padded row count (bucket, or n at exact shape)
         self.packed = packed
+        # Cost-ledger dispatch stamp (slo/ledger.py): perf_counter at
+        # device enqueue, 0.0 when the ledger is disarmed — the fetch
+        # side differences it into the entry's device-path seconds.
+        self.t0 = t0
 
     def start_copy(self) -> None:
         _start_copy(self.out)
@@ -106,15 +113,16 @@ class _ArraysHandle:
 class _GroupHandle:
     """In-flight grouped dispatch (or the degenerate solo-path result)."""
 
-    __slots__ = ("out", "sizes", "rows", "responses", "slots", "entry")
+    __slots__ = ("out", "sizes", "rows", "responses", "slots", "entry", "t0")
 
     def __init__(self, out=None, sizes=None, rows=0, responses=None,
-                 slots=0):
+                 slots=0, t0=0.0):
         self.out = out
         self.sizes = sizes
         self.rows = rows
         self.responses = responses  # set = degenerate path, already done
         self.slots = slots  # slot-bucket geometry actually dispatched
+        self.t0 = t0  # cost-ledger dispatch stamp (see _ArraysHandle)
         # tracewire compiled-entry key, derived ONCE from the ints the
         # engine chose (degraded fallback included) — consumers carry the
         # ints (serve/ipc.py) or this string (the batcher's span entry),
@@ -169,6 +177,12 @@ class InferenceEngine:
         # (compiled entry, requested rows, padded rows). Disarmed = None =
         # one branch on the hot path (the faultline overhead discipline).
         self.shape_stats = None
+        # Device-time cost ledger (mlops_tpu/slo/ledger.py), armed by
+        # `set_cost_ledger`: per-entry dispatch->fetch seconds keyed by
+        # entry + model fingerprint. Disarmed = None = one branch on the
+        # dispatch path, one on the fetch path.
+        self.cost_ledger = None
+        self._cost_tag = ""
         if bundle.flavor == "doc":
             raise ValueError(
                 "doc bundles score record HISTORIES, not single records — "
@@ -598,6 +612,29 @@ class InferenceEngine:
         add); the engine calls it bare on the dispatch path."""
         self.shape_stats = stats
 
+    @staticmethod
+    def _model_tag(bundle: Bundle) -> str:
+        """The cost ledger's model dimension: the same model-config
+        fingerprint the compile cache hashes into its keys
+        (compilecache/keys.py), shortened for the label/shm-key budget.
+        Two engines whose architectures match share compiled programs
+        (tenancy adoption) and correctly share ledger entries; a
+        promotion to a DIFFERENT architecture lands in fresh entries."""
+        from mlops_tpu.compilecache.keys import model_fingerprint
+
+        return model_fingerprint(bundle.model_config)[:8]
+
+    def set_cost_ledger(self, ledger) -> None:
+        """Install (or clear, with None) the device-time cost ledger
+        (`slo/ledger.CostLedger`): every packed dispatch accounts
+        (entry, requested rows, padded rows, dispatch->fetch seconds)
+        under ``<entry>@<model-tag>``. Disarmed = None = one branch on
+        the dispatch path and one on the fetch path (the faultline
+        overhead discipline; bench key ``slo_overhead_pct``)."""
+        if ledger is not None:
+            self._cost_tag = self._model_tag(self.bundle)
+        self.cost_ledger = ledger
+
     # ----------------------------------------------------- bundle turnover
     def set_lifecycle_tee(self, tee) -> None:
         """Install (or clear, with None) the lifecycle observation hook:
@@ -655,6 +692,12 @@ class InferenceEngine:
                 self._predict = candidate._predict
                 self._predict_group = candidate._predict_group
                 self.bundle_generation += 1
+        if self.cost_ledger is not None:
+            # Re-key the ledger to the promoted architecture (outside the
+            # locks: hashing a config dict must not extend the swap's
+            # critical section; the attr store is atomic, and at most a
+            # dispatch already in flight bills the outgoing tag).
+            self._cost_tag = self._model_tag(self.bundle)
         return self.bundle_generation
 
     def rollback(self) -> int:
@@ -676,6 +719,8 @@ class InferenceEngine:
                  self._temperature, self._exec, self._predict,
                  self._predict_group) = retired
                 self.bundle_generation += 1
+        if self.cost_ledger is not None:
+            self._cost_tag = self._model_tag(self.bundle)  # see swap_bundle
         return self.bundle_generation
 
     def seed_monitor_totals(
@@ -841,6 +886,7 @@ class InferenceEngine:
             if stats is not None:
                 stats.observe(f"bucket_{rows}", n, rows)
             return _ArraysHandle(out, n, rows, packed=False)
+        t0 = time.perf_counter() if self.cost_ledger is not None else 0.0
         out, rows = self._dispatch_padded(cat_ids, numeric, n, rows)
         stats = self.shape_stats
         if stats is not None:
@@ -848,7 +894,7 @@ class InferenceEngine:
             # fallback bucket when the target failed) — the histogram must
             # describe the compute paid, not the compute intended.
             stats.observe(f"bucket_{rows}", n, rows)
-        return _ArraysHandle(out, n, rows, packed=True)
+        return _ArraysHandle(out, n, rows, packed=True, t0=t0)
 
     def _dispatch_padded(self, cat_ids, numeric, n: int, rows: int):
         """Pad to ``rows`` and dispatch the fused packed program, keyed by
@@ -920,6 +966,16 @@ class InferenceEngine:
             predictions = np.asarray(out["predictions"])[:n]
             outliers = np.asarray(out["outliers"])[:n]
             drift = np.asarray(out["feature_drift_batch"])
+        ledger = self.cost_ledger
+        if ledger is not None and handle.t0:
+            # Device-path seconds: dispatch enqueue -> host copy landed
+            # (on a remote-attached chip this includes the transfer —
+            # exactly the cost a regrid would re-shape). The np.asarray
+            # above is the blocking wait, so the buffer is in hand here.
+            ledger.observe(
+                f"bucket_{rows}", self._cost_tag, n, rows,
+                time.perf_counter() - handle.t0,
+            )
         return (
             predictions.astype(float),
             outliers.astype(float),
@@ -1003,6 +1059,7 @@ class InferenceEngine:
         # serve.engine.dispatch — covers the micro-batcher and the shm
         # ring plane's coalesced jobs.
         faults.fire("serve.engine.dispatch_group")
+        t0 = time.perf_counter() if self.cost_ledger is not None else 0.0
         slots = GROUP_SLOT_BUCKETS[
             bisect.bisect_left(GROUP_SLOT_BUCKETS, len(parts))
         ]
@@ -1036,7 +1093,9 @@ class InferenceEngine:
             # padded = the full slots x rows grid the program computed
             # (slot padding AND row padding both count as waste).
             stats.observe(f"group_{slots}x{rows}", sum(sizes), slots * rows)
-        handle = _GroupHandle(out=out, sizes=sizes, rows=rows, slots=slots)
+        handle = _GroupHandle(
+            out=out, sizes=sizes, rows=rows, slots=slots, t0=t0
+        )
         handle.start_copy()
         return handle
 
@@ -1101,6 +1160,17 @@ class InferenceEngine:
                              "responses; fetch_group owns that path")
         rows = handle.rows
         arr = np.asarray(handle.out)  # [slots, 2*rows + D]
+        ledger = self.cost_ledger
+        if ledger is not None and handle.t0:
+            # Grouped twin of the solo fetch's ledger hook: the whole
+            # group rode one device dispatch, so the group's seconds
+            # land on its geometry entry (requested = the rows clients
+            # asked for; padded = the full slots x rows grid).
+            ledger.observe(
+                f"group_{handle.slots}x{rows}", self._cost_tag,
+                sum(handle.sizes), handle.slots * rows,
+                time.perf_counter() - handle.t0,
+            )
         # Response assembly is serial host Python on the grouped hot path:
         # do the dtype casts/rounding ONCE over the stacked arrays, then
         # slice per slot (per-slot .astype/.round cost ~3x more).

@@ -115,6 +115,7 @@ class FrontendServer(HttpProtocol):
         preprocessor: Any,
         trace: Any = None,
         tenancy: Any = None,
+        slo: Any = None,
     ) -> None:
         from mlops_tpu.tenancy import QuotaGovernor, TenantRouter
 
@@ -191,6 +192,34 @@ class FrontendServer(HttpProtocol):
                 flush_interval_s=trace.flush_interval_s,
                 on_drop=_count_drops,
             )
+        if slo is not None and slo.enabled and slo.flightrec_enabled:
+            # sloscope flight recorder (mlops_tpu/slo/): EACH front end
+            # keeps its own evidence ring (its requests, its spans) and
+            # dumps it on anomaly — per-process files (pid in the name)
+            # need no cross-process coordination, and the tmp+rename
+            # discipline means a sibling's kill -9 can never tear a
+            # dump. The SLO ENGINE itself runs engine-side (the lead
+            # replica's telemetry loop); this worker watches the shm
+            # alert flags and the respawn counter for its dump
+            # triggers (_run_frontend's watchdog).
+            from mlops_tpu.slo import FlightRecorder
+
+            def _count_dump(path) -> None:
+                # Single-writer shm cell (like trace_dropped): any
+                # worker's scrape shows the fleet's landed dumps.
+                ring.flight_dumps[worker_id] += 1
+
+            self.flightrec = FlightRecorder(
+                slo.flightrec_dir,
+                capacity=slo.flightrec_capacity,
+                cooldown_s=slo.flightrec_cooldown_s,
+                keep=slo.flightrec_keep,
+                source="ring",
+                worker=worker_id,
+                spike_errors=slo.flightrec_spike_errors,
+                spike_window_s=slo.flightrec_spike_window_s,
+                on_dump=_count_dump,
+            )
         # The ring's large slabs are sized by the parent to the (possibly
         # bucket-clamped) request cap; the slab capacity is the contract.
         self.max_batch = min(config.max_batch, ring.large_rows)
@@ -241,6 +270,26 @@ class FrontendServer(HttpProtocol):
             # lot for the whole recompile.
             remaining = eta
         return max(1, math.ceil(remaining))
+
+    def _slo_view(self):
+        # /healthz verdict source (httpcore._healthz): the fleet view the
+        # lead replica last mirrored into shm — rows never written render
+        # the zero baseline (last-known-values contract).
+        if not self.ring.slo_armed:
+            return None
+        from mlops_tpu.slo.engine import read_slo_view
+
+        return read_slo_view(
+            self.ring.slo_vals,
+            self.ring.alert_vals,
+            tuple(self.ring.tenant_names),
+            tuple(float(x) for x in self.ring.slo_meta[:4]),
+        )
+
+    def _engine_down(self) -> bool:
+        # The /healthz verdict's "down" condition IS the full-outage
+        # predicate the brownout shed uses.
+        return self._outage_stamped()
 
     async def _metrics_endpoint(self):
         # Every gauge renders straight from shared memory — all workers'
@@ -615,6 +664,7 @@ def _frontend_main(
     preprocess_path: str | list[str],
     trace: Any = None,
     tenancy: Any = None,
+    slo: Any = None,
 ) -> None:
     """Front-end child process entry (forked — everything arrives by
     inheritance). Never imports jax, never touches the device.
@@ -631,7 +681,7 @@ def _frontend_main(
     try:
         asyncio.run(
             _run_frontend(
-                worker_id, config, ring, preprocessors, trace, tenancy
+                worker_id, config, ring, preprocessors, trace, tenancy, slo
             )
         )
     except KeyboardInterrupt:  # pragma: no cover - interactive stop
@@ -645,9 +695,10 @@ async def _run_frontend(
     preprocessor,
     trace: Any = None,
     tenancy: Any = None,
+    slo: Any = None,
 ) -> None:
     server = FrontendServer(
-        config, ring, worker_id, preprocessor, trace, tenancy
+        config, ring, worker_id, preprocessor, trace, tenancy, slo
     )
     srv = await server.start()
     logger.info(
@@ -670,6 +721,40 @@ async def _run_frontend(
 
     parent = os.getppid()
 
+    def _read_alert_flags() -> dict:
+        # ONE snapshot rule for the edge detector's seed and its
+        # per-pass read: the two must stay identical or a respawned
+        # worker would re-trigger dumps on historical alerts.
+        from mlops_tpu.slo.engine import ENGINE_ALERTS
+
+        return {
+            (alert, tenant): bool(ring.alert_vals[t, a_i])
+            for a_i, alert in enumerate(ENGINE_ALERTS)
+            for t, tenant in enumerate(ring.tenant_names)
+        }
+
+    def _watch_anomalies(state: dict) -> None:
+        # Flight-recorder triggers this worker can only see in shm
+        # (mlops_tpu/slo/): an engine respawn (the supervisor bumped a
+        # replica's counter) and alert flags flipping ACTIVE (the lead
+        # replica's SLO engine mirrored a rising edge). Edge-detected
+        # against the previous watchdog pass, so a sustained alert
+        # triggers once (plus the recorder's own cooldown).
+        from mlops_tpu.slo.engine import ALERT_SEVERITY
+
+        respawns = int(ring.eng_vals[:, ENG_RESPAWNS].sum())
+        if respawns > state["respawns"]:
+            server.flightrec.trigger("engine_respawn")
+        state["respawns"] = respawns
+        flags = _read_alert_flags()
+        for key, active in flags.items():
+            if active and not state["alerts"].get(key):
+                alert, tenant = key
+                server.flightrec.note_alert(
+                    alert, tenant, ALERT_SEVERITY[alert]
+                )
+        state["alerts"] = flags
+
     async def _watch_plane() -> None:
         # Two drain triggers besides the direct SIGTERM: the shared ring
         # drain flag (a front end forked mid-drain, or a missed signal),
@@ -680,8 +765,24 @@ async def _run_frontend(
         # respawns the engine, in-flight requests park against their
         # deadline budgets, and the replay answers them — the watchdog
         # split that turned engine death from an outage into a brownout.
+        # Seed the edge detector from the CURRENT shm state: a worker
+        # (re)spawned into a plane mid-incident must not re-trigger on
+        # history it never witnessed — only on new transitions.
+        anomaly_state = {
+            "respawns": int(ring.eng_vals[:, ENG_RESPAWNS].sum()),
+            "alerts": {},
+        }
+        if server.flightrec is not None and ring.slo_armed:
+            anomaly_state["alerts"] = _read_alert_flags()
         while not draining.is_set():
             await asyncio.sleep(1.0)
+            if server.flightrec is not None:
+                # Executor: a triggered dump writes a file, which must
+                # not stall the accept loop (the recorder is
+                # thread-safe; one leaf lock).
+                await loop.run_in_executor(
+                    None, _watch_anomalies, anomaly_state
+                )
             if ring.draining:
                 logger.info("frontend %d: ring drain flag set; draining",
                             worker_id)
@@ -713,6 +814,11 @@ async def _run_frontend(
     await asyncio.get_running_loop().run_in_executor(
         None, server.close_tracer
     )
+    if server.flightrec is not None:
+        # Evidence-gated SIGTERM dump (a clean drain writes nothing).
+        await asyncio.get_running_loop().run_in_executor(
+            None, server.flightrec.dump_if_evidence, "sigterm"
+        )
     logger.info("frontend %d drained; exiting", worker_id)
 
 
@@ -722,11 +828,14 @@ def start_frontends(
     preprocess_path: str | list[str],
     trace: Any = None,
     tenancy: Any = None,
+    slo: Any = None,
 ) -> list[multiprocessing.Process]:
     """Fork one front-end process per worker (call BEFORE any jax backend
     initializes in the parent — the children inherit a clean world)."""
     return [
-        _respawn(config, ring, preprocess_path, worker_id, trace, tenancy)
+        _respawn(
+            config, ring, preprocess_path, worker_id, trace, tenancy, slo
+        )
         for worker_id in range(ring.workers)
     ]
 
@@ -828,6 +937,24 @@ def _engine_main(
         stats = ShapeStats()
         for eng in engines:
             eng.set_shape_stats(stats)
+    slo_cfg = getattr(config, "slo", None)
+    ledger = None
+    if slo_cfg is not None and slo_cfg.ledger_dir:
+        # Device-time cost ledger (slo/ledger.py): ONE per engine
+        # process, shared across the tenant fleet (entries key by
+        # entry + model fingerprint, so arch twins correctly share);
+        # sharded per replica on disk so concurrent flushes never
+        # clobber a sibling's totals.
+        from mlops_tpu.slo import CostLedger
+
+        ledger = CostLedger(
+            slo_cfg.ledger_dir,
+            flush_interval_s=slo_cfg.ledger_flush_s,
+            shard=f"r{replica}" if ring.replicas > 1 else "",
+        )
+        for eng in engines:
+            eng.set_cost_ledger(ledger)
+        logger.info("cost ledger armed -> %s", ledger.path)
     service = RingService(
         engines[0],
         ring,
@@ -839,6 +966,50 @@ def _engine_main(
         engines=engines,
         replica=replica,
     )
+    service.cost_ledger = ledger
+    if slo_cfg is not None and slo_cfg.enabled and replica == 0:
+        # SLO engine on the LEAD replica only (one writer for the shm
+        # alert rows; every replica reads the same fleet-wide counters
+        # anyway): evaluated each telemetry tick from the ring's shm
+        # request matrices, mirrored for the front ends' renders. The
+        # lifecycle breaker flags ride in from the life rows so a broken
+        # retrain path alerts through the same channel as a burn.
+        from mlops_tpu.serve.metrics import LIFE_BREAKER_OPEN
+        from mlops_tpu.slo import SLOEngine
+        from mlops_tpu.slo.engine import SLO_NAMES, read_slo_view
+
+        def _ring_breakers() -> dict:
+            return {
+                name: bool(ring.life_vals[t, LIFE_BREAKER_OPEN])
+                for t, name in enumerate(ring.tenant_names)
+            }
+
+        # Respawn-base seed (the ISSUE 11 monotone-counter discipline):
+        # a respawned engine's fresh evaluator re-baselines against the
+        # surviving shm request counters — seed it with the dead
+        # incarnation's last-published totals so slo_*_total never
+        # regresses across a respawn (first boot reads the zero view).
+        prev = read_slo_view(
+            ring.slo_vals, ring.alert_vals, tuple(ring.tenant_names),
+            tuple(float(x) for x in ring.slo_meta[:4]),
+        )
+        prior = {
+            name: (
+                prev[name]["slos"][SLO_NAMES[0]]["good"],
+                prev[name]["slos"][SLO_NAMES[0]]["total"],
+                prev[name]["slos"][SLO_NAMES[1]]["good"],
+                prev[name]["slos"][SLO_NAMES[1]]["total"],
+            )
+            for name in ring.tenant_names
+        }
+        service.slo = SLOEngine(
+            slo_cfg,
+            tuple(ring.tenant_names),
+            source=lambda: ring.slo_counts(slo_cfg.latency_threshold_ms),
+            breaker_source=_ring_breakers,
+            prior_counts=prior,
+        )
+        logger.info("sloscope armed (lead replica evaluator)")
     if serve_cfg.profile_dir and replica == 0:
         # /debug/profile: front ends forward start/stop through the
         # ring's single control word, answered by the LEAD replica (one
@@ -919,6 +1090,8 @@ def _engine_main(
         for _, controller in service._tenant_lifecycles():
             controller.stop()
         service.stop()
+        if ledger is not None:
+            ledger.close()  # final atomic flush
         logger.info("engine process drained; exiting")
     if rc:
         raise SystemExit(rc)
@@ -1065,6 +1238,17 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         ring.set_tracing(True)
     else:
         trace_cfg = None
+    slo_cfg = getattr(config, "slo", None)
+    if slo_cfg is not None and (slo_cfg.enabled or slo_cfg.ledger_dir):
+        # sloscope (mlops_tpu/slo/): validate + publish the SLO geometry
+        # into shm BEFORE the fork — front ends render the SLO/alert
+        # block (and label its windows) straight from the ring; the
+        # lead engine replica evaluates and mirrors (_engine_main).
+        slo_cfg.validate()
+        if slo_cfg.enabled:
+            ring.arm_slo(slo_cfg)
+    else:
+        slo_cfg = None
     # Reserve the port once (also resolves port=0), then hand the concrete
     # port to every child; the placeholder never listens, so the kernel
     # routes nothing to it.
@@ -1075,7 +1259,7 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
         serve_cfg, port=placeholder.getsockname()[1], max_batch=max_batch
     )
     procs = start_frontends(
-        child_cfg, ring, preprocess_paths, trace_cfg, tenancy
+        child_cfg, ring, preprocess_paths, trace_cfg, tenancy, slo_cfg
     )
     logger.info(
         "supervisor %d spawned %d front ends (pids %s) for %d tenant(s) %s",
@@ -1135,7 +1319,8 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
                     i, proc.pid, proc.exitcode,
                 )
                 procs[i] = _respawn(
-                    child_cfg, ring, preprocess_paths, i, trace_cfg, tenancy
+                    child_cfg, ring, preprocess_paths, i, trace_cfg,
+                    tenancy, slo_cfg,
                 )
             if engine_procs[-1] is None and ring.rep_ready[0]:
                 # Replica 0 is warm: its compiles are persisted, so the
@@ -1244,6 +1429,7 @@ def _respawn(
     worker_id: int,
     trace: Any = None,
     tenancy: Any = None,
+    slo: Any = None,
 ) -> multiprocessing.Process:
     """Fork a replacement front end for one worker slot partition (the
     generation counters in shm make any of the dead worker's in-flight
@@ -1253,7 +1439,7 @@ def _respawn(
     ctx = multiprocessing.get_context("fork")
     proc = ctx.Process(
         target=_frontend_main,
-        args=(worker_id, config, ring, preprocess_path, trace, tenancy),
+        args=(worker_id, config, ring, preprocess_path, trace, tenancy, slo),
         name=f"mlops-tpu-frontend-{worker_id}",
     )
     proc.start()

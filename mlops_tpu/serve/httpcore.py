@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import random
 import time
 import re
 import uuid
@@ -165,6 +166,12 @@ class HttpProtocol:
         self.tracer: Any = None
         self.trace_plane = "single"
         self.trace_worker = 0
+        # sloscope (mlops_tpu/slo/): the flight recorder, when the slo
+        # config section arms it, else None — one is-None check per
+        # request disarmed (the faultline discipline). Subclasses also
+        # override `_slo_view`/`_engine_down` so /healthz and the
+        # request hooks render their plane's fleet verdict.
+        self.flightrec: Any = None
         # Tenant routing (mlops_tpu/tenancy/): the ``x-tenant`` header
         # resolves to a tenant index through this router; subclasses
         # serving a multi-tenant fleet install their own. The default is
@@ -239,28 +246,38 @@ class HttpProtocol:
             # and the 413/deadline gates all behind us.
             span.rows = len(record_dicts)
             span.stamp("admission")
-        # Two layers keep log formatting off the hot path: isEnabledFor
-        # skips everything when the deployment silences INFO, and
-        # _LazyJson defers the dumps of the full payload to record-emit
-        # time (a filtered/sampled handler never serializes at all).
-        if logger.isEnabledFor(logging.INFO):
-            logger.info(
-                "%s",
-                _LazyJson(
-                    {
-                        "service_name": self.config.service_name,
-                        "type": "InferenceData",
-                        "request_id": request_id,
-                        "data": record_dicts,
-                    }
-                ),
-            )
+        # Three layers keep log formatting off the hot path: isEnabledFor
+        # skips everything when the deployment silences INFO, _LazyJson
+        # defers the dumps of the full payload to record-emit time, and
+        # serve.log_sample_rate (< 1.0) SAMPLES the two-event pair under
+        # overload — while non-200 outcomes are ALWAYS logged: an
+        # unsampled request that sheds/fails emits its InferenceData
+        # event post-hoc, so at rate 0.01 a shed burst still logs every
+        # 503 (errors are never sampled out of the evidence stream).
+        info_enabled = logger.isEnabledFor(logging.INFO)
+        rate = self.config.log_sample_rate
+        sampled = rate >= 1.0 or random.random() < rate
+        request_event = None
+        if info_enabled:
+            request_event = {
+                "service_name": self.config.service_name,
+                "type": "InferenceData",
+                "request_id": request_id,
+                "data": record_dicts,
+            }
+            if sampled:
+                logger.info("%s", _LazyJson(request_event))
         response = await self._score(
             record_dicts, request_id, deadline, span, tenant
         )
         if isinstance(response, tuple):
-            return response  # subclass error path, already wire-shaped
-        if logger.isEnabledFor(logging.INFO):
+            # Subclass error path (shed 503 / deadline 504 / failure
+            # 500), already wire-shaped: an unsampled request's evidence
+            # event is emitted NOW — non-200s always log.
+            if info_enabled and not sampled:
+                logger.info("%s", _LazyJson(request_event))
+            return response
+        if info_enabled and sampled:
             logger.info(
                 "%s",
                 _LazyJson(
@@ -293,6 +310,30 @@ class HttpProtocol:
 
     def _ready(self) -> bool:
         raise NotImplementedError
+
+    def _slo_view(self):
+        """The sloscope view dict for /healthz (`slo/engine` view shape):
+        the single-process server reads its in-process SLOEngine, ring
+        front ends read the shm mirror; None = sloscope disarmed (the
+        verdict then derives from readiness alone)."""
+        return None
+
+    def _engine_down(self) -> bool:
+        """True during a FULL engine outage (ring plane: every replica
+        down with the outage supervisor-stamped). The single-process
+        server's engine lives in-process — never down while answering."""
+        return False
+
+    async def _healthz(self):
+        """`GET /healthz` — the sloscope VERDICT endpoint (distinct from
+        the liveness/readiness probes): ok / degraded (an alert is
+        active; the body names them) / down (503). One wire shape for
+        both planes (`slo/engine.health_verdict`)."""
+        from mlops_tpu.slo.engine import health_verdict
+
+        return health_verdict(
+            self._slo_view(), self._ready(), engine_down=self._engine_down()
+        )
 
     async def _metrics_endpoint(self):
         raise NotImplementedError
@@ -449,6 +490,14 @@ class HttpProtocol:
                     self.metrics.observe_request(
                         route_path, status, latency_ms, tenant=tenant_bill
                     )
+                    if self.flightrec is not None:
+                        # Flight-recorder evidence ring (mlops_tpu/slo/):
+                        # one bounded append per request; 5xx feed its
+                        # spike trigger.
+                        self.flightrec.observe_request(
+                            route_path, status, latency_ms,
+                            tenant=tenant_bill, request_id=request_id,
+                        )
                     keep_alive = keep_alive and not self.draining
                     await self._write_response(
                         writer, status, payload, content_type, keep_alive,
@@ -463,7 +512,13 @@ class HttpProtocol:
                         # dropped, never finished: finish() must not race
                         # a concurrent stamp.
                         span.stamp("respond")
-                        self.tracer.record(span.finish(status))
+                        record = span.finish(status)
+                        self.tracer.record(record)
+                        if self.flightrec is not None:
+                            # With tracewire armed too, the dump's
+                            # timeline carries the offending spans, not
+                            # just their statuses.
+                            self.flightrec.note_span(record)
                 finally:
                     self._busy.discard(writer)
                 if not keep_alive:
@@ -577,6 +632,8 @@ class HttpProtocol:
                 if self._openapi is None:
                     self._openapi = build_openapi(self.config.service_name)
                 return 200, self._openapi, "application/json"
+            if path == "/healthz":
+                return await self._healthz()
             if path == "/healthz/live":
                 return 200, {"status": "alive"}, "application/json"
             if path == "/healthz/ready":

@@ -341,11 +341,19 @@ class RequestRing:
             TABLE_VALS,
         )
 
+        from mlops_tpu.slo.engine import N_ENGINE_ALERTS, SLO_FIELDS
+        from mlops_tpu.slo.ledger import (
+            TABLE_KEY_BYTES as LEDGER_KEY_BYTES,
+            TABLE_ROWS as LEDGER_ROWS,
+            TABLE_VALS as LEDGER_VALS,
+        )
+
         plan: list[tuple[str, np.dtype, tuple[int, ...]]] = [
             # control flags: [0] reserved (readiness moved to the
             # per-replica rep_ready words), [1] draining, [2] tracing
-            # armed (tracewire — gates every per-slot stamp store)
-            ("ctl", np.dtype(np.uint64), (3,)),
+            # armed (tracewire — gates every per-slot stamp store),
+            # [3] sloscope armed (gates the SLO/alert render block)
+            ("ctl", np.dtype(np.uint64), (4,)),
             # /debug/profile control words (front end -> engine): [0] the
             # request word (seq << 8 | action), [1] the acknowledgement
             # (seq << 16 | http status). Each word is ONE u64 store, so
@@ -456,6 +464,13 @@ class RequestRing:
             ("lat_counts", np.dtype(np.uint64), (workers, T, self._nb)),
             ("lat_sum_ms", np.dtype(np.float64), (workers, T)),
             ("lat_n", np.dtype(np.uint64), (workers, T)),
+            # /predict-scoped latency histogram for the sloscope latency
+            # SLO (ServingMetrics.predict_latency_counts' ring twin):
+            # the all-routes block above stays the exported histogram;
+            # the SLO must not let probe/scrape latencies dilute
+            # /predict violations. Single writer per worker row.
+            ("pred_lat_counts", np.dtype(np.uint64), (workers, T, self._nb)),
+            ("pred_lat_n", np.dtype(np.uint64), (workers, T)),
             ("shed", np.dtype(np.uint64), (workers, T, 2)),
             ("inflight", np.dtype(np.uint64), (workers, T, 2)),
             # quota rejections (admission refused by the tenant's own
@@ -479,6 +494,11 @@ class RequestRing:
             # tracewire spans each front end's bounded recorder DROPPED
             # (single writer per worker, like expired/shed)
             ("trace_dropped", np.dtype(np.uint64), (workers,)),
+            # sloscope flight-recorder dumps written by each front end
+            # (single writer per worker): the fleet-wide observable that
+            # an anomaly tripped evidence capture somewhere — scrape any
+            # worker, see every worker's dumps.
+            ("flight_dumps", np.dtype(np.uint64), (workers,)),
             # tracewire shape-histogram mirror (trace/shapes.py): the
             # engine's telemetry loop writes its ShapeStats into this
             # fixed table so ANY front end renders the _bucket series on
@@ -516,6 +536,29 @@ class RequestRing:
             # differences). One writer per cell, per row.
             ("eng_vals", np.dtype(np.float64), (R, 6)),
             ("eng_rows_tenant", np.dtype(np.float64), (R, T)),
+            # sloscope (ISSUE 14, mlops_tpu/slo/). slo_meta carries the
+            # armed SLO geometry (four burn windows + targets +
+            # latency threshold — written once by the supervisor at
+            # arm_slo, so any front end can label the window dimension
+            # without config plumbing); slo_vals/alert_vals are the
+            # per-tenant SLO state the LEAD replica's telemetry loop
+            # mirrors each tick (single writer; the write_monitor
+            # tearing contract). Front ends render fleet verdicts from
+            # these rows — during a full engine outage the gauges serve
+            # last-known values and the render raises engine_down
+            # itself.
+            ("slo_meta", np.dtype(np.float64), (8,)),
+            ("slo_vals", np.dtype(np.float64), (T, SLO_FIELDS)),
+            ("alert_vals", np.dtype(np.float64), (T, N_ENGINE_ALERTS)),
+            # device-time cost ledger mirror (slo/ledger.py), ONE TABLE
+            # PER REPLICA like the shape tables: ledger_meta[r] > 0 =
+            # replica r's ledger is armed and mirrored; the render
+            # merges by entry key.
+            ("ledger_meta", np.dtype(np.float64), (R,)),
+            ("ledger_keys", np.dtype(np.uint8),
+             (R, LEDGER_ROWS, LEDGER_KEY_BYTES)),
+            ("ledger_vals", np.dtype(np.float64),
+             (R, LEDGER_ROWS, LEDGER_VALS)),
             # lifecycle loop state, ONE ROW PER TENANT (single writer:
             # the engine process's per-tenant controller telemetry —
             # serve/metrics.py LIFE_* indices), so ANY front end renders
@@ -604,6 +647,51 @@ class RequestRing:
 
     def set_tracing(self, armed: bool) -> None:
         self.ctl[2] = 1 if armed else 0
+
+    @property
+    def slo_armed(self) -> bool:
+        return bool(self.ctl[3])
+
+    def arm_slo(self, slo_config) -> None:
+        """Supervisor-side (before fork): publish the SLO geometry so
+        every front end can render the block — window labels included —
+        without any config plumbing, and flip the armed flag that gates
+        the render."""
+        self.slo_meta[0] = float(slo_config.fast_short_s)
+        self.slo_meta[1] = float(slo_config.fast_long_s)
+        self.slo_meta[2] = float(slo_config.slow_short_s)
+        self.slo_meta[3] = float(slo_config.slow_long_s)
+        self.slo_meta[4] = float(slo_config.availability_target)
+        self.slo_meta[5] = float(slo_config.latency_target)
+        self.slo_meta[6] = float(slo_config.latency_threshold_ms)
+        self.ctl[3] = 1
+
+    def slo_counts(
+        self, latency_threshold_ms: float
+    ) -> dict[str, tuple[int, int, int, int]]:
+        """The sloscope counter source for the ring plane (the fleet
+        twin of `ServingMetrics.slo_counts`): per tenant, cumulative
+        ``(avail_good, avail_total, lat_good, lat_total)`` summed over
+        every worker's shm request matrices. Lock-free reads of
+        monotone counters — a read racing an increment under-counts by
+        at most one in-flight request, which the next tick absorbs."""
+        from mlops_tpu.serve.metrics import (
+            SLO_BAD_STATUSES,
+            latency_good_buckets,
+        )
+
+        route_i = _ROUTE_IDX["/predict"]
+        bad_cols = [_STATUS_IDX[s] for s in SLO_BAD_STATUSES]
+        k = latency_good_buckets(latency_threshold_ms)
+        out: dict[str, tuple[int, int, int, int]] = {}
+        for t, tenant in enumerate(self.tenant_names):
+            counts = self.req_counts[:, t, route_i, :]
+            total = int(counts.sum())
+            bad = int(counts[:, bad_cols].sum())
+            lat_good = int(self.pred_lat_counts[:, t, :k].sum())
+            lat_total = int(self.pred_lat_n[:, t].sum())
+            out[tenant] = (total - bad, total, lat_good, lat_total)
+        return out
 
     # ---------------------------------------------------- slot geometry
     def worker_slots(self, worker: int) -> tuple[range, range]:
@@ -951,6 +1039,13 @@ class ShmWorkerMetrics:
             if latency_ms <= edge:
                 ring.lat_counts[w, t, i] += 1
                 break
+        if route == "/predict":
+            # The latency-SLO scope (see the pred_lat_counts plan note).
+            ring.pred_lat_n[w, t] += 1
+            for i, edge in enumerate(self._buckets):
+                if latency_ms <= edge:
+                    ring.pred_lat_counts[w, t, i] += 1
+                    break
 
     def count_deadline_expired(self) -> None:
         """Front-end-side dead-work shed (admission/budget 504 before any
@@ -1373,6 +1468,15 @@ class RingService:
         # (serve/server.py JaxProfiler.control — set by serve_multi_worker
         # when serve.profile_dir is configured), None = 404.
         self.profiler: Any = None
+        # sloscope (ISSUE 14): the LEAD replica's telemetry loop ticks
+        # an attached `slo/engine.SLOEngine` (reading the fleet's shm
+        # request counters) and mirrors its view into the slo/alert
+        # rows; an attached `slo/ledger.CostLedger` mirrors into this
+        # replica's ledger table. Both attach after construction,
+        # before start() (engine-process wiring in _engine_main).
+        self.slo: Any = None
+        self.cost_ledger: Any = None
+        self._slo_last = 0.0  # telemetry-thread private tick clock
         self._prof_handled = 0  # collector-thread private
         self._requests_since_fetch = 0  # collector-thread private counter;
         # the telemetry thread only READS it (a torn read costs one fetch
@@ -1384,7 +1488,15 @@ class RingService:
             target=self._collect, name="ring-collector", daemon=True
         )
         self._collector.start()
-        if self._any_accumulating and self._mon_period > 0:
+        # The telemetry thread runs for EITHER consumer: the monitor
+        # mirror (accumulating engines with a nonzero cadence — the
+        # pre-sloscope condition) or sloscope's evaluator/ledger mirror,
+        # which must tick even when serve.monitor_fetch_every_s=0
+        # disables the monitor timer (an operator arming slo.enabled
+        # must never get a silently dead alert layer).
+        wants_monitor = self._any_accumulating and self._mon_period > 0
+        wants_slo = self.slo is not None or self.cost_ledger is not None
+        if wants_monitor or wants_slo:
             self._telemetry = threading.Thread(
                 target=self._telemetry_loop, name="ring-telemetry", daemon=True
             )
@@ -1412,6 +1524,8 @@ class RingService:
         self._write_lifecycle()
         self._write_robustness()
         self._write_shapes()
+        self._tick_slo(force=True)
+        self._write_ledger()
 
     # ------------------------------------------------------------ collect
     def _collect(self) -> None:
@@ -1878,12 +1992,19 @@ class RingService:
         ring requests accumulated or the T-second cadence lapses with
         traffic outstanding — the device is never fetched per request or
         per scrape."""
-        tick = min(0.25, self._mon_period)
+        # mon_period can be 0 here (monitor timer disabled, sloscope
+        # armed): the tick then floors at 0.25 s instead of busy-looping,
+        # and the monitor-fetch block below is skipped entirely.
+        tick = min(0.25, self._mon_period) if self._mon_period > 0 else 0.25
         last_fetch = time.monotonic()
         while not self._stop.wait(tick):
             self._write_lifecycle()
             self._write_robustness()
             self._write_shapes()
+            self._tick_slo()
+            self._write_ledger()
+            if not (self._any_accumulating and self._mon_period > 0):
+                continue
             due_k = self._mon_every and (
                 self._requests_since_fetch >= self._mon_every
             )
@@ -1915,6 +2036,41 @@ class RingService:
                         "ring monitor fetch failed (tenant %d); gauges "
                         "stale", t,
                     )
+
+    def _tick_slo(self, force: bool = False) -> None:
+        """One sloscope evaluation + shm mirror (LEAD replica only — the
+        rows have one writer, and the engine reads the same fleet-wide
+        shm counters from any replica anyway). Rate-limited to the
+        configured tick; ``force`` (the drain path) publishes the final
+        state regardless."""
+        slo = self.slo
+        if slo is None or self.replica != 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._slo_last < float(slo.config.tick_s):
+            return
+        self._slo_last = now
+        try:
+            slo.tick()
+            slo.write_rows(self.ring.slo_vals, self.ring.alert_vals)
+        # Telemetry breadth contract: an evaluator bug costs one tick of
+        # gauge freshness, never the telemetry thread.
+        except Exception:  # tpulint: disable=TPU201
+            logger.exception("slo tick failed; alert gauges stale")
+
+    def _write_ledger(self) -> None:
+        """Mirror this replica's cost-ledger totals into its shm table
+        (host counter reads + f64 stores, no device work) so any front
+        end's /metrics renders the entry_* series; the render merges
+        replica tables by entry key."""
+        ledger = self.cost_ledger
+        if ledger is None:
+            return
+        rep = self.replica
+        ledger.write_table(
+            self.ring.ledger_keys[rep], self.ring.ledger_vals[rep]
+        )
+        self.ring.ledger_meta[rep] = 1.0
 
     def _write_robustness(self) -> None:
         """Mirror the fleet's degraded-dispatch total into shm (host int

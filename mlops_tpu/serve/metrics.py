@@ -65,6 +65,63 @@ LIFE_OUTCOMES = ("promoted", "rejected", "rolled_back")
 
 DEFAULT_TENANT_LABEL = "default"
 
+# sloscope (mlops_tpu/slo/): the statuses that spend availability error
+# budget — every server-side failure in the closed ring set. 500 is the
+# failure contract, 503 the shed (a shed request is not goodput — the
+# fleet-goodput framing), 504 the deadline expiry.
+SLO_BAD_STATUSES = (500, 503, 504)
+
+_BUILD_INFO_LINES: list[str] | None = None
+
+
+def build_info_lines() -> list[str]:
+    """``mlops_tpu_build_info{version,jax,jaxlib,backend}`` — the
+    standard fleet-inventory gauge (value 1, identity in the labels),
+    emitted by BOTH planes' renders.
+
+    Computed once, WITHOUT importing jax: the ring front ends are
+    jax-free by construction, so the jax/jaxlib versions come from
+    installed-package metadata and ``backend`` is the CONFIGURED
+    platform (the first JAX_PLATFORMS entry, or "default" for
+    jax's own resolution) — identical label sets across planes by
+    construction, which is what makes the series joinable fleet-wide."""
+    global _BUILD_INFO_LINES
+    if _BUILD_INFO_LINES is None:
+        import importlib.metadata
+        import os
+
+        from mlops_tpu.version import __version__
+
+        def _pkg(name: str) -> str:
+            try:
+                return importlib.metadata.version(name)
+            except importlib.metadata.PackageNotFoundError:
+                return "absent"
+
+        backend = (
+            os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+            or "default"
+        )
+        _BUILD_INFO_LINES = [
+            "# TYPE mlops_tpu_build_info gauge",
+            f'mlops_tpu_build_info{{backend="{backend}",'
+            f'jax="{_pkg("jax")}",jaxlib="{_pkg("jaxlib")}",'
+            f'version="{__version__}"}} 1',
+        ]
+    return list(_BUILD_INFO_LINES)
+
+
+def latency_good_buckets(threshold_ms: float) -> int:
+    """How many histogram buckets count as "good" for the latency SLO:
+    the smallest edge >= the configured threshold is the EFFECTIVE
+    threshold (the histogram is the only latency source both planes
+    share)."""
+    buckets = ServingMetrics.LATENCY_BUCKETS
+    for i, edge in enumerate(buckets):
+        if edge >= threshold_ms:
+            return i + 1
+    return len(buckets)
+
 
 def _zero_monitor_block() -> dict:
     return {
@@ -95,6 +152,14 @@ class ServingMetrics:
         }
         self.latency_sum_ms: dict[str, float] = defaultdict(float)
         self.latency_n: dict[str, int] = defaultdict(int)
+        # /predict-scoped latency histogram for the sloscope latency SLO
+        # (same buckets): the all-routes histogram above stays the
+        # exported series, but an SLO computed over it would let fast
+        # probe/scrape traffic DILUTE /predict violations — a plane
+        # whose every user-facing request breaks the threshold could
+        # still read healthy. Two extra increments per predict.
+        self.predict_latency_counts: dict[str, list[int]] = {}
+        self.predict_latency_n: dict[str, int] = defaultdict(int)
         # tenant label -> monitor aggregate block (rows/outliers/batches/
         # drift gauges). The default tenant's block always exists so the
         # zero baseline stays exported (chaos-smoke monotonicity).
@@ -117,6 +182,10 @@ class ServingMetrics:
         # scrape; stays 0 (and still exported) with tracing disarmed so
         # the chaos smoke's monotonicity check covers it.
         self.trace_dropped = 0
+        # sloscope flight-recorder dumps (mlops_tpu/slo/flightrec.py) —
+        # mirrored from the recorder per scrape; same zero-baseline
+        # contract as trace_dropped.
+        self.flight_dumps = 0
         # Lifecycle gauges (mlops_tpu/lifecycle/), per tenant: empty until
         # a controller installs a snapshot — the series are only exported
         # when a loop is actually running, so a loop-less deployment's
@@ -128,6 +197,7 @@ class ServingMetrics:
     KNOWN_ROUTES = (
         "/predict",
         "/",
+        "/healthz",
         "/healthz/live",
         "/healthz/ready",
         "/metrics",
@@ -155,6 +225,17 @@ class ServingMetrics:
                 if latency_ms <= edge:
                     counts[i] += 1
                     break
+            if route == "/predict":
+                pcounts = self.predict_latency_counts.get(tenant)
+                if pcounts is None:
+                    pcounts = self.predict_latency_counts[tenant] = (
+                        [0] * len(self.LATENCY_BUCKETS)
+                    )
+                self.predict_latency_n[tenant] += 1
+                for i, edge in enumerate(self.LATENCY_BUCKETS):
+                    if latency_ms <= edge:
+                        pcounts[i] += 1
+                        break
 
     def _monitor_block(self, tenant: str) -> dict:
         block = self.monitor.get(tenant)
@@ -203,6 +284,33 @@ class ServingMetrics:
         with self._lock:
             self.lifecycle[tenant] = dict(snapshot)
 
+    def slo_counts(
+        self, latency_threshold_ms: float, tenants: tuple[str, ...]
+    ) -> dict[str, tuple[int, int, int, int]]:
+        """The sloscope counter source (`slo/engine.SLOEngine`): per
+        tenant, cumulative ``(avail_good, avail_total, lat_good,
+        lat_total)``. BOTH dimensions are ``/predict``-scoped — the
+        serving SLO; probe/scrape traffic must never dilute it. A
+        status in ``SLO_BAD_STATUSES`` spends availability budget;
+        latency counts the predict-scoped histogram against the
+        effective threshold bucket."""
+        k = latency_good_buckets(latency_threshold_ms)
+        out: dict[str, tuple[int, int, int, int]] = {}
+        with self._lock:
+            for tenant in tenants:
+                total = bad = 0
+                for (route, status, t), count in self.requests.items():
+                    if route != "/predict" or t != tenant:
+                        continue
+                    total += count
+                    if status in SLO_BAD_STATUSES or status >= 500:
+                        bad += count
+                counts = self.predict_latency_counts.get(tenant)
+                lat_good = sum(counts[:k]) if counts else 0
+                lat_total = self.predict_latency_n.get(tenant, 0)
+                out[tenant] = (total - bad, total, lat_good, lat_total)
+        return out
+
     def count_deadline_expired(self) -> None:
         """One dead-work shed: a request answered the documented 504
         WITHOUT its work dispatching (admission check, batcher purge)."""
@@ -221,9 +329,18 @@ class ServingMetrics:
         with self._lock:
             self.trace_dropped = int(total)
 
+    def set_flight_dumps(self, total: int) -> None:
+        """Mirror the flight recorder's landed-dump counter (an absolute
+        total — `slo/flightrec.FlightRecorder`)."""
+        with self._lock:
+            self.flight_dumps = int(total)
+
     @staticmethod
     def robustness_lines(
-        deadline_expired: int, degraded: int, trace_dropped: int = 0
+        deadline_expired: int,
+        degraded: int,
+        trace_dropped: int = 0,
+        flight_dumps: int = 0,
     ) -> list[str]:
         """The robustness counter block — ONE definition shared by the
         single-process render and the ring render, so both telemetry
@@ -236,6 +353,10 @@ class ServingMetrics:
             f"mlops_tpu_degraded_dispatch_total {int(degraded)}",
             "# TYPE mlops_tpu_trace_dropped_total counter",
             f"mlops_tpu_trace_dropped_total {int(trace_dropped)}",
+            # Flight-recorder dumps landed (sloscope): nonzero means an
+            # anomaly tripped evidence capture — go read runs/.
+            "# TYPE mlops_tpu_flightrec_dumps_total counter",
+            f"mlops_tpu_flightrec_dumps_total {int(flight_dumps)}",
         ]
 
     @staticmethod
@@ -335,9 +456,8 @@ class ServingMetrics:
         ``tenant`` label (constant "default" on a single-tenant plane,
         so pre-tenancy dashboards parse unchanged)."""
         with self._lock:
-            lines = [
-                "# TYPE mlops_tpu_requests_total counter",
-            ]
+            lines = build_info_lines()
+            lines.append("# TYPE mlops_tpu_requests_total counter")
             for (route, status, tenant), count in sorted(
                 self.requests.items(), key=lambda kv: (kv[0][2],) + kv[0][:2]
             ):
@@ -427,6 +547,7 @@ class ServingMetrics:
                     self.deadline_expired,
                     self.degraded_dispatches,
                     self.trace_dropped,
+                    self.flight_dumps,
                 )
             )
             # Single-process plane: the engine lives in THIS process, so
@@ -457,7 +578,8 @@ def render_ring_metrics(ring) -> str:
     routes = ServingMetrics.KNOWN_ROUTES + ("<other>",)
     buckets = ServingMetrics.LATENCY_BUCKETS
     tenants = tuple(getattr(ring, "tenant_names", ("default",)))
-    lines = ["# TYPE mlops_tpu_requests_total counter"]
+    lines = build_info_lines()
+    lines.append("# TYPE mlops_tpu_requests_total counter")
     for w in range(ring.workers):
         for t, tenant in enumerate(tenants):
             for r_i, route in enumerate(routes):
@@ -634,6 +756,7 @@ def render_ring_metrics(ring) -> str:
             + int(ring.rob_vals[:, ROB_EXPIRED_ENGINE].sum()),
             int(ring.rob_vals[:, ROB_DEGRADED].sum()),
             int(ring.trace_dropped.sum()),
+            sum(int(x) for x in getattr(ring, "flight_dumps", ())),
         )
     )
     # Engine-survivability block (ISSUE 11): per-replica rows summed
@@ -717,6 +840,46 @@ def render_ring_metrics(ring) -> str:
         )
         elapsed = time.monotonic() - min(metas[r] for r in armed)
         lines.extend(render_entries_lines(entries, elapsed))
+    if getattr(ring, "slo_armed", False):
+        # sloscope (mlops_tpu/slo/): the SLO/alert block the LEAD engine
+        # replica's telemetry loop last mirrored into shm — rendered by
+        # ANY front end, so during a full engine outage the gauges serve
+        # last-known values (rows never written render the zero
+        # baseline) and the scrape NEVER errors. ``engine_down`` is
+        # computed HERE, by whoever answers the scrape: a dead engine
+        # cannot raise its own alert.
+        from mlops_tpu.slo.engine import read_slo_view, render_slo_lines
+
+        engine_down = not ring.engine_ready and bool(
+            (ring.eng_vals[:, ENG_DOWN_SINCE] > 0).any()
+        )
+        view = read_slo_view(
+            ring.slo_vals,
+            ring.alert_vals,
+            tenants,
+            tuple(float(x) for x in ring.slo_meta[:4]),
+        )
+        lines.extend(render_slo_lines(view, engine_down=engine_down))
+    led_metas = [float(m) for m in getattr(ring, "ledger_meta", [])]
+    if any(m > 0 for m in led_metas):
+        # Device-time cost ledger (slo/ledger.py), mirrored per replica
+        # by the telemetry loop and MERGED by entry key at render — the
+        # same series names the single-process render emits from its
+        # in-process ledger.
+        from mlops_tpu.slo.ledger import (
+            merge_entries as merge_ledger_entries,
+            read_table as read_ledger_table,
+            render_entry_lines,
+        )
+
+        entries = merge_ledger_entries(
+            [
+                read_ledger_table(ring.ledger_keys[r], ring.ledger_vals[r])
+                for r in range(R)
+                if led_metas[r] > 0
+            ]
+        )
+        lines.extend(render_entry_lines(entries))
     for t, tenant in enumerate(tenants):
         if not ring.life_vals[t, LIFE_HAS]:
             continue

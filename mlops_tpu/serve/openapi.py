@@ -168,6 +168,27 @@ def build_openapi(service_name: str) -> dict[str, Any]:
                     },
                 }
             },
+            "/healthz": {
+                "get": {
+                    "summary": "SLO verdict (sloscope)",
+                    "responses": {
+                        "200": {
+                            "description": (
+                                "Serving: verdict 'ok', or 'degraded' "
+                                "with the active alerts named (a "
+                                "burning error budget means look, not "
+                                "pull the instance)."
+                            )
+                        },
+                        "503": {
+                            "description": (
+                                "verdict 'down': full engine outage or "
+                                "never-ready."
+                            )
+                        },
+                    },
+                }
+            },
             "/healthz/live": {
                 "get": {
                     "summary": "Liveness probe",

@@ -164,6 +164,13 @@ class HttpServer(HttpProtocol):
             max_workers=max_workers, thread_name_prefix="predict"
         )
         self._profiler = JaxProfiler(config.profile_dir)
+        # sloscope (mlops_tpu/slo/), armed by _serve when slo.enabled:
+        # the SLO engine ticks on its own timer task (start()) against
+        # this server's ServingMetrics counters; the cost ledger renders
+        # on scrapes. Both None = every hook is one is-None check.
+        self.slo_engine = None
+        self.cost_ledger = None
+        self._slo_task: asyncio.Task | None = None
         # Device-resident monitor aggregate telemetry (serve/engine.py
         # monitor_snapshot): the request path only counts requests; the
         # aggregate is fetched OFF the hot path — after K requests, on the
@@ -276,6 +283,8 @@ class HttpServer(HttpProtocol):
         )
         if self.tracer is not None:
             self.metrics.set_trace_dropped(self.tracer.dropped)
+        if self.flightrec is not None:
+            self.metrics.set_flight_dumps(self.flightrec.landed)
         text = self.metrics.render()
         shape_stats = getattr(self.engine, "shape_stats", None)
         if shape_stats is not None:
@@ -284,7 +293,26 @@ class HttpServer(HttpProtocol):
             lines = shape_stats.render_lines()
             if lines:
                 text += "\n".join(lines) + "\n"
+        if self.slo_engine is not None:
+            # Fresh SLO/alert gauges per scrape (an extra tick is cheap
+            # host arithmetic; the timer task keeps them fresh between
+            # scrapes too) — same series names as the ring render's shm
+            # block. engine_down is structurally False here: the engine
+            # lives in THIS process.
+            self.slo_engine.tick()
+            text += "\n".join(self.slo_engine.render_lines()) + "\n"
+        if self.cost_ledger is not None:
+            lines = self.cost_ledger.render_lines()
+            if lines:
+                text += "\n".join(lines) + "\n"
         return 200, text, "text/plain; version=0.0.4"
+
+    def _slo_view(self):
+        # /healthz verdict source (httpcore._healthz): the in-process
+        # engine's current view.
+        if self.slo_engine is None:
+            return None
+        return self.slo_engine.view()
 
     async def _profile(self, action: str):
         """On-demand device tracing (SURVEY.md SS5.1: the reference has no
@@ -485,11 +513,30 @@ class HttpServer(HttpProtocol):
                 self._spawn_monitor_fetch()
 
     # ------------------------------------------------------------ lifecycle
+    async def _slo_timer(self) -> None:
+        """The sloscope evaluation cadence (slo.tick_s): burn rates and
+        alert transitions advance even when nobody scrapes — the alert
+        contract ("flips within two ticks") and the flight recorder's
+        alert trigger both ride this task."""
+        period = self.slo_engine.config.tick_s
+        while True:
+            await asyncio.sleep(period)
+            try:
+                self.slo_engine.tick()
+            # An evaluator bug costs one tick of gauge freshness, never
+            # the timer task (logged; the next tick retries).
+            except Exception:  # tpulint: disable=TPU201
+                logger.exception("slo tick failed; alert gauges stale")
+
     async def start(self) -> asyncio.AbstractServer:
         if self._monitor_accumulating and self.config.monitor_fetch_every_s > 0:
             # Strong ref: a bare create_task could be garbage-collected.
             self._monitor_timer_task = asyncio.get_running_loop().create_task(
                 self._monitor_timer()
+            )
+        if self.slo_engine is not None:
+            self._slo_task = asyncio.get_running_loop().create_task(
+                self._slo_timer()
             )
         return await asyncio.start_server(
             self.handle_connection, self.config.host, self.config.port
@@ -500,7 +547,9 @@ class HttpServer(HttpProtocol):
         fetch on shutdown: left pending, asyncio logs 'Task was destroyed
         but it is pending!' on every clean rollout and the leaked task
         keeps the engine alive in start/stop test harnesses."""
-        for task in (self._monitor_timer_task, self._monitor_task):
+        for task in (
+            self._monitor_timer_task, self._monitor_task, self._slo_task
+        ):
             if task is not None and not task.done():
                 task.cancel()
 
@@ -511,8 +560,74 @@ async def _serve(
     lifecycle=None,
     trace=None,
     registry=None,
+    slo=None,
 ) -> None:
     server = HttpServer(engine, config, lifecycle=lifecycle, registry=registry)
+    flightrec = None
+    ledger = None
+    if slo is not None and (slo.enabled or slo.ledger_dir):
+        # sloscope (mlops_tpu/slo/): SLO engine + flight recorder when
+        # slo.enabled; the cost ledger arms independently off
+        # slo.ledger_dir (autotuner input, not alerting). Disabled, every
+        # hot path keeps its is-None check.
+        slo.validate()
+        if slo.enabled:
+            from mlops_tpu.slo import FlightRecorder, SLOEngine
+
+            tenant_names = tuple(server.tenants.names)
+            if slo.flightrec_enabled:
+                flightrec = FlightRecorder(
+                    slo.flightrec_dir,
+                    capacity=slo.flightrec_capacity,
+                    cooldown_s=slo.flightrec_cooldown_s,
+                    keep=slo.flightrec_keep,
+                    source="single",
+                    spike_errors=slo.flightrec_spike_errors,
+                    spike_window_s=slo.flightrec_spike_window_s,
+                )
+                server.flightrec = flightrec
+
+            def _breakers() -> dict:
+                # The lifecycle circuit breaker surfaces as an alert
+                # (and therefore a flight-recorder trigger): host dict
+                # reads under each controller's own leaf lock.
+                out = {}
+                for label, controller in server._tenant_lifecycles():
+                    try:
+                        snapshot = controller.metrics_snapshot()
+                        out[label] = bool(snapshot.get("breaker_open"))
+                    except Exception:  # tpulint: disable=TPU201
+                        logger.exception(
+                            "breaker probe failed (tenant %r)", label
+                        )
+                return out
+
+            server.slo_engine = SLOEngine(
+                slo,
+                tenant_names,
+                source=lambda: server.metrics.slo_counts(
+                    slo.latency_threshold_ms, tenant_names
+                ),
+                breaker_source=_breakers,
+                on_alert=(
+                    flightrec.note_alert if flightrec is not None else None
+                ),
+            )
+            logger.info(
+                "sloscope armed (availability %.4f, latency %.4f @ %gms)",
+                slo.availability_target, slo.latency_target,
+                slo.latency_threshold_ms,
+            )
+        if slo.ledger_dir:
+            from mlops_tpu.slo import CostLedger
+
+            ledger = CostLedger(
+                slo.ledger_dir, flush_interval_s=slo.ledger_flush_s
+            )
+            server.cost_ledger = ledger
+            for eng in server.engines:
+                eng.set_cost_ledger(ledger)
+            logger.info("cost ledger armed -> %s", ledger.path)
     tracer = None
     if trace is not None and trace.enabled:
         # tracewire (mlops_tpu/trace/): spans to <trace.dir>/spans.jsonl,
@@ -599,6 +714,16 @@ async def _serve(
         srv.close()
         for w in list(server._connections - server._busy):
             w.close()  # idle readline() sees EOF; handler exits
+        if flightrec is not None:
+            # Evidence-gated: a drain during an incident preserves the
+            # ring's tail; a clean drain writes nothing (the serve-smoke
+            # zero-dump contract). Executor, like every other dump site:
+            # the busy exchanges this drain is letting finish must not
+            # stall behind a disk write (asyncio.run's shutdown joins the
+            # executor, so the dump always completes before exit).
+            loop.run_in_executor(
+                None, flightrec.dump_if_evidence, "sigterm"
+            )
 
     try:
         loop.add_signal_handler(signal.SIGTERM, _drain, signal.SIGTERM)
@@ -614,6 +739,12 @@ async def _serve(
         await srv.serve_forever()
     except asyncio.CancelledError:
         pass
+    except BaseException:
+        if flightrec is not None:
+            # Fatal server-loop failure: preserve the ring's last N
+            # seconds unconditionally — this dump IS the post-mortem.
+            flightrec.dump("fatal")
+        raise
     finally:
         srv.close()
         server.stop_telemetry()
@@ -647,6 +778,10 @@ async def _serve(
             # writer thread — run it in the executor so the final flush
             # never blocks the event loop.
             await loop.run_in_executor(None, tracer.close)
+        if ledger is not None:
+            # Final atomic flush of the cost ledger (close joins its
+            # writer thread — executor, same reason as the tracer).
+            await loop.run_in_executor(None, ledger.close)
     if warmup_error:
         raise SystemExit(f"warmup failed: {warmup_error[0]}")
 
@@ -657,6 +792,7 @@ def serve_forever(
     lifecycle=None,
     trace=None,
     registry=None,
+    slo=None,
 ) -> None:
     """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`).
     ``lifecycle`` is an optional `LifecycleController` (or a per-tenant
@@ -670,6 +806,6 @@ def serve_forever(
     asyncio.run(
         _serve(
             engine, config, lifecycle=lifecycle, trace=trace,
-            registry=registry,
+            registry=registry, slo=slo,
         )
     )

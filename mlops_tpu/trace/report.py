@@ -19,11 +19,26 @@ from mlops_tpu.utils.timing import percentile
 
 
 def load_spans(path: str | Path) -> list[dict[str, Any]]:
-    """Every parseable span record under ``path`` (a trace dir or a
-    single JSONL file). Non-span records (kind="stage") and torn/garbage
+    """Every parseable span record under ``path`` — a trace dir (all its
+    ``spans*.jsonl``, so a multi-worker plane's per-worker files
+    aggregate as ONE trace set with no manual concatenation), a glob
+    pattern (``traces/spans-w*.jsonl`` — cross-directory sweeps), or a
+    single JSONL file. Non-span records (kind="stage") and torn/garbage
     lines are skipped — the report must work on a file mid-append."""
+    import glob as _glob
+
+    raw = str(path)
     path = Path(path)
-    files = sorted(path.glob("spans*.jsonl")) if path.is_dir() else [path]
+    if path.is_dir():
+        files = sorted(path.glob("spans*.jsonl"))
+    elif not path.exists() and any(c in raw for c in "*?["):
+        # Glob form — only when the LITERAL path does not exist, so a
+        # real directory/file whose name happens to contain bracket
+        # characters keeps loading directly instead of being parsed as
+        # a character class that matches nothing.
+        files = [Path(f) for f in sorted(_glob.glob(raw))]
+    else:
+        files = [path]
     spans: list[dict[str, Any]] = []
     for file in files:
         try:

@@ -288,6 +288,20 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
             # RESPAWN in the kill scenario warm-starts by deserializing,
             # which is what keeps the brownout window tight.
             f"cache.dir={os.path.join(tmp, 'chaos-serve-cache')}",
+            # sloscope (ISSUE 14), DRILL-TUNED: seconds-scale burn
+            # windows, a 0.5 s tick, and a burn threshold of 1.0 so the
+            # stall scenario's seeded 504s provably cross it — the
+            # acceptance is alert_active flipping within two ticks and
+            # a flight-recorder dump whose timeline carries the
+            # offending spans (tracewire armed for exactly that).
+            "slo.enabled=true", "slo.tick_s=0.5",
+            "slo.fast_burn_threshold=1.0", "slo.slow_burn_threshold=1.0",
+            "slo.fast_short_s=10", "slo.fast_long_s=30",
+            "slo.slow_short_s=45", "slo.slow_long_s=90",
+            "slo.flightrec_cooldown_s=2",
+            f"slo.flightrec_dir={os.path.join(tmp, 'flightrec')}",
+            "trace.enabled=true",
+            f"trace.dir={os.path.join(tmp, 'chaos-traces')}",
         ],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -351,6 +365,73 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         )
         print(f"# chaos-smoke: engine stall OK ({got_504} deadline 504s "
               f"in {len(statuses)} budgeted requests)", flush=True)
+
+        # ---- scenario: the 504 storm burns the error budget ----------
+        # (ISSUE 14 acceptance) The stall's 504s must flip
+        # mlops_tpu_alert_active within two evaluation ticks
+        # (tick_s=0.5 -> allow 2 ticks + one watchdog pass of margin for
+        # the scrape itself), and a front end watching the shm alert
+        # flags must drop a flight-recorder dump whose timeline carries
+        # the offending 504 evidence (spans included — tracewire armed).
+        alert_deadline = time.time() + 10.0
+        burn_alert_on = False
+        while time.time() < alert_deadline and not burn_alert_on:
+            status, text = get(f"http://127.0.0.1:{port}/metrics", 15)
+            assert status == 200
+            burn_alert_on = any(
+                line.startswith(
+                    'mlops_tpu_alert_active{alert="availability_fast_burn"'
+                ) and line.endswith(" 1")
+                for line in text.decode().splitlines()
+            )
+            if not burn_alert_on:
+                time.sleep(0.5)
+        assert burn_alert_on, (
+            "availability_fast_burn never flipped after the 504 storm"
+        )
+        status, text = get(f"http://127.0.0.1:{port}/healthz", 15)
+        verdict = json.loads(text)
+        assert status == 200 and verdict["verdict"] == "degraded", verdict
+        dump_deadline = time.time() + 15.0
+        flightrec_dir = os.path.join(tmp, "flightrec")
+        offending = None
+        while time.time() < dump_deadline and offending is None:
+            names = (
+                sorted(os.listdir(flightrec_dir))
+                if os.path.isdir(flightrec_dir) else []
+            )
+            for name in names:
+                path = os.path.join(flightrec_dir, name)
+                try:
+                    dump = json.loads(open(path).read())
+                except (OSError, ValueError):
+                    continue  # a dump mid-rename; the next pass reads it
+                has_504 = any(
+                    e.get("status") == 504
+                    for e in dump.get("events", [])
+                    if e.get("kind") in ("request", "span")
+                )
+                has_span = any(
+                    e.get("kind") == "span" and e.get("status") == 504
+                    for e in dump.get("events", [])
+                )
+                if has_504 and has_span:
+                    offending = path
+                    break
+            if offending is None:
+                time.sleep(0.5)
+        assert offending is not None, (
+            "no flight-recorder dump carrying the offending 504 spans"
+        )
+        # The CLI renders it (timeline includes the 504 evidence).
+        render = subprocess.run(
+            [sys.executable, "-m", "mlops_tpu", "flightrec", offending],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert render.returncode == 0, render.stderr[-1000:]
+        assert "504" in render.stderr
+        print(f"# chaos-smoke: burn alert + flight dump OK ({offending})",
+              flush=True)
 
         # ---- wire-contract probes ------------------------------------
         status, _, _ = raw_predict(port, json.dumps([RECORD] * 9).encode())

@@ -96,6 +96,7 @@ def main() -> int:
 
     port = free_port()
     trace_dir = os.path.join(tmp, "traces")
+    flightrec_dir = os.path.join(tmp, "flightrec")
     server = subprocess.Popen(
         [
             sys.executable, "-m", "mlops_tpu", "serve", "--workers", "2",
@@ -103,6 +104,15 @@ def main() -> int:
             f"serve.model_directory={bundle}",
             "serve.warmup_batch_sizes=1,8", "serve.max_batch=8",
             "trace.enabled=true", f"trace.dir={trace_dir}",
+            # sloscope armed: the clean-run contract is ZERO alerts
+            # fired and ZERO flight-recorder dumps written across the
+            # whole smoke (ISSUE 14). Availability keeps production
+            # thresholds; the latency threshold widens to a CI-box
+            # bound (a loaded runner's first-request latency must not
+            # flake the zero-alert assertion — latency SLOs are tuned
+            # per deployment, availability is the invariant here).
+            "slo.enabled=true", "slo.latency_threshold_ms=250",
+            f"slo.flightrec_dir={flightrec_dir}",
         ],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -169,6 +179,26 @@ def main() -> int:
         assert "mlops_tpu_requests_total" in text
         print("# serve-smoke: /metrics shows both workers", flush=True)
 
+        # sloscope (ISSUE 14): the SLO/alert block is exported, the
+        # build-info inventory gauge is present, and on a CLEAN plane
+        # every alert_active sample is 0.
+        assert "mlops_tpu_build_info{" in text
+        assert 'mlops_tpu_slo_total{slo="availability"' in text
+        alert_samples = [
+            line for line in text.splitlines()
+            if line.startswith("mlops_tpu_alert_active{")
+        ]
+        assert alert_samples, "alert_active series missing"
+        firing = [line for line in alert_samples
+                  if not line.endswith(" 0")]
+        assert not firing, f"clean run fired alerts: {firing}"
+        # /healthz verdict endpoint: a clean serving plane says "ok".
+        status, body = get(f"http://127.0.0.1:{port}/healthz", 30)
+        verdict = json.loads(body)
+        assert status == 200 and verdict["verdict"] == "ok", verdict
+        print("# serve-smoke: sloscope clean (zero alerts, verdict ok)",
+              flush=True)
+
         # Kill -9 one front end: the supervisor (thread-free and
         # jax-free, so its forks never cross jax threads) must respawn
         # it and the plane must keep serving.
@@ -232,6 +262,16 @@ def main() -> int:
         assert {"smoke-trace-0", "smoke-trace-1"} <= smoke_ids, smoke_ids
         print(f"# serve-smoke: {len(spans)} spans parsed clean from "
               f"{len(span_files)} worker files", flush=True)
+        # sloscope zero-dump contract: a clean run (even one that
+        # SIGKILLed a front end and drained on SIGTERM) writes NO
+        # flight-recorder dumps — dumps are anomaly evidence, not noise.
+        dumps = (
+            os.listdir(flightrec_dir)
+            if os.path.isdir(flightrec_dir) else []
+        )
+        assert not dumps, f"clean run wrote flight-recorder dumps: {dumps}"
+        print("# serve-smoke: zero flight-recorder dumps (clean plane)",
+              flush=True)
         print("# serve-smoke: OK (clean drain, zero leaked tasks)",
               flush=True)
         return 0
