@@ -1859,6 +1859,33 @@ def _lifecycle_stage(engine, bundle, record) -> dict:
     return out
 
 
+def _analysis_stage() -> dict:
+    """Wall time of the full static gate (Layers 1+3+4 plus the
+    suppression audit; ``--no-trace`` keeps device work out of it). The
+    analyzer is framework code too: a Layer-4 pass that quietly goes
+    quadratic on the project graph is a CI-latency regression, and this
+    key makes it visible in the BENCH_* trajectory like any other
+    number."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    start = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "mlops_tpu", "analyze", "--no-trace",
+         "--strict", "--concurrency", "--contracts", "--fail-stale",
+         os.path.join(repo, "mlops_tpu")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600,
+        cwd=repo,
+    )
+    out = {"analysis_wall_s": round(time.perf_counter() - start, 2)}
+    if proc.returncode != 0:
+        out["analysis_gate_error"] = (
+            f"exit {proc.returncode}: "
+            + proc.stdout.decode(errors="replace").strip()[-300:]
+        )
+    return out
+
+
 def _wait_port(port: int, timeout: float = 30.0) -> None:
     import socket as _socket
 
@@ -2102,6 +2129,11 @@ def main() -> None:
         lifecycle = _lifecycle_stage(engine, bundle, record)
     except Exception as err:
         lifecycle = {"lifecycle_error": f"{type(err).__name__}: {err}"}
+    _note("static-analysis gate timing")
+    try:
+        analysis = _analysis_stage()
+    except Exception as err:
+        analysis = {"analysis_stage_error": f"{type(err).__name__}: {err}"}
     _note("stages complete")
 
     p50 = batch1["p50_ms"]
@@ -2124,6 +2156,7 @@ def main() -> None:
                 **coldstart,
                 **http,
                 **lifecycle,
+                **analysis,
                 "device": str(device),
                 "model": family if ensemble == 1 else f"{family}-ens{ensemble}",
                 # Training throughput for the bundle above (data gen +

@@ -1,4 +1,4 @@
-"""tpulint — two-layer static analysis for the TPU hot paths.
+"""tpulint — four-layer static analysis for the TPU hot paths.
 
 The production path (train -> register -> serve -> monitor) only hits its
 latency/goodput targets while the compiled hot paths STAY compiled: one
@@ -27,12 +27,20 @@ package keeps the codebase honest on every PR:
   swaps real locks for instrumented wrappers in tests: per-thread
   acquisition stacks asserted against the same declared order, lock-wait
   accounting (bench's ``lock_wait_ms``), and seeded schedule perturbation.
+- **Layer 4** (`contracts` + `seriesreg`): cross-process CONTRACT rules,
+  analyzed project-wide rather than per file — shm ring fields checked
+  against the declared writer-role manifest (``TPULINT_SHM_OWNERSHIP``),
+  the Prometheus series surface extracted from both renderer planes and
+  checked for parity, bounded labels, alert-rule references and docs
+  coverage, config knobs that validate but are never read (the PR 13
+  ``replica_affinity_slack`` class), and fault points without a fire
+  site. Pure ``ast``, opt-in via ``analyze --contracts`` (CI runs it).
 
 The suppression ledger stays honest via ``analyze --list-suppressions``
 (every ``# tpulint: disable`` with live/stale status) and ``--fail-stale``
 (stale ones gate as TPU400).
 
-CLI: ``mlops-tpu analyze [--strict] [--concurrency] [paths ...]``
+CLI: ``mlops-tpu analyze [--strict] [--concurrency] [--contracts] [paths ...]``
 (`analysis/cli.py`); CI runs it as a gate before pytest. Suppress a
 finding inline with ``# tpulint: disable=TPU101`` (see
 `docs/static-analysis.md`).
@@ -47,14 +55,22 @@ from mlops_tpu.analysis.concurrency import (
     analyze_concurrency_paths,
     analyze_concurrency_source,
 )
+from mlops_tpu.analysis.contracts import (
+    CONTRACT_RULES,
+    analyze_contracts_paths,
+    analyze_contracts_source,
+)
 
 __all__ = [
     "CONCURRENCY_RULES",
+    "CONTRACT_RULES",
     "Finding",
     "RULES",
     "Severity",
     "analyze_concurrency_paths",
     "analyze_concurrency_source",
+    "analyze_contracts_paths",
+    "analyze_contracts_source",
     "analyze_paths",
     "analyze_source",
     "format_findings",

@@ -55,17 +55,38 @@ def _run_analyze(args: argparse.Namespace) -> int:
         ]
         return 1 if (stale and getattr(args, "fail_stale", False)) else 0
 
-    findings: list[Finding] = analyze_paths(paths)
+    # Per-layer wall time, reported under --strict: the gate grows a
+    # layer per review epoch, and a slow layer should show up in CI
+    # output (and bench.py's analysis_wall_s), not in folklore.
+    from time import perf_counter
+
+    timings: list[tuple[str, float]] = []
+
+    def timed(label: str, fn):
+        t0 = perf_counter()
+        result = fn()
+        timings.append((label, perf_counter() - t0))
+        return result
+
+    findings: list[Finding] = timed("layer1", lambda: analyze_paths(paths))
     if getattr(args, "concurrency", False):
         from mlops_tpu.analysis.concurrency import analyze_concurrency_paths
 
-        findings.extend(analyze_concurrency_paths(paths))
+        findings.extend(
+            timed("layer3", lambda: analyze_concurrency_paths(paths))
+        )
+    if getattr(args, "contracts", False):
+        from mlops_tpu.analysis.contracts import analyze_contracts_paths
+
+        findings.extend(
+            timed("layer4", lambda: analyze_contracts_paths(paths))
+        )
     if getattr(args, "fail_stale", False):
         from mlops_tpu.analysis.suppressions import stale_findings
 
         # TPU400 findings are immune to disable comments by construction
         # (suppressions.py): a stale disable can't silence its own report.
-        findings.extend(stale_findings(paths))
+        findings.extend(timed("audit", lambda: stale_findings(paths)))
 
     notes: list[str] = []
     if not getattr(args, "no_trace", False):
@@ -78,7 +99,7 @@ def _run_analyze(args: argparse.Namespace) -> int:
         _honor_jax_platforms_env()
         from mlops_tpu.analysis.traces import run_trace_checks
 
-        trace_findings, notes = run_trace_checks()
+        trace_findings, notes = timed("layer2", run_trace_checks)
         findings.extend(trace_findings)
 
     if getattr(args, "numeric", False):
@@ -102,6 +123,9 @@ def _run_analyze(args: argparse.Namespace) -> int:
 
     for note in notes:
         print(f"tpulint: {note}")
+    if strict and timings:
+        spent = " | ".join(f"{label} {secs:.2f}s" for label, secs in timings)
+        print(f"tpulint: layer timings: {spent}")
     if findings:
         print(format_findings(findings))
     gating = [f for f in findings if f.gates(strict)]

@@ -84,10 +84,17 @@ def _comments(source: str) -> list[tuple[int, str, bool]]:
 
 
 def audit_file(
-    source: str, path: str | Path, rel_path: str | Path | None = None
+    source: str,
+    path: str | Path,
+    rel_path: str | Path | None = None,
+    extra_findings: Iterable[Finding] = (),
 ) -> list[Suppression]:
     """Every disable comment in one file, with live/stale resolved against
-    a suppression-off run of the suppressible layers."""
+    a suppression-off run of the suppressible layers. ``extra_findings``
+    carries findings from layers that can't re-run per file — Layer 4's
+    contract rules are cross-file (one file's manifest governs another's
+    write sites), so `audit_paths` computes them project-wide once and
+    passes this file's slice in."""
     path = str(path)
     skipped = file_skipped(source)
     raw = [
@@ -103,9 +110,11 @@ def audit_file(
                         live=False, skipped_file=True)
             for lineno, rules, standalone in raw
         ]
-    findings = analyze_source(
-        source, path, rel_path=rel_path, keep_suppressed=True
-    ) + analyze_concurrency_source(source, path, keep_suppressed=True)
+    findings = (
+        analyze_source(source, path, rel_path=rel_path, keep_suppressed=True)
+        + analyze_concurrency_source(source, path, keep_suppressed=True)
+        + list(extra_findings)
+    )
     by_line: dict[int, set[str]] = {}
     for f in findings:
         by_line.setdefault(f.line, set()).add(f.rule)
@@ -131,6 +140,16 @@ def audit_file(
 
 
 def audit_paths(paths: Iterable[str | Path]) -> list[Suppression]:
+    from mlops_tpu.analysis.contracts import analyze_contracts_paths
+
+    # Layer-4 findings are project-wide (cross-file manifests), so one
+    # suppression-off pass up front, sliced per file below — a disable
+    # covering a TPU501-504 finding counts as live whether or not the
+    # current invocation passed --contracts.
+    paths = list(paths)
+    contract_by_file: dict[str, list[Finding]] = {}
+    for finding in analyze_contracts_paths(paths, keep_suppressed=True):
+        contract_by_file.setdefault(finding.path, []).append(finding)
     out: list[Suppression] = []
     for file, rel in iter_py_files(paths):
         out.extend(
@@ -138,6 +157,7 @@ def audit_paths(paths: Iterable[str | Path]) -> list[Suppression]:
                 file.read_text(encoding="utf-8"),
                 file.as_posix(),
                 rel_path=rel.as_posix(),
+                extra_findings=contract_by_file.get(file.as_posix(), ()),
             )
         )
     return out

@@ -161,6 +161,14 @@ def build_parser() -> argparse.ArgumentParser:
         "TPU401-404; pure AST, no JAX import)",
     )
     analyze.add_argument(
+        "--contracts",
+        action="store_true",
+        help="also run the Layer-4 cross-process contract rules (shm "
+        "ownership, metric-series parity + alert/doc references, config "
+        "knob liveness, fault-point liveness — TPU501-504; pure AST, "
+        "no JAX import)",
+    )
+    analyze.add_argument(
         "--list-suppressions",
         action="store_true",
         help="report every `# tpulint: disable` in the tree with file:line,"

@@ -2,9 +2,13 @@
 
 The reference scatters configuration across four ad-hoc mechanisms (notebook
 widgets, bundle variables, env vars, CI secrets/vars — SURVEY.md SS5.6). Here
-a single dataclass tree covers model/train/serve/mesh, loadable from TOML,
-overridable from environment (``MLOPS_TPU_<SECTION>_<FIELD>``) and CLI flags
-(``--section.field=value``).
+a single dataclass tree covers model/train/serve/monitor, loadable from
+TOML, overridable from environment (``MLOPS_TPU_<SECTION>_<FIELD>``) and CLI
+flags (``--section.field=value``). Every knob constructed here must be READ
+somewhere outside this module — tpulint's TPU503 dead-knob rule
+(`analysis/contracts.py`) gates CI on it, keyed off the declaration below
+(the PR 13 ``replica_affinity_slack`` lesson: a validated setting that
+changes nothing is worse than no setting).
 """
 
 from __future__ import annotations
@@ -19,6 +23,10 @@ except ModuleNotFoundError:  # Python < 3.11: tomllib landed in 3.11
     import tomli as tomllib  # type: ignore[no-redef]
 from pathlib import Path
 from typing import Any
+
+# Opts this module's *Config dataclasses into the TPU503 knob-liveness
+# contract (read from source by the analyzer, never imported).
+TPULINT_CONFIG_MODULE = True
 
 
 @dataclasses.dataclass
@@ -164,7 +172,10 @@ class HPOConfig:
 
 @dataclasses.dataclass
 class MonitorConfig:
-    drift_p_val: float = 0.05  # parity: TabularDrift(p_val=.05)
+    # (drift_p_val, the TabularDrift(p_val=.05) parity knob, was removed:
+    # the fused monitor exports CONTINUOUS 1-p drift scores and the only
+    # consumed threshold is lifecycle.drift_threshold on windowed means
+    # — a p-value cutoff here was a validated no-op, TPU503.)
     outlier_quantile: float = 0.95  # parity: IForest(threshold=0.95)
     drift_ref_size: int = 2048  # per-feature reference sample for K-S
 
@@ -834,12 +845,6 @@ class CacheConfig:
 
 
 @dataclasses.dataclass
-class MeshConfig:
-    data_axis: int = 0  # 0 -> use all devices on the data axis
-    model_axis: int = 1
-
-
-@dataclasses.dataclass
 class Config:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -855,7 +860,10 @@ class Config:
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
-    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # (mesh: MeshConfig was removed — its data_axis/model_axis index knobs
+    # were never read; the mesh axis layout is the hardcoded
+    # parallel/mesh.py AXES, and sizing flows through make_mesh(n,
+    # model_parallel=...) arguments. TPU503 dead-knob cleanup.)
 
 
 def _tuple_element_type(owner: type, field: str) -> type:

@@ -180,6 +180,129 @@ TPULINT_LOCK_ORDER = {
 }
 TPULINT_CROSS_METHOD_SEMAPHORES = {"RingService": ("_inflight",)}
 
+# ---------------------------------------------------------------------------
+# Layer-4 shm ownership manifest (tpulint TPU501, `analysis/contracts.py`).
+#
+# Every field of the plan below has exactly one writer ROLE — that is the
+# whole crash-survivability argument: a reader never needs a lock against
+# a writer it doesn't share a process with, and a dead process can only
+# have torn state the ownership map says it was allowed to tear. The
+# analyzer classifies every cell-write (`...ring.field[i] = / +=`) by the
+# enclosing class/method's role and gates CI on writes from anyone else.
+# A tuple value is a DECLARED handoff: each listed role writes the field
+# at a distinct protocol phase (e.g. `ctl`: the supervisor arms draining
+# and SLO words, front ends stamp trace arming), which is single-writer
+# per word even though the block is shared.
+TPULINT_SHM_OWNERSHIP = {
+    # control + profile lease
+    "ctl": ("supervisor", "frontend-worker"),
+    "prof_ctl": ("frontend-worker", "engine-replica"),
+    "prof_claim": "frontend-worker",
+    # replica liveness (replica stamps ready/incarnation; the supervisor
+    # clears it when respawning a corpse)
+    "rep_ready": ("engine-replica", "supervisor"),
+    "rep_inflight": "frontend-worker",
+    # submission ring: producer head/entries, consumer tail
+    "sub_entries": "frontend-worker",
+    "sub_head": "frontend-worker",
+    "sub_tail": "engine-replica",
+    # completion rings: producer head/entries, consumer tail
+    "comp_entries": "engine-replica",
+    "comp_head": "engine-replica",
+    "comp_tail": "frontend-worker",
+    # request slots: the front end owns the request half...
+    "slot_gen": "frontend-worker",
+    "slot_n": "frontend-worker",
+    "slot_busy": "frontend-worker",
+    "slot_tenant": "frontend-worker",
+    "slot_replica": "frontend-worker",
+    "slot_deadline": "frontend-worker",
+    # ...the engine owns the response half
+    "resp_gen": "engine-replica",
+    "resp_status": "engine-replica",
+    "resp_incarnation": "engine-replica",
+    "resp_trace": ("engine-replica", "frontend-worker"),
+    # slabs: requests in, responses out
+    "small_cat": "frontend-worker",
+    "small_num": "frontend-worker",
+    "large_cat": "frontend-worker",
+    "large_num": "frontend-worker",
+    "small_resp": "engine-replica",
+    "large_resp": "engine-replica",
+    # per-worker metrics blocks (each worker writes only its own row)
+    "req_counts": "frontend-worker",
+    "lat_counts": "frontend-worker",
+    "lat_sum_ms": "frontend-worker",
+    "lat_n": "frontend-worker",
+    "pred_lat_counts": "frontend-worker",
+    "pred_lat_n": "frontend-worker",
+    "shed": "frontend-worker",
+    "inflight": "frontend-worker",
+    "quota_shed": "frontend-worker",
+    "expired": "frontend-worker",
+    "parked": "frontend-worker",
+    "brownout_shed": "frontend-worker",
+    "trace_dropped": "frontend-worker",
+    "flight_dumps": "frontend-worker",
+    # engine telemetry blocks (the engine's telemetry loop publishes;
+    # reattach/recovery paths on the replica rebuild them)
+    "shape_meta": "telemetry-loop",
+    "shape_keys": "telemetry-loop",
+    "shape_vals": "telemetry-loop",
+    "rob_vals": ("engine-replica", "telemetry-loop"),
+    "mon_vals": ("engine-replica", "telemetry-loop"),
+    "mon_drift_last": ("engine-replica", "telemetry-loop"),
+    "mon_drift_mean": ("engine-replica", "telemetry-loop"),
+    "mon_drift_sum": ("engine-replica", "telemetry-loop"),
+    "eng_vals": ("engine-replica", "supervisor"),
+    "eng_rows_tenant": "engine-replica",
+    # sloscope plane: the supervisor arms, the telemetry loop publishes
+    "slo_meta": ("supervisor", "frontend-worker"),
+    "slo_vals": "telemetry-loop",
+    "alert_vals": "telemetry-loop",
+    "ledger_meta": "telemetry-loop",
+    "ledger_keys": "telemetry-loop",
+    "ledger_vals": "telemetry-loop",
+    "life_vals": "telemetry-loop",
+    "life_promos": "telemetry-loop",
+}
+
+# Which process role a lexical context runs as. Most specific wins:
+# "Class.method" over "Class"; bare names are module-level functions.
+# RequestRing is the shared library both sides import, so it gets NO
+# class-wide role — each mutating method is pinned to the role that is
+# allowed to call it (calling `submit` from an engine would be flagged
+# exactly because the method's role, not the caller's import, decides).
+TPULINT_SHM_ROLES = {
+    "FrontendServer": "frontend-worker",
+    "ShmWorkerMetrics": "frontend-worker",
+    "RingClient": "frontend-worker",
+    "RingService": "engine-replica",
+    "RingService._telemetry_loop": "telemetry-loop",
+    "RingService._write_ledger": "telemetry-loop",
+    "RingService._write_robustness": "telemetry-loop",
+    "RingService._write_shapes": "telemetry-loop",
+    # RequestRing methods, by protocol side:
+    "RequestRing.submit": "frontend-worker",
+    "RequestRing.pop_completions": "frontend-worker",
+    "RequestRing.set_tracing": "frontend-worker",
+    "RequestRing.try_claim_profile": "frontend-worker",
+    "RequestRing.release_profile": "frontend-worker",
+    "RequestRing.post_profile_request": "frontend-worker",
+    "RequestRing.cancel_profile_request": "frontend-worker",
+    "RequestRing.pop_submissions": "engine-replica",
+    "RequestRing.push_completion": "engine-replica",
+    "RequestRing.set_ready": "engine-replica",
+    "RequestRing.recover_engine_locks": "engine-replica",
+    "RequestRing.set_draining": "supervisor",
+    "RequestRing.arm_slo": "supervisor",
+    "RequestRing.write_monitor": "telemetry-loop",
+    "RequestRing.write_lifecycle": "telemetry-loop",
+    # module-level process mains
+    "_engine_main": "engine-replica",
+    "serve_multi_worker": "supervisor",
+}
+
 SMALL, LARGE = 0, 1  # slot classes (stats/gauge indices)
 
 STATUSES = RING_STATUSES  # closed status set for the request matrices

@@ -71,6 +71,59 @@ DEFAULT_TENANT_LABEL = "default"
 # fleet-goodput framing), 504 the deadline expiry.
 SLO_BAD_STATUSES = (500, 503, 504)
 
+# ---------------------------------------------------------------------------
+# Layer-4 series-contract manifests (tpulint TPU502, `analysis/seriesreg.py`).
+#
+# The two scrape roots below must emit the same series surface — a panel
+# wired against one plane has to survive a redeploy onto the other. The
+# analyzer rebuilds the registry from the renderers' f-strings on every CI
+# run; these declarations only name the roots, the deliberate exceptions,
+# and the label keys whose values come from closed sets.
+TPULINT_SERIES_PLANES = {
+    "single": ("HttpServer._metrics_endpoint",),
+    "ring": ("FrontendServer._metrics_endpoint",),
+}
+# Series that exist on exactly one plane ON PURPOSE. The ring plane's
+# extras are its fleet anatomy (per-worker ring depth/quota, per-replica
+# liveness) — physical structure the single-process plane doesn't have.
+TPULINT_PLANE_ONLY_SERIES = {
+    "ring": (
+        "mlops_tpu_ring_depth",
+        "mlops_tpu_shed_total",
+        "mlops_tpu_tenant_quota_shed_total",
+        "mlops_tpu_replica_ready",
+        "mlops_tpu_replica_ring_depth",
+        "mlops_tpu_replica_incarnation",
+        "mlops_tpu_replica_respawn_total",
+        "mlops_tpu_replica_replayed_slots_total",
+        "mlops_tpu_replica_rows_scored_total",
+    ),
+}
+# Label keys whose runtime values are closed sets (route/status tables,
+# schema feature names, tenant registry, bucket bounds...). A formatted
+# label value under any OTHER key is unbounded cardinality and gates.
+TPULINT_BOUNDED_LABELS = (
+    "alert",
+    "backend",
+    "class",
+    "entry",
+    "feature",
+    "jax",
+    "jaxlib",
+    "le",
+    "model",
+    "outcome",
+    "replica",
+    "route",
+    "severity",
+    "slo",
+    "status",
+    "tenant",
+    "version",
+    "window",
+    "worker",
+)
+
 _BUILD_INFO_LINES: list[str] | None = None
 
 

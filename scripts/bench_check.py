@@ -12,6 +12,12 @@ mean either a real regression or a broken measurement, and both gate.
 Rounds are the driver wrapper files ``BENCH_r*.json`` at the repo root
 (``parsed`` holds the bench JSON; a bare bench line is accepted too).
 
+On top of the round contract, the committed alert rules
+(``configs/alerts/*.yml``) are validated against the series registry
+rebuilt statically from the renderers (``analysis/seriesreg.py`` — the
+same registry TPU502 consumes), so an alert referencing a renamed or
+deleted series fails the bench gate even if nobody re-ran the linter.
+
 Run from the repo root: ``python scripts/bench_check.py``
 (exit 0 = contract holds, 1 = named violations, 2 = no rounds found).
 """
@@ -80,6 +86,36 @@ def latest_round() -> tuple[Path, dict] | None:
     return path, doc.get("parsed", doc)
 
 
+def alert_rule_problems() -> list[str]:
+    """Every ``mlops_tpu_*`` token in the committed alert rules must name
+    a series some renderer actually emits. Group/alert identifier lines
+    (``name:``/``alert:``) are labels, not references."""
+    sys.path.insert(0, str(REPO))  # scripts/ is sys.path[0] when run
+    from mlops_tpu.analysis.contracts import _YML_IDENTIFIER_LINE
+    from mlops_tpu.analysis.seriesreg import registry_from_paths
+
+    registry = registry_from_paths([REPO / "mlops_tpu"])
+    if registry is None:
+        return ["series registry: no TPULINT_SERIES_PLANES manifest "
+                "found under mlops_tpu/"]
+    known = registry.names()
+    token_re = re.compile(r"mlops_tpu_\w+")
+    problems: list[str] = []
+    for rules in sorted((REPO / "configs" / "alerts").glob("*.yml")):
+        for lineno, line in enumerate(
+            rules.read_text().splitlines(), start=1
+        ):
+            if _YML_IDENTIFIER_LINE.match(line):
+                continue
+            for token in token_re.findall(line):
+                if token not in known:
+                    problems.append(
+                        f"{rules.name}:{lineno}: alert references "
+                        f"series {token!r}, which no renderer emits"
+                    )
+    return problems
+
+
 def main() -> int:
     found = latest_round()
     if found is None:
@@ -102,6 +138,7 @@ def main() -> int:
                 f"{key}={value} outside declared bounds "
                 f"[{lower}, {upper}]"
             )
+    problems.extend(alert_rule_problems())
     if problems:
         print(f"bench-check: {path.name} violates the round contract:",
               file=sys.stderr)
@@ -110,7 +147,8 @@ def main() -> int:
         return 1
     print(
         f"bench-check: {path.name} OK — {len(HEADLINE_KEYS)} headline "
-        f"keys present, {len(BOUNDS)} bounds hold"
+        f"keys present, {len(BOUNDS)} bounds hold, alert rules match "
+        "the series registry"
     )
     return 0
 

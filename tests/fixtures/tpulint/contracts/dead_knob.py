@@ -1,0 +1,24 @@
+"""TPU503 fixture: a validated-but-never-read config knob.
+
+``validate()`` reading a field does NOT make it live — that is exactly
+the PR 13 ``replica_affinity_slack`` failure mode this rule exists for.
+"""
+
+import dataclasses
+
+TPULINT_CONFIG_MODULE = True
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    max_batch: int = 64
+    drain_grace_s: float = 2.0  # PLANT: TPU503
+
+    def validate(self):
+        if self.drain_grace_s < 0:
+            raise ValueError("drain_grace_s must be >= 0")
+        return self
+
+
+def apply(config):
+    return [0] * config.max_batch

@@ -1,0 +1,21 @@
+"""TPU504 fixture: fault-point liveness.
+
+A miniature of faults/__init__.py: the module-level ``POINTS`` dict IS
+the manifest, and ``fire`` sites must agree with it in both directions.
+"""
+
+POINTS = {
+    "fixture.encode.bitflip": "flip one embedding id before scoring",
+    "fixture.fetch.stall": "inject a device-fetch stall",  # PLANT: TPU504
+}
+
+
+def fire(name):
+    """Stand-in for faults.fire: matched by leaf name."""
+    return name
+
+
+def degraded_path(batch):
+    fire("fixture.encode.bitflip")
+    fire("fixture.ghost.point")  # PLANT: TPU504
+    return batch

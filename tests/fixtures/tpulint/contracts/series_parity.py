@@ -1,0 +1,39 @@
+"""TPU502 fixture: series parity + label boundedness. Analyzed, never run.
+
+Two renderer roots play the single-process and shm-ring metrics planes;
+the sibling ``series_parity.yml`` plays the committed alert rules (its
+typo'd series reference is planted there).
+"""
+
+TPULINT_SERIES_PLANES = {
+    "single": ("SingleServer.metrics_endpoint",),
+    "ring": ("RingServer.metrics_endpoint",),
+}
+
+TPULINT_PLANE_ONLY_SERIES = {
+    "ring": ("mlops_tpu_fix_ring_depth",),
+}
+
+TPULINT_BOUNDED_LABELS = ("tenant",)
+
+
+def shared_lines(tenant, source):
+    return [
+        "# TYPE mlops_tpu_fix_requests_total counter",
+        f'mlops_tpu_fix_requests_total{{tenant="{tenant}"}} 1',
+        f'mlops_tpu_fix_errors_total{{source="{source}"}} 0',  # PLANT: TPU502
+    ]
+
+
+class SingleServer:
+    def metrics_endpoint(self, tenant):
+        lines = shared_lines(tenant, "http")
+        lines.append("mlops_tpu_fix_rows_scored_total 0")  # PLANT: TPU502
+        return "\n".join(lines)
+
+
+class RingServer:
+    def metrics_endpoint(self, tenant):
+        lines = shared_lines(tenant, "ring")
+        lines.append("mlops_tpu_fix_ring_depth 0")  # allowlisted ring-only
+        return "\n".join(lines)

@@ -1,0 +1,66 @@
+"""TPU501 fixture: shm ownership violations. Analyzed, never imported.
+
+A miniature of the serve plane's ring protocol: the manifests below play
+the role of serve/ipc.py's, and every marked line writes a ring cell from
+the wrong side of the process boundary.
+"""
+
+TPULINT_SHM_OWNERSHIP = {
+    "sub_head": "frontend-worker",
+    "shed": "frontend-worker",
+    "comp_head": "engine-replica",
+    # Declared handoff: the engine publishes, the supervisor resets.
+    "eng_vals": ("engine-replica", "supervisor"),
+}
+
+TPULINT_SHM_ROLES = {
+    "Frontend": "frontend-worker",
+    "Engine": "engine-replica",
+    "Engine._telemetry": "telemetry-loop",
+    "respawn_supervisor": "supervisor",
+}
+
+
+class Frontend:
+    def __init__(self, ring):
+        self.ring = ring
+        self.sub_head = ring.sub_head  # view construction, not a write
+
+    def submit(self, idx):
+        self.ring.sub_head[0] = idx  # owner writes its own head
+        self.ring.shed[0] += 1  # owner bumps its own counter
+
+    def steal_completion(self, idx):
+        self.ring.comp_head[0] = idx  # PLANT: TPU501
+
+
+class Engine:
+    def __init__(self, ring):
+        self.ring = ring
+
+    def publish(self, idx):
+        self.ring.comp_head[0] = idx  # owner writes its own head
+        self.ring.eng_vals[0] = 1.0  # handoff tuple includes engine
+
+    def wrong_side(self, n):
+        self.ring.shed[0] += n  # PLANT: TPU501
+
+    def _telemetry(self):
+        self.ring.eng_vals[1] = 2.0  # PLANT: TPU501
+
+    def scratch(self, x):
+        self.ring.scratch_vals[0] = x  # PLANT: TPU501
+
+
+class Stranger:
+    """No role entry at all — even writes to correctly-named fields gate."""
+
+    def __init__(self, ring):
+        self.ring = ring
+
+    def poke(self):
+        self.ring.sub_head[0] = 7  # PLANT: TPU501
+
+
+def respawn_supervisor(ring):
+    ring.eng_vals[0] = 0.0  # handoff tuple includes the supervisor

@@ -59,9 +59,9 @@ def test_each_planted_violation_fires_at_its_line(name):
 
 def test_every_shipped_rule_is_exercised_by_a_fixture():
     """A rule without a fixture is a rule that can silently stop firing."""
-    from mlops_tpu.analysis import CONCURRENCY_RULES
+    from mlops_tpu.analysis import CONCURRENCY_RULES, CONTRACT_RULES
 
-    shipped = set(RULES) | set(CONCURRENCY_RULES)
+    shipped = set(RULES) | set(CONCURRENCY_RULES) | set(CONTRACT_RULES)
     planted_rules = set()
     for path in FIXTURES.rglob("*.py"):
         planted_rules |= {rule for _, rule in _planted(path)}
@@ -249,6 +249,164 @@ def test_annotated_manifest_is_read():
     )
     findings = analyze_concurrency_source(source, "inline.py")
     assert [f.rule for f in findings] == ["TPU401"]
+
+
+# ------------------------------------------------------------ Layer 4
+CONTRACT_FIXTURES = FIXTURES / "contracts"
+# Exact planted counts per contract rule — the precision net in both
+# directions, same contract as CONCURRENCY_COUNTS above.
+CONTRACT_COUNTS = {"TPU501": 5, "TPU502": 3, "TPU503": 1, "TPU504": 2}
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["shm_ownership", "series_parity", "dead_knob", "fault_points"],
+)
+def test_each_planted_contract_violation_fires_at_its_line(name):
+    from mlops_tpu.analysis import analyze_contracts_source
+
+    path = CONTRACT_FIXTURES / f"{name}.py"
+    planted = _planted(path)
+    assert planted, f"fixture {name} has no PLANT markers"
+    found = {
+        (f.line, f.rule)
+        for f in analyze_contracts_source(path.read_text(), path)
+    }
+    assert planted <= found, f"missed: {planted - found}"
+    extra = {(ln, r) for ln, r in found if (ln, r) not in planted}
+    assert not extra, f"unexpected findings: {extra}"
+
+
+def test_contract_fixture_counts_pinned():
+    """Exact per-rule counts over the contracts dir analyzed as ONE
+    project — including the alert-rules yml, whose typo'd series
+    reference must land on its planted line — and the CLI detects all of
+    them through `analyze --contracts`."""
+    from collections import Counter
+
+    from mlops_tpu.analysis import analyze_contracts_paths
+    from mlops_tpu.cli import main
+
+    findings = analyze_contracts_paths([CONTRACT_FIXTURES])
+    assert dict(Counter(f.rule for f in findings)) == CONTRACT_COUNTS
+    planted = {
+        (path.as_posix(), lineno, rule)
+        for path in sorted(CONTRACT_FIXTURES.iterdir())
+        for lineno, rule in _planted(path)
+    }
+    found = {(f.path, f.line, f.rule) for f in findings}
+    assert found == planted
+    assert (
+        main(["analyze", "--no-trace", "--contracts",
+              str(CONTRACT_FIXTURES)])
+        == 1
+    )
+
+
+def test_contract_layer_requires_flag():
+    """Without --contracts the fixtures raise no TPU50x findings (the
+    planted files are Layer-1 clean by construction)."""
+    from mlops_tpu.cli import main
+
+    assert main(["analyze", "--no-trace", str(CONTRACT_FIXTURES)]) == 0
+
+
+def test_contract_rules_respect_suppressions():
+    from mlops_tpu.analysis import analyze_contracts_source
+
+    source = (
+        'POINTS = {"a.b": "x"}\n'
+        "def f():\n"
+        '    fire("a.c")  # tpulint: disable=TPU504\n'
+        '    return fire("a.b")\n'
+    )
+    assert analyze_contracts_source(source, "inline.py") == []
+    kept = analyze_contracts_source(source, "inline.py", keep_suppressed=True)
+    assert [f.rule for f in kept] == ["TPU504"]
+
+
+def test_deleting_a_series_from_one_plane_fails_parity():
+    """The acceptance scenario: drop one series from one renderer plane
+    and TPU502 gates. Extraction is pinned by the fixtures; this pins the
+    parity check against the REAL registry built from the shipped
+    package."""
+    from mlops_tpu.analysis.contracts import _check_series
+    from mlops_tpu.analysis.seriesreg import registry_from_paths
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    registry = registry_from_paths([package])
+    assert registry is not None
+    info = registry.series["mlops_tpu_requests_total"]
+    assert info.planes == {"single", "ring"}
+    info.planes.discard("ring")
+    findings = _check_series(
+        [], registry, alert_files=[], docs_file=None, extra_sources={}
+    )
+    assert any(
+        f.rule == "TPU502" and "mlops_tpu_requests_total" in f.message
+        for f in findings
+    )
+
+
+def test_renamed_alert_series_fails_gate(tmp_path):
+    """The other acceptance scenario: rename one series in the alert
+    rules and the reference-integrity check gates against the real
+    registry."""
+    from mlops_tpu.analysis.contracts import _check_series
+    from mlops_tpu.analysis.seriesreg import registry_from_paths
+
+    root = Path(__file__).parents[1]
+    registry = registry_from_paths([root / "mlops_tpu"])
+    rules = root / "configs" / "alerts" / "mlops_tpu_slo.rules.yml"
+    bad = tmp_path / "rules.yml"
+    bad.write_text(
+        rules.read_text().replace(
+            "mlops_tpu_alert_active", "mlops_tpu_alert_actve"
+        )
+    )
+    findings = _check_series(
+        [], registry, alert_files=[bad], docs_file=None, extra_sources={}
+    )
+    assert findings and all(f.rule == "TPU502" for f in findings)
+    assert all("mlops_tpu_alert_actve" in f.message for f in findings)
+    # The committed rules file itself is clean against the registry.
+    assert (
+        _check_series(
+            [], registry, alert_files=[rules], docs_file=None,
+            extra_sources={},
+        )
+        == []
+    )
+
+
+def test_contract_suppressions_count_in_ledger(tmp_path, capsys):
+    """A disable covering a Layer-4 finding is LIVE in the ledger even
+    though Layer 4 is cross-file: audit_paths computes the contract
+    findings project-wide and slices them per file."""
+    from mlops_tpu.cli import main
+
+    mod = tmp_path / "faulty.py"
+    mod.write_text(
+        'POINTS = {"a.b": "x"}  # tpulint: disable=TPU504\n'
+        "def f():\n"
+        "    return 1\n"
+    )
+    assert main(["analyze", "--list-suppressions", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "faulty.py:1: disable=TPU504 [live]" in out
+
+
+def test_repo_contract_gate_clean_at_head():
+    """`analyze --contracts` over the shipped package exits clean: the
+    shm ownership map, both metrics planes, the committed alert rules,
+    the docs series table, every config knob and every fault point hold
+    at HEAD."""
+    from mlops_tpu.cli import main
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    assert (
+        main(["analyze", "--no-trace", "--contracts", str(package)]) == 0
+    )
 
 
 # ------------------------------------------- suppression ledger (TPU400)
@@ -588,16 +746,17 @@ def test_cli_analyze_nonzero_on_fixtures_and_zero_on_package(capsys):
 
 
 @pytest.mark.slow
-def test_cli_analyze_full_two_layer_gate(capsys):
-    """`mlops-tpu analyze --strict --concurrency --fail-stale mlops_tpu/`
-    — the exact CI invocation — exits 0 with every entry point traced."""
+def test_cli_analyze_full_gate(capsys):
+    """`mlops-tpu analyze --strict --concurrency --contracts --fail-stale
+    mlops_tpu/` — the exact CI invocation — exits 0 with every entry
+    point traced."""
     from mlops_tpu.cli import main
 
     package = Path(__file__).parents[1] / "mlops_tpu"
     assert (
         main(
-            ["analyze", "--strict", "--concurrency", "--fail-stale",
-             str(package)]
+            ["analyze", "--strict", "--concurrency", "--contracts",
+             "--fail-stale", str(package)]
         )
         == 0
     )
@@ -610,10 +769,13 @@ def test_cli_analyze_full_two_layer_gate(capsys):
 def test_rule_catalog_documented():
     """Every rule ID (all three layers + the suppression audit) appears in
     docs/static-analysis.md."""
-    from mlops_tpu.analysis import CONCURRENCY_RULES
+    from mlops_tpu.analysis import CONCURRENCY_RULES, CONTRACT_RULES
     from mlops_tpu.analysis.suppressions import STALE_RULE
     from mlops_tpu.analysis.traces import TRACE_RULES
 
     doc = (Path(__file__).parents[1] / "docs" / "static-analysis.md").read_text()
-    for rule in [*RULES, *CONCURRENCY_RULES, STALE_RULE, *TRACE_RULES]:
+    for rule in [
+        *RULES, *CONCURRENCY_RULES, *CONTRACT_RULES, STALE_RULE,
+        *TRACE_RULES,
+    ]:
         assert rule in doc, f"{rule} missing from docs/static-analysis.md"

@@ -8,8 +8,13 @@ from mlops_tpu.config import Config, load_config
 def test_defaults():
     config = load_config(env={})
     assert config.serve.port == 5000  # parity: app/Dockerfile EXPOSE 5000
-    assert config.monitor.drift_p_val == 0.05
+    assert config.monitor.outlier_quantile == 0.95
     assert config.hpo.trials == 10  # parity: hyperopt max_evals=10
+    # Removed dead knobs stay removed: drift_p_val (threshold consumption
+    # lives in lifecycle.drift_threshold) and the mesh section (axis
+    # layout is hardcoded in parallel/mesh.py). TPU503 regression pins.
+    assert not hasattr(config.monitor, "drift_p_val")
+    assert not hasattr(config, "mesh")
 
 
 def test_toml_and_overrides(tmp_path):
