@@ -571,6 +571,26 @@ def _bulk_stage(engine, bundle) -> dict:
     fidelity = bundle.bulk_fidelity
     if "roc_auc_delta" in fidelity:
         out["bulk_fidelity_auc_delta"] = round(fidelity["roc_auc_delta"], 4)
+
+    # Quant tier sweep (ISSUE 17): the int8/bf16 student through the same
+    # chunked scorer. quant_auc_delta is the STAMPED held-out fidelity
+    # (student AUC minus teacher AUC, post-quantization — the number the
+    # promotion gate graded), not re-measured on this unlabeled synthetic
+    # sweep; quant_speedup_vs_student is the acceptance ratio vs the f32
+    # bulk path the sweep above just measured.
+    if bundle.has_quant and bundle.quant_gates_passed:
+        _note("bulk quant sweep")
+        quant = score_dataset(
+            bundle, ds, mesh=None, chunk_rows=16_384, tier="quant"
+        )
+        out["quant_rows_per_s"] = round(quant.rows_per_s, 1)
+        out["quant_speedup_vs_student"] = round(
+            quant.rows_per_s
+            / max(out["bulk_rows_per_s_bulkpath"], 1e-9), 2
+        )
+        qfid = bundle.quant_fidelity
+        if "roc_auc_delta" in qfid:
+            out["quant_auc_delta"] = round(qfid["roc_auc_delta"], 4)
     return out
 
 
@@ -669,20 +689,34 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
 
     if bundle.flavor == "sklearn":
         return {}
-    peak = peak_flops(device)
-    if os.environ.get("MLOPS_TPU_PEAK_FLOPS"):
-        peak_source = "env"
-    elif peak is not None:
-        peak_source = "spec"
-    elif getattr(device, "platform", "") == "cpu":
-        # No published peak for arbitrary host silicon: measure the
-        # backend's own dense-GEMM rate and report MFU against that —
-        # "fraction of this host's measured matmul peak".
-        peak = measured_gemm_peak()
-        peak_source = "measured-gemm"
-    else:
-        peak_source = "unknown"
-    out: dict = {"peak_flops": peak, "peak_source": peak_source}
+
+    def peak_for(dtype: str) -> tuple[float | None, str]:
+        """Peak at the stated EXECUTING precision (ISSUE 17 mfu fix: an
+        f32 program divided by the bf16 spec peak understates MFU 2x)."""
+        if os.environ.get("MLOPS_TPU_PEAK_FLOPS"):
+            return peak_flops(device, dtype), "env"
+        p = peak_flops(device, dtype)
+        if p is not None:
+            return p, "spec"
+        if getattr(device, "platform", "") == "cpu":
+            # No published peak for arbitrary host silicon: measure the
+            # backend's own dense-GEMM rate AT THIS PRECISION and report
+            # MFU against that — "fraction of this host's measured
+            # matmul peak".
+            return measured_gemm_peak(dtype=dtype), "measured-gemm"
+        return None, "unknown"
+
+    # The bulk/train programs execute f32 end to end — the quant tier too
+    # (it dequantizes in-jit; int8 saves HBM bytes, not MXU precision).
+    # Only the flash-attention kernel below runs bf16. Each mfu_* key
+    # records the precision its denominator was taken at.
+    peak, peak_source = peak_for("f32")
+    out: dict = {
+        "peak_flops": peak,
+        "peak_source": peak_source,
+        "mfu_bulk_dtype": "float32",
+        "mfu_train_dtype": "float32",
+    }
 
     model, variables = bundle.model, bundle.variables
     rng = np.random.default_rng(1)
@@ -763,6 +797,8 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
         try:
             from mlops_tpu.ops.attention import flash_attention
 
+            peak_bf16, _ = peak_for("bf16")
+            out["mfu_flash_attn_dtype"] = "bfloat16"
             b, s, h, d = 4, 2048, 8, 64
             q, k, v = (
                 jnp.asarray(
@@ -783,7 +819,7 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
             # hand.
             f_attn = 4.0 * b * h * s * s * d
             out["flash_attn_gflops_per_s"] = round(f_attn / dt / 1e9, 1)
-            out["mfu_flash_attn"] = mfu(f_attn, 1.0 / dt, peak)
+            out["mfu_flash_attn"] = mfu(f_attn, 1.0 / dt, peak_bf16)
 
             # Forward+backward through the Pallas VJP (round 5): the
             # backward recomputes p from the stored logsumexp in two
@@ -808,7 +844,7 @@ def _mfu_stage(bundle, bulk: dict, device) -> dict:
                 dt_g = (time.perf_counter() - t0) / reps
                 f_train = f_attn * 3.5  # fwd (2 matmuls) + bwd (5 matmuls)
                 out["flash_attn_bwd_ms"] = round(dt_g * 1e3, 3)
-                out["mfu_flash_attn_train"] = mfu(f_train, 1.0 / dt_g, peak)
+                out["mfu_flash_attn_train"] = mfu(f_train, 1.0 / dt_g, peak_bf16)
             except Exception as err:
                 out["flash_attn_bwd_error"] = f"{type(err).__name__}: {err}"
 
@@ -944,6 +980,62 @@ def _engine_stage(engine, record) -> dict:
         t.join()
     dt = time.perf_counter() - t0
     return {"engine_group_req_per_s": round(n_threads * reps * 64 / dt, 1)}
+
+
+def _batcher_mode_stage(engine, record) -> dict:
+    """Continuous vs windowed micro-batching (ISSUE 17): per-request p50
+    for batch-1 bodies THROUGH the MicroBatcher under concurrent load (8
+    overlapped clients — batch-1 sequential traffic rides the batcher's
+    idle fast-path in both modes, so only concurrency exposes the
+    admission policy). The windowed wave holds every group open for the
+    full ``window_ms`` before dispatching; continuous admits at dispatch
+    boundaries (zero wait while groups are in flight, a measured
+    EWMA-derived deadline on an empty pipe), so its p50 sheds most of the
+    fixed window. Responses are bit-identical across modes
+    (tests/test_batcher.py pins it); this stage records the latency
+    consequence."""
+    import asyncio
+    from concurrent.futures import ThreadPoolExecutor
+
+    from mlops_tpu.serve.batcher import MicroBatcher
+
+    if not engine.supports_grouping:
+        return {}
+
+    async def run(mode: str) -> tuple[list[float], float]:
+        lat: list[float] = []
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            batcher = MicroBatcher(
+                engine, pool, window_ms=1.0, batch_mode=mode
+            )
+            loop = asyncio.get_running_loop()
+
+            async def client(n: int) -> None:
+                for _ in range(n):
+                    t0 = loop.time()
+                    await batcher.predict([record])
+                    lat.append((loop.time() - t0) * 1e3)
+
+            await asyncio.gather(*[client(5) for _ in range(8)])  # warm
+            lat.clear()
+            await asyncio.gather(*[client(25) for _ in range(8)])
+            # Drain stragglers so the pool shutdown never strands a task.
+            while batcher._dispatch_tasks:
+                await asyncio.sleep(0.001)
+            admit_ms = batcher._admit_deadline_s() * 1e3
+        lat.sort()
+        return lat, admit_ms
+
+    out: dict = {}
+    for mode in ("windowed", "continuous"):
+        lat, admit_ms = asyncio.run(run(mode))
+        out[f"batch1_p50_ms_{mode}"] = round(_percentile(lat, 50), 4)
+        out[f"batch1_p99_ms_{mode}"] = round(_percentile(lat, 99), 4)
+        if mode == "continuous":
+            # The measured empty-pipe admit deadline the EWMA settled on
+            # (the windowed mode's equivalent is the fixed 1.0 window).
+            out["batch1_admit_deadline_ms"] = round(admit_ms, 4)
+    return out
 
 
 _HTTP_CLIENT = r"""
@@ -2012,7 +2104,10 @@ def main() -> None:
     config.data.rows = 50_000
     config.model = ModelConfig(family=family, ensemble_size=ensemble)
     config.train = TrainConfig(
-        batch_size=1024, steps=600, eval_every=600, warmup_steps=60
+        batch_size=1024, steps=600, eval_every=600, warmup_steps=60,
+        # Quant tier (ISSUE 17): distill + quantize + gate the int8/bf16
+        # student at packaging time so the bulk stage can measure it.
+        distill_quant=True,
     )
     config.registry.run_root = "runs/bench"
     _note(f"backend up, device={device}; training {family} ens={ensemble}")
@@ -2089,6 +2184,13 @@ def main() -> None:
         coldstart = {"engine_cold_start_error": f"{type(err).__name__}: {err}"}
     _note("engine grouped stage")
     engine_stats = _engine_stage(engine, record)
+    _note("batcher admission-mode stage (windowed vs continuous)")
+    try:
+        # Continuous micro-batching evidence (ISSUE 17), guarded like the
+        # other plane stages.
+        engine_stats.update(_batcher_mode_stage(engine, record))
+    except Exception as err:
+        engine_stats["batcher_mode_error"] = f"{type(err).__name__}: {err}"
     _note("http stage")
     http = {**engine_stats, **_http_stage(engine, record)}
     _note("http multi-worker stage")

@@ -21,6 +21,12 @@ jaxpr the analyzer inspects is the program production compiles:
 - ``serve-predict-group-packed`` — `ops/predict.py
   make_packed_grouped_base` (the micro-batcher's packed vmapped
   dispatch), traced across slot buckets.
+- ``serve-predict-quant-packed`` / ``serve-predict-quant-group-packed`` —
+  `ops/quant_kernel.py make_quant_packed_base` /
+  ``make_quant_grouped_base`` (the int8/bf16 quantized student tier in
+  the same packed 7-arg cacheable form; Pallas-fused on TPU, traced here
+  through the jnp composite route, which is the same program family the
+  parity tests pin bit-identical).
 - ``bulk-score-chunk``   — `parallel/bulk.py make_bulk_fused` (the fused
   chunk program the pipelined bulk/stream scorers dispatch per chunk),
   traced at two chunk sizes with the production int8 categorical ids.
@@ -222,6 +228,63 @@ def _build_serve_predict_group():
     return entry, {smallest: args(smallest), largest: args(largest)}
 
 
+def _build_serve_quant():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.ops.quant import abstract_quant_params
+    from mlops_tpu.ops.quant_kernel import make_quant_packed_base
+
+    qparams = abstract_quant_params()
+    monitor = _abstract_monitor()
+    # use_kernel=False: the analyzer traces the jnp composite route — the
+    # Pallas route is the same math (parity-pinned bitwise under jit) but
+    # its jaxpr hides the body inside a pallas_call, which Layer-2's
+    # structural checks cannot see through.
+    entry = make_quant_packed_base(use_kernel=False)
+
+    def args(bucket: int):
+        cat, num = _schema_batch(bucket)
+        mask = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
+        temp = jax.ShapeDtypeStruct((), jnp.float32)
+        return (qparams, monitor, _abstract_accumulator(), temp, cat, num, mask)
+
+    buckets = ServeConfig().warmup_batch_sizes
+    return entry, {b: args(b) for b in buckets}
+
+
+def _build_serve_quant_group():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.ops.quant import abstract_quant_params
+    from mlops_tpu.ops.quant_kernel import make_quant_grouped_base
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+
+    qparams = abstract_quant_params()
+    monitor = _abstract_monitor()
+    entry = make_quant_grouped_base(use_kernel=False)
+
+    S = jax.ShapeDtypeStruct
+
+    def args(slots: int):
+        rows = GROUP_ROW_BUCKET
+        return (
+            qparams,
+            monitor,
+            _abstract_accumulator(),
+            S((), jnp.float32),
+            S((slots, rows, SCHEMA.num_categorical), jnp.int32),
+            S((slots, rows, SCHEMA.num_numeric), jnp.float32),
+            S((slots, rows), jnp.bool_),
+        )
+
+    smallest, largest = GROUP_SLOT_BUCKETS[0], GROUP_SLOT_BUCKETS[-1]
+    return entry, {smallest: args(smallest), largest: args(largest)}
+
+
 def _build_bulk_score_chunk():
     import jax
     import jax.numpy as jnp
@@ -287,6 +350,22 @@ def registered_entry_points() -> list[EntryPoint]:
         EntryPoint(
             name="serve-predict-group-packed",
             build=_build_serve_predict_group,
+            params_in_spec=None,
+        ),
+        EntryPoint(
+            name="serve-predict-quant-packed",
+            build=_build_serve_quant,
+            params_in_spec=None,
+            # ONE program family: the quant tier runs the dense masked K-S
+            # statistic at EVERY bucket (ops/quant_kernel.py — the
+            # sort-based large-batch form does not lower on Mosaic, and
+            # the dense form is mathematically identical), so there is no
+            # 64→256 family split like the exact tier's.
+            bucket_families=((1, 8, 64, 256),),
+        ),
+        EntryPoint(
+            name="serve-predict-quant-group-packed",
+            build=_build_serve_quant_group,
             params_in_spec=None,
         ),
         EntryPoint(

@@ -20,6 +20,7 @@ from mlops_tpu.version import __version__
 MANIFEST_NAME = "manifest.json"
 PARAMS_NAME = "params.msgpack"
 BULK_PARAMS_NAME = "bulk_params.msgpack"
+QUANT_PARAMS_NAME = "quant_params.npz"
 ESTIMATOR_NAME = "estimator.joblib"
 PREPROCESS_NAME = "preprocess.npz"
 MONITOR_NAME = "monitor.npz"
@@ -47,6 +48,7 @@ class Bundle:
     estimator: Any = None  # SklearnBaseline (sklearn flavor) | None
     bulk_model: Any = None  # distilled student (train/distill.py) | None
     bulk_variables: dict[str, Any] | None = None
+    quant_params: dict[str, Any] | None = None  # int8/bf16 tier (ops/quant.py)
 
     @property
     def flavor(self) -> str:
@@ -62,6 +64,34 @@ class Bundle:
     @property
     def bulk_fidelity(self) -> dict[str, float]:
         return dict(self.manifest.get("bulk", {}).get("fidelity", {}))
+
+    @property
+    def has_quant(self) -> bool:
+        """True when the bundle carries the int8/bf16 quantized student
+        tier (`ops/quant.py`, fitted by `train/distill.py
+        distill_quant_student`). Presence alone does NOT make it
+        servable — `quant_gates_passed` is the engine's admission check."""
+        return self.quant_params is not None
+
+    @property
+    def quant_fidelity(self) -> dict[str, float]:
+        return dict(self.manifest.get("quant", {}).get("fidelity", {}))
+
+    @property
+    def quant_temperature(self) -> float:
+        """Post-hoc refit temperature for the quant tier's logits; falls
+        back to the exact tier's temperature for old manifests."""
+        quant = self.manifest.get("quant", {})
+        return float(quant.get("temperature", self.temperature))
+
+    @property
+    def quant_gates_passed(self) -> bool:
+        """The stamped packaging-time promotion decision
+        (`lifecycle/promote.py quant_tier_gates`). Absent block or absent
+        decision grades as FAILED — an ungraded tier must not serve."""
+        return bool(
+            self.manifest.get("quant", {}).get("gates", {}).get("passed", False)
+        )
 
     @property
     def model_config(self) -> ModelConfig:
@@ -115,6 +145,7 @@ def save_bundle(
     tags: dict[str, str] | None = None,
     calibration: dict[str, float] | None = None,
     bulk: Any = None,  # DistillResult (train/distill.py) | None
+    quant: Any = None,  # QuantDistillResult (train/distill.py) | None
 ) -> Path:
     """Write a self-contained bundle directory.
 
@@ -156,6 +187,25 @@ def save_bundle(
         }
         (directory / BULK_PARAMS_NAME).write_bytes(
             tree_bytes(bulk.student_params)
+        )
+    if quant is not None:
+        # Quantized student tier (train/distill.py distill_quant_student):
+        # flat npz (numpy has no bf16 — ops/quant.py ships the embed as
+        # its exact f32 image), with fidelity, refit temperature, AND the
+        # stamped gate decision so serving admission needs no labels.
+        import numpy as np
+
+        from mlops_tpu.ops.quant import QUANT_FORMAT, quant_params_to_arrays
+
+        manifest["quant"] = {
+            "format": QUANT_FORMAT,
+            "fidelity": quant.fidelity,
+            "temperature": quant.temperature,
+            "gates": quant.gates,
+        }
+        np.savez(
+            directory / QUANT_PARAMS_NAME,
+            **quant_params_to_arrays(quant.qparams),
         )
     preprocessor.save(directory / PREPROCESS_NAME)
     monitor.save(directory / MONITOR_NAME)
@@ -225,6 +275,23 @@ def load_bundle(directory: str | Path) -> Bundle:
             "builds — re-train/re-register the model with the current "
             "framework"
         ) from err
+    quant_params = None
+    if "quant" in manifest and (directory / QUANT_PARAMS_NAME).exists():
+        import numpy as np
+
+        from mlops_tpu.ops.quant import QUANT_FORMAT, quant_params_from_arrays
+
+        stored = manifest["quant"].get("format")
+        if stored != QUANT_FORMAT:
+            raise ValueError(
+                f"bundle {directory} carries quant params in format "
+                f"{stored!r}; this framework serves {QUANT_FORMAT!r} — "
+                "re-run packaging to regenerate the quant tier"
+            )
+        with np.load(directory / QUANT_PARAMS_NAME) as data:
+            quant_params = quant_params_from_arrays(
+                {k: data[k] for k in data.files}
+            )
     bulk_model = None
     bulk_variables = None
     if "bulk" in manifest and (directory / BULK_PARAMS_NAME).exists():
@@ -245,4 +312,5 @@ def load_bundle(directory: str | Path) -> Bundle:
         monitor=monitor,
         bulk_model=bulk_model,
         bulk_variables=bulk_variables,
+        quant_params=quant_params,
     )

@@ -593,6 +593,7 @@ def _serve(config) -> int:
             compile_cache=from_config(config),
             warmup_workers=config.cache.warmup_workers,
             model_shards=config.serve.model_shards,
+            serve_tier=config.serve.serve_tier,
         )
         engine = registry.default_engine
     else:
@@ -609,6 +610,7 @@ def _serve(config) -> int:
             compile_cache=from_config(config),
             warmup_workers=config.cache.warmup_workers,
             model_shards=config.serve.model_shards,
+            serve_tier=config.serve.serve_tier,
         )
     lifecycle = None
     if config.lifecycle.enabled:

@@ -32,6 +32,14 @@ CACHE_ENTRY_IDS: tuple[str, ...] = (
     # one.
     "serve-predict-packed",
     "serve-predict-group-packed",
+    # Quantized student tier (ops/quant_kernel.py): the int8/bf16 packed
+    # programs — same 7-arg cacheable signature and packed layout as the
+    # exact tier, different program family (Pallas-fused on TPU, jnp
+    # composite elsewhere). Separate ids: a quant executable served where
+    # the exact tier was asked for (or vice versa) must be a cache MISS,
+    # never a silent hit.
+    "serve-predict-quant-packed",
+    "serve-predict-quant-group-packed",
     "bulk-score-chunk",
 )
 
@@ -42,6 +50,7 @@ CACHED_JIT_BUILDERS: frozenset[str] = frozenset(
     {
         "make_chunk_scorer",  # parallel/bulk.py  -> bulk-score-chunk
         "make_bulk_jit",  # parallel/bulk.py      -> bulk-score-chunk
+        "make_bulk_quant_jit",  # parallel/bulk.py -> bulk-score-chunk (quant)
         "make_sharded_train_step",  # parallel/steps.py -> train-step-tp
     }
 )

@@ -252,6 +252,120 @@ def serve_group_jobs(
     return jobs
 
 
+def serve_quant_jobs(
+    qparams,
+    monitor,
+    buckets: tuple[int, ...],
+    temperature: float = 1.0,
+    placement=None,
+    device_tag: str = "",
+) -> list[CacheJob]:
+    """One job per warmup bucket of the QUANTIZED packed predict (entry
+    ``serve-predict-quant-packed`` — `ops/quant_kernel.py
+    make_quant_packed_base`). Same 7-arg signature and packed layout as
+    the exact tier; ``qparams`` may be the bundle's concrete int8/bf16
+    tree or the `ops/quant.py abstract_quant_params` twin. The quant tier
+    is single-device by contract (the engine refuses quant + model
+    shards), so there is no ``mesh`` axis — only the replica
+    ``placement``/``device_tag`` pin."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.predict import _acc_donation
+    from mlops_tpu.ops.quant import QUANT_FORMAT, quant_params_geometry
+    from mlops_tpu.ops.quant_kernel import make_quant_packed_base
+
+    concrete = _is_concrete(qparams)
+    if placement is not None and not concrete:
+        raise ValueError(
+            "placed quant warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    embed_dim, hidden = quant_params_geometry(qparams)
+    config_hash = (
+        model_fingerprint((QUANT_FORMAT, embed_dim, hidden)) + device_tag
+    )
+    donate = _acc_donation()
+    jobs = []
+    for bucket in buckets:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict-quant-packed",
+                jitted=jax.jit(
+                    make_quant_packed_base(), donate_argnums=donate
+                ),
+                abstract_args=_serve_avals(
+                    qparams, monitor, (bucket,), None, placement
+                ),
+                config_hash=config_hash,
+                donated=bool(donate),
+                label=f"serve-predict-quant-packed/b{bucket}",
+                meta={"bucket": bucket},
+                execute_args=(
+                    (qparams, monitor, _acc_zeros(),
+                     np.float32(temperature), *_schema_zeros((bucket,)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
+def serve_quant_group_jobs(
+    qparams,
+    monitor,
+    grid: list[tuple[int, int]],
+    temperature: float = 1.0,
+    placement=None,
+    device_tag: str = "",
+) -> list[CacheJob]:
+    """One job per (slots, rows) shape of the quant tier's vmapped
+    grouped dispatch (entry ``serve-predict-quant-group-packed``)."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.predict import _acc_donation
+    from mlops_tpu.ops.quant import QUANT_FORMAT, quant_params_geometry
+    from mlops_tpu.ops.quant_kernel import make_quant_grouped_base
+
+    concrete = _is_concrete(qparams)
+    if placement is not None and not concrete:
+        raise ValueError(
+            "placed quant warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    embed_dim, hidden = quant_params_geometry(qparams)
+    config_hash = (
+        model_fingerprint((QUANT_FORMAT, embed_dim, hidden)) + device_tag
+    )
+    donate = _acc_donation()
+    jobs = []
+    for slots, rows in grid:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict-quant-group-packed",
+                jitted=jax.jit(
+                    make_quant_grouped_base(), donate_argnums=donate
+                ),
+                abstract_args=_serve_avals(
+                    qparams, monitor, (slots, rows), None, placement
+                ),
+                config_hash=config_hash,
+                donated=bool(donate),
+                label=f"serve-predict-quant-group-packed/g{slots}x{rows}",
+                meta={"slots": slots, "rows": rows},
+                execute_args=(
+                    (qparams, monitor, _acc_zeros(),
+                     np.float32(temperature), *_schema_zeros((slots, rows)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
 # ------------------------------------------------------------- bulk entry
 def bulk_chunk_job(
     model,
@@ -284,6 +398,42 @@ def bulk_chunk_job(
         mesh_shape=tuple(mesh.devices.shape) if mesh is not None else None,
         label=f"bulk-score-chunk/{path_label}-c{chunk_rows}",
         meta={"chunk_rows": chunk_rows, "path": path_label},
+    )
+
+
+def bulk_quant_chunk_job(
+    qparams,
+    monitor,
+    chunk_rows: int,
+    mesh=None,
+    jitted: Callable | None = None,
+) -> CacheJob:
+    """The quant-tier bulk chunk program — same ``bulk-score-chunk``
+    entry, keyed apart by ``path_label="quant"`` plus the quant FORMAT and
+    geometry (the serve quant jobs' fingerprint discipline: the flax model
+    config says nothing about this program — the int8/bf16 packing scheme
+    and the (embed_dim, hidden) widths do)."""
+    import jax.numpy as jnp
+
+    from mlops_tpu.ops.quant import QUANT_FORMAT, quant_params_geometry
+    from mlops_tpu.parallel.bulk import make_bulk_quant_jit
+
+    embed_dim, hidden = quant_params_geometry(qparams)
+    return CacheJob(
+        entry_id="bulk-score-chunk",
+        jitted=jitted if jitted is not None else make_bulk_quant_jit(mesh),
+        abstract_args=(
+            tree_avals(qparams),
+            tree_avals(monitor),
+            _temp_aval(),
+            *_schema_avals((chunk_rows,), cat_dtype=jnp.int8),
+        ),
+        config_hash=model_fingerprint(
+            ("quant", QUANT_FORMAT, embed_dim, hidden)
+        ),
+        mesh_shape=tuple(mesh.devices.shape) if mesh is not None else None,
+        label=f"bulk-score-chunk/quant-c{chunk_rows}",
+        meta={"chunk_rows": chunk_rows, "path": "quant"},
     )
 
 
@@ -450,6 +600,51 @@ def _warm_serve_group(config, bundle) -> list[CacheJob]:
     )
 
 
+def _quant_serve_state(config, bundle):
+    """(qparams, monitor, temperature) for the quant serve entries, or
+    None when this deployment will never dispatch them: ``serve_tier``
+    "exact" (the knob that routes tiers — `serve/engine.py`), or a bundle
+    whose quant tier is absent/ungated (`bundle.quant_gates_passed`)."""
+    if config.serve.serve_tier == "exact":
+        return None
+    if bundle is not None:
+        if not (bundle.has_quant and bundle.quant_gates_passed):
+            return None
+        return bundle.quant_params, bundle.monitor, bundle.quant_temperature
+    from mlops_tpu.monitor.state import abstract_monitor_state
+    from mlops_tpu.ops.quant import abstract_quant_params
+
+    return (
+        abstract_quant_params(),
+        abstract_monitor_state(config.monitor),
+        1.0,
+    )
+
+
+def _warm_serve_quant(config, bundle) -> list[CacheJob]:
+    state = _quant_serve_state(config, bundle)
+    if state is None:
+        return []
+    qparams, monitor, temp = state
+    return serve_quant_jobs(
+        qparams, monitor,
+        tuple(config.serve.warmup_batch_sizes), temperature=temp,
+    )
+
+
+def _warm_serve_quant_group(config, bundle) -> list[CacheJob]:
+    state = _quant_serve_state(config, bundle)
+    if state is None or config.serve.batch_window_ms <= 0:
+        return []
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKETS, GROUP_SLOT_BUCKETS
+
+    qparams, monitor, temp = state
+    grid = [(s, r) for r in GROUP_ROW_BUCKETS for s in GROUP_SLOT_BUCKETS]
+    return serve_quant_group_jobs(
+        qparams, monitor, grid, temperature=temp
+    )
+
+
 def _warm_bulk(config, bundle) -> list[CacheJob]:
     import jax
 
@@ -482,6 +677,17 @@ def _warm_bulk(config, bundle) -> list[CacheJob]:
                 model, mcfg, variables, monitor, chunk, mesh,
                 path_label=path_label,
             )
+        )
+    if (
+        bundle is not None
+        and bundle.flavor != "sklearn"
+        and bundle.has_quant
+        and bundle.quant_gates_passed
+    ):
+        # Gate-passed quant tree present: warm its chunk program too, so a
+        # `score --tier quant` sweep deserializes instead of compiling.
+        jobs.append(
+            bulk_quant_chunk_job(bundle.quant_params, monitor, chunk, mesh)
         )
     return jobs
 
@@ -573,6 +779,8 @@ def _warm_train_tp(config, bundle) -> list[CacheJob]:
 _WARMERS: dict[str, Callable] = {
     "serve-predict-packed": _warm_serve_predict,
     "serve-predict-group-packed": _warm_serve_group,
+    "serve-predict-quant-packed": _warm_serve_quant,
+    "serve-predict-quant-group-packed": _warm_serve_quant_group,
     "bulk-score-chunk": _warm_bulk,
     "train-step-dense": _warm_train_dense,
     "train-step-tp": _warm_train_tp,

@@ -119,6 +119,12 @@ class TrainConfig:
     # bulk sweeps route through it so they beat the sklearn GBM floor
     # instead of paying K× ensemble FLOPs; serving stays exact. The
     # student's fidelity record lands in the bundle manifest.
+    distill_quant: bool = False  # also package the int8/bf16 QUANTIZED
+    # student tier (train/distill.py distill_quant_student, served by
+    # ops/quant_kernel.py): the raw-speed serving/bulk tier behind the
+    # lifecycle AUC/ECE promotion gates. Opt-in — it costs a second
+    # distillation fit at packaging time, and only deployments that set
+    # serve.serve_tier (or bulk --tier quant) away from "exact" use it.
     pipeline_microbatches: int = 8  # GPipe microbatches per step on the
     # pipeline-parallel path (model.pipeline_stages > 0): bubble fraction
     # is (S-1)/(M+S-1), so raise M to amortize; batch_size must divide by
@@ -215,10 +221,33 @@ class ServeConfig:
     warmup_batch_sizes: tuple[int, ...] = (1, 8, 64, 256)
     batch_window_ms: float = 1.0  # micro-batching window: concurrent small
     # requests arriving within it coalesce into one vmapped dispatch
-    # (serve/batcher.py); 0 disables coalescing
+    # (serve/batcher.py); 0 disables coalescing. In continuous mode this
+    # is the CAP on the measured admit deadline, not a fixed wave
     max_group: int = 64  # most requests one vmapped dispatch may carry;
     # clamped to the largest warmed slot bucket. Large groups are what
     # amortize the flat per-dispatch transport round trip into req/s
+    batch_mode: str = "continuous"  # micro-batcher admission policy
+    # (serve/batcher.py): "continuous" admits pending requests into the
+    # next free in-flight dispatch slot at dispatch boundaries — while a
+    # dispatch is in flight new arrivals accumulate for free, so the
+    # admit wait only exists when the pipe is empty, where it is sized
+    # from the MEASURED dispatch time (batch_admit_fraction x EWMA,
+    # capped by batch_window_ms). "windowed" is the legacy fixed-wave
+    # policy: hold every group open for the full window first. Responses
+    # are bit-identical either way (group geometry never changes the
+    # per-request math — tests/test_batcher.py pins it)
+    batch_admit_fraction: float = 0.5  # continuous mode: fraction of the
+    # EWMA dispatch-stage seconds an empty-pipe group waits for
+    # co-travelers before dispatching. Higher coalesces more at idle,
+    # lower trims batch-1 p50; irrelevant under load (in-flight
+    # dispatches make the admit wait 0)
+    serve_tier: str = "exact"  # which packed program family serves
+    # (serve/engine.py): "exact" = the bundle's full model; "quant" =
+    # the int8/bf16 distilled student tier (ops/quant_kernel.py —
+    # Pallas-fused on TPU, ~2x bulk rows/s), REQUIRED to exist and to
+    # have passed its packaging-time fidelity gates (refuses otherwise);
+    # "auto" = quant when admissible, exact (logged) when not. Train
+    # with train.distill_quant=true to package the tier
     max_inflight: int = 4  # overlapped grouped dispatches the micro-batcher
     # may have in flight at once. Sync constraint: must not exceed
     # max_workers, or dispatches just queue inside the executor and the
@@ -334,6 +363,34 @@ class ServeConfig:
             )
         if self.workers < 0:
             problems.append(f"serve.workers={self.workers} must be >= 0")
+        if self.batch_window_ms < 0:
+            problems.append(
+                f"serve.batch_window_ms={self.batch_window_ms} must be "
+                ">= 0 (0 disables coalescing; negative has no meaning)"
+            )
+        if self.max_group < 2:
+            problems.append(
+                f"serve.max_group={self.max_group} must be >= 2 (a group "
+                "of one is the solo path; the batcher clamps the top end "
+                "to the largest warmed slot bucket)"
+            )
+        if self.batch_mode not in ("continuous", "windowed"):
+            problems.append(
+                f"serve.batch_mode={self.batch_mode!r} must be "
+                "'continuous' or 'windowed'"
+            )
+        if not 0.0 < self.batch_admit_fraction <= 1.0:
+            problems.append(
+                f"serve.batch_admit_fraction={self.batch_admit_fraction} "
+                "must be in (0, 1] — it scales the measured dispatch time "
+                "into the empty-pipe admit deadline; more than one whole "
+                "dispatch of waiting buys nothing a deeper group wouldn't"
+            )
+        if self.serve_tier not in ("exact", "quant", "auto"):
+            problems.append(
+                f"serve.serve_tier={self.serve_tier!r} must be 'exact', "
+                "'quant' or 'auto'"
+            )
         if self.drain_deadline_s <= 0:
             problems.append(
                 f"serve.drain_deadline_s={self.drain_deadline_s} must be "

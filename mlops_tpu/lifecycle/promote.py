@@ -118,6 +118,41 @@ def evaluate_gates(
     return GateDecision(passed=not reasons, reasons=tuple(reasons))
 
 
+def quant_tier_gates(
+    fidelity: dict[str, float], config: LifecycleConfig
+) -> GateDecision:
+    """The promotion-gate discipline applied to the QUANTIZED student tier
+    at packaging time (`train/distill.py distill_quant_student`).
+
+    Same knobs, same semantics as `evaluate_gates`, different evidence:
+    the quant tier never shadows live traffic — its AUC delta vs the
+    teacher and its calibrated ECE come from the held-out validation
+    split, post-quantization. The decision is STAMPED into the bundle's
+    quant manifest block, and `serve/engine.py` refuses to serve (or
+    auto-route to) a quant tier whose stamped decision failed — the gate
+    runs once where the labels are, not on every engine boot. Latency has
+    no gate here: the tier exists to be faster, and the bench round
+    measures it directly."""
+    reasons: list[str] = []
+    delta = fidelity.get("roc_auc_delta")
+    if delta is None:
+        reasons.append(
+            "auc: no labeled validation split — the quant tier cannot be "
+            "graded and must not serve"
+        )
+    elif delta < -config.max_auc_drop:
+        reasons.append(
+            f"auc: quant student trails the teacher by {-delta:.4f} > "
+            f"epsilon {config.max_auc_drop:g}"
+        )
+    ece = fidelity.get("ece")
+    if ece is not None and ece > config.max_ece:
+        reasons.append(
+            f"calibration: quant ECE {ece:.4f} > bound {config.max_ece:g}"
+        )
+    return GateDecision(passed=not reasons, reasons=tuple(reasons))
+
+
 def promote_engine(live, shadow: ShadowEngine) -> int:
     """Install the shadowed candidate into the live engine (zero-downtime
     ref-swap; the candidate engine's device state and warmed exec table

@@ -105,6 +105,38 @@ def ks_two_sample(
     return statistic, p_value
 
 
+def ks_small_masked_statistic(
+    ref_sorted: jnp.ndarray,  # f32 [R] ascending
+    ref_cdf: jnp.ndarray,  # f32 [R] ECDF_ref at its own points (right-cont.)
+    batch: jnp.ndarray,  # f32 [B] possibly padded, B small
+    mask: jnp.ndarray,  # bool [B] True for real rows
+) -> jnp.ndarray:
+    """The dense masked K-S STATISTIC alone — split from the p-value so
+    the Pallas fused kernel (`ops/quant_kernel.py`) can run the heavy
+    [B,R]/[R,B] comparison planes in-kernel while the Kolmogorov survival
+    function stays outside (its series builds ``arange`` constants, which
+    a Pallas kernel body cannot capture)."""
+    r = ref_sorted.shape[0]
+    ref_sorted = ref_sorted.astype(jnp.float32)
+    bvals = jnp.where(mask, batch.astype(jnp.float32), jnp.inf)
+    n_valid = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
+
+    # ECDFs at batch points ([B,R] and [B,B] comparisons).
+    f_ref_b = (ref_sorted[None, :] <= bvals[:, None]).sum(axis=1) / r
+    cnt_b = (bvals[None, :] <= bvals[:, None]).sum(axis=1).astype(jnp.float32)
+    f_b_b = jnp.minimum(cnt_b, n_valid) / n_valid
+    d_b = jnp.where(
+        jnp.isfinite(bvals), jnp.abs(f_ref_b - f_b_b), 0.0
+    ).max()
+
+    # ECDFs at reference points ([R,B] comparisons; ECDF_ref precomputed).
+    cnt_r = (bvals[None, :] <= ref_sorted[:, None]).sum(axis=1)
+    f_b_r = jnp.minimum(cnt_r.astype(jnp.float32), n_valid) / n_valid
+    d_r = jnp.abs(ref_cdf - f_b_r).max()
+
+    return jnp.where(mask.any(), jnp.maximum(d_b, d_r), 0.0)
+
+
 def ks_two_sample_small_masked(
     ref_sorted: jnp.ndarray,  # f32 [R] ascending
     ref_cdf: jnp.ndarray,  # f32 [R] ECDF_ref at its own points (right-cont.)
@@ -126,24 +158,8 @@ def ks_two_sample_small_masked(
     (+inf rows contribute 0 everywhere).
     """
     r = ref_sorted.shape[0]
-    ref_sorted = ref_sorted.astype(jnp.float32)
-    bvals = jnp.where(mask, batch.astype(jnp.float32), jnp.inf)
+    statistic = ks_small_masked_statistic(ref_sorted, ref_cdf, batch, mask)
     n_valid = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
-
-    # ECDFs at batch points ([B,R] and [B,B] comparisons).
-    f_ref_b = (ref_sorted[None, :] <= bvals[:, None]).sum(axis=1) / r
-    cnt_b = (bvals[None, :] <= bvals[:, None]).sum(axis=1).astype(jnp.float32)
-    f_b_b = jnp.minimum(cnt_b, n_valid) / n_valid
-    d_b = jnp.where(
-        jnp.isfinite(bvals), jnp.abs(f_ref_b - f_b_b), 0.0
-    ).max()
-
-    # ECDFs at reference points ([R,B] comparisons; ECDF_ref precomputed).
-    cnt_r = (bvals[None, :] <= ref_sorted[:, None]).sum(axis=1)
-    f_b_r = jnp.minimum(cnt_r.astype(jnp.float32), n_valid) / n_valid
-    d_r = jnp.abs(ref_cdf - f_b_r).max()
-
-    statistic = jnp.where(mask.any(), jnp.maximum(d_b, d_r), 0.0)
     en = jnp.sqrt(r * n_valid / (r + n_valid))
     p_value = _kolmogorov_sf((en + 0.12 + 0.11 / en) * statistic)
     return statistic, p_value

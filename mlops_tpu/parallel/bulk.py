@@ -60,7 +60,7 @@ class BulkScoreResult:
     feature_drift: dict[str, float]  # per-feature 1 - p_val on the sample
     rows: int
     elapsed_s: float  # device scoring time (excludes data generation/IO)
-    path: str = "exact"  # "exact" | "distilled" — which params scored
+    path: str = "exact"  # "exact" | "distilled" | "quant" — which params scored
     pipeline: dict[str, Any] | None = None  # per-stage busy/occupancy
     # timings from the streaming executor (None for the empty dataset)
     compile_cache: dict[str, Any] | None = None  # hit/miss/bypass counts +
@@ -111,18 +111,46 @@ def use_distilled_bulk(bundle: Bundle, exact: bool | None = None) -> bool:
     return jax.default_backend() == "cpu"
 
 
+def use_quant_bulk(bundle: Bundle, tier: str = "exact") -> bool:
+    """Quant-tier routing for bulk sweeps — the same demand-vs-preference
+    semantics as `serve/engine.py _resolve_tier`: ``tier="quant"`` is a
+    DEMAND (raises when the bundle has no gate-passed quant tree — an
+    explicit ask is never silently downgraded), ``"auto"`` takes quant
+    when it is there and gated, ``"exact"`` never routes here. Unlike the
+    serve tier there is no shard restriction: bulk quant is data-parallel
+    (params replicate over the 'data' axis like every other bulk path)."""
+    if tier not in ("exact", "quant", "auto"):
+        raise ValueError(f"tier must be exact|quant|auto, got {tier!r}")
+    if tier == "exact":
+        return False
+    eligible = (
+        bundle.flavor != "sklearn"
+        and bundle.has_quant
+        and bundle.quant_gates_passed
+    )
+    if tier == "quant" and not eligible:
+        raise ValueError(
+            "tier='quant' refused: bundle carries no gate-passed quant "
+            "params (train with train.distill_quant=true)"
+        )
+    return eligible
+
+
 def make_chunk_scorer(
     bundle: Bundle,
     mesh: Mesh | None,
     exact: bool | None = None,
     compile_cache=None,
     chunk_rows: int | None = None,
+    tier: str = "exact",
 ):
     """One compiled program: (cat[chunk,C], num[chunk,M], mask[chunk]) ->
     (probs, outlier_flags), fixed-shape per call site (the caller feeds
     equal-sized chunks so a single compile serves the whole sweep).
     Sharded over 'data' when a mesh is given. ``exact`` controls
-    distilled-student routing (see ``use_distilled_bulk``).
+    distilled-student routing (see ``use_distilled_bulk``); ``tier``
+    routes the int8/bf16 quant student (``use_quant_bulk``) and, when it
+    routes, takes precedence over the exact/distilled pair.
 
     With ``compile_cache`` + ``chunk_rows``, the chunk program is AOT
     loaded through the persistent executable cache (`compilecache/` entry
@@ -153,13 +181,21 @@ def make_chunk_scorer(
 
         return score_chunk
 
-    path = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
-    if path == "distilled":
+    if use_quant_bulk(bundle, tier):
+        path = "quant"
+        model, variables = None, bundle.quant_params
+        temperature = bundle.quant_temperature  # the quant tier carries
+        # its OWN post-distillation refit (train/calibrate.py) — the
+        # student's logit scale is not the teacher's
+        fn = make_bulk_quant_jit(mesh)
+    elif use_distilled_bulk(bundle, exact):
+        path = "distilled"
         model, variables = bundle.bulk_model, bundle.bulk_variables
+        fn = make_bulk_jit(model, mesh)
     else:
+        path = "exact"
         model, variables = bundle.model, bundle.variables
-
-    fn = make_bulk_jit(model, mesh)
+        fn = make_bulk_jit(model, mesh)
     # device_put the per-call program state ONCE (replicated over the mesh
     # when sharded): params/monitor travel as arguments now, and host
     # arrays would re-pay the transfer every chunk.
@@ -170,10 +206,16 @@ def make_chunk_scorer(
     t = place(np.float32(temperature))
     aot = None
     if compile_cache is not None and chunk_rows:
-        from mlops_tpu.compilecache.warmup import bulk_chunk_job
+        if path == "quant":
+            from mlops_tpu.compilecache.warmup import bulk_quant_chunk_job
 
-        aot = compile_cache.load_or_compile(
-            bulk_chunk_job(
+            job = bulk_quant_chunk_job(
+                variables, monitor, chunk_rows, mesh, jitted=fn
+            )
+        else:
+            from mlops_tpu.compilecache.warmup import bulk_chunk_job
+
+            job = bulk_chunk_job(
                 model,
                 bundle.model_config,
                 variables,
@@ -183,7 +225,7 @@ def make_chunk_scorer(
                 path_label=path,
                 jitted=fn,
             )
-        )
+        aot = compile_cache.load_or_compile(job)
 
     def score_chunk(cat, num, mask):
         run = aot if (aot is not None and cat.shape[0] == chunk_rows) else fn
@@ -231,6 +273,43 @@ def make_bulk_fused(model):
     return fused
 
 
+def make_bulk_quant_fused():
+    """Quant-tier bulk chunk body: the int8/bf16 student
+    (`ops/quant.py quant_student_logits` — dequantized in-jit, f32
+    compute) in place of the flax ensemble, same ``(probs, flags)``
+    contract and the same cacheable argument discipline as
+    `make_bulk_fused`. ``variables`` is the quant param DICT; the chunk
+    program stays tier-keyed in the compile cache via
+    ``path_label="quant"`` plus the quant geometry fingerprint
+    (`compilecache/warmup.py bulk_quant_chunk_job`)."""
+    from mlops_tpu.ops.quant import quant_student_logits
+
+    def fused(variables, monitor, temperature, cat, num, mask):
+        logits = quant_student_logits(variables, cat.astype(jnp.int32), num)
+        return jax.nn.sigmoid(logits / temperature), outlier_flags(monitor, num, mask)
+
+    return fused
+
+
+def make_bulk_quant_jit(mesh: Mesh | None):
+    """Quant twin of `make_bulk_jit` — the ONE jit site for the quant bulk
+    chunk program (whitelisted in `compilecache/registry.py
+    CACHED_JIT_BUILDERS`). Data-parallel like the exact path: rows shard
+    over 'data', the quant tree replicates (its int8/bf16 leaves are a few
+    KB — replication is free; there is no model axis in this tier)."""
+    fused = make_bulk_quant_fused()
+    if mesh is None:
+        return jax.jit(fused)
+    data_in = batch_sharding(mesh)
+    mask_in = batch_sharding(mesh, ndim=1)
+    rep = replicated(mesh)
+    return jax.jit(
+        fused,
+        in_shardings=(rep, rep, rep, data_in, data_in, mask_in),
+        out_shardings=(batch_sharding(mesh, ndim=1), batch_sharding(mesh, ndim=1)),
+    )
+
+
 def make_chunk_transfer(bundle: Bundle, mesh: Mesh | None):
     """Stage-3 device placement for the pipelined executors
     (`data/pipeline_exec.py`): ``jax.device_put`` the NEXT chunk's host
@@ -268,6 +347,7 @@ def score_dataset(
     exact: bool | None = None,
     pipeline_depth: int = 2,
     compile_cache=None,
+    tier: str = "exact",
 ) -> BulkScoreResult:
     """Stream ``ds`` through the chunk scorer; aggregate monitors.
 
@@ -282,10 +362,17 @@ def score_dataset(
 
     ``exact=None`` auto-routes through the distilled bulk student on CPU
     backends when the bundle carries one (``use_distilled_bulk``);
-    ``exact=True`` forces the serving-identical ensemble."""
+    ``exact=True`` forces the serving-identical ensemble. ``tier``
+    ("exact"|"quant"|"auto") routes the int8/bf16 quant student
+    (``use_quant_bulk``) ahead of both."""
     from mlops_tpu.data.pipeline_exec import Stage, run_pipeline
 
-    path = "distilled" if use_distilled_bulk(bundle, exact) else "exact"
+    if use_quant_bulk(bundle, tier):
+        path = "quant"
+    elif use_distilled_bulk(bundle, exact):
+        path = "distilled"
+    else:
+        path = "exact"
     n = ds.n
     if n == 0:
         # Same guard as the serving engine: an empty dataset has no drift
@@ -299,7 +386,8 @@ def score_dataset(
         )
     chunk = mesh_chunk_rows(chunk_rows, mesh)
     scorer = make_chunk_scorer(
-        bundle, mesh, exact, compile_cache=compile_cache, chunk_rows=chunk
+        bundle, mesh, exact, compile_cache=compile_cache, chunk_rows=chunk,
+        tier=tier,
     )
     transfer = make_chunk_transfer(bundle, mesh)
 

@@ -12,6 +12,15 @@ ones already fill the MXU alone and go straight through. The window closes
 early the moment a full group is waiting, so the added latency under load
 is ~0 (the group fills faster than the window) and at idle is bounded by
 ``window_ms`` (default 1 ms, well inside the 5 ms p50 budget).
+
+Two admission modes (ISSUE 17, ``serve.batch_mode``): the legacy
+"windowed" wave holds every group open for the fixed window first;
+"continuous" (default) admits pending requests into in-flight group slots
+at dispatch boundaries — the in-flight round trip is itself the
+coalescing window (paid for free), and only an empty pipe waits, for a
+deadline derived from the measured dispatch time instead of a guess. See
+``MicroBatcher.__init__`` and docs/performance.md "Continuous
+micro-batching".
 """
 
 from __future__ import annotations
@@ -73,13 +82,38 @@ class MicroBatcher:
         max_group: int = GROUP_SLOT_BUCKETS[-1],
         max_inflight: int = 4,
         fetch_inflight: int | None = None,
+        batch_mode: str = "continuous",
+        admit_fraction: float = 0.5,
     ):
+        if batch_mode not in ("continuous", "windowed"):
+            raise ValueError(
+                f"batch_mode must be 'continuous' or 'windowed', "
+                f"got {batch_mode!r}"
+            )
         self.engine = engine
         self._executor = executor
         self.window_s = window_ms / 1e3
         # A group can never exceed the largest warmed slot bucket — beyond
         # it predict_group would have no compiled shape to run.
         self.max_group = min(max_group, GROUP_SLOT_BUCKETS[-1])
+        # Admission policy (ISSUE 17). "windowed" (the legacy wave): every
+        # group holds its window open for the full window_s before
+        # claiming. "continuous": admission happens at DISPATCH
+        # BOUNDARIES — the drain loop claims the in-flight slot first,
+        # then admits whatever is pending. While other dispatches are in
+        # flight the admit wait is ZERO (their device round trips already
+        # gave co-travelers time to accumulate — that accumulation IS the
+        # window, paid for free); only an empty pipe waits, and then for
+        # ``admit_fraction`` of the EWMA-measured dispatch-stage seconds
+        # (the span stage that dominates batch-1 latency — BENCH_r08:
+        # fetch_sync ~1.59 of 2.02 ms p50), capped by window_s. Group
+        # geometry never changes per-request math, so responses are
+        # bit-identical across modes at any load.
+        self.batch_mode = batch_mode
+        self.admit_fraction = admit_fraction
+        self._dispatch_ewma_s = 0.0  # EWMA of measured dispatch-phase
+        # seconds (event-loop confined: updated by _dispatch tasks, read
+        # by _drain — both on the loop thread, never the executor)
         # (records, future, absolute loop-clock deadline or None,
         #  tracewire span or None)
         self._pending: list[
@@ -198,20 +232,49 @@ class MicroBatcher:
             self._drain_task = asyncio.create_task(self._drain())
         return await future
 
+    def _admit_deadline_s(self) -> float:
+        """Continuous mode's empty-pipe admit wait. 0 while dispatches are
+        in flight (the dispatch boundary IS the admission point — arrivals
+        during the in-flight round trip coalesced for free); otherwise a
+        fraction of the measured dispatch time, capped by the configured
+        window (cold start, before any measurement, waits the full cap)."""
+        if self._dispatch_tasks:
+            return 0.0
+        if self._dispatch_ewma_s <= 0.0:
+            return self.window_s
+        return min(self.window_s, self.admit_fraction * self._dispatch_ewma_s)
+
     async def _drain(self) -> None:
+        continuous = self.batch_mode == "continuous"
         while self._pending:
-            if len(self._pending) < self.max_group:
-                # Hold the window open for co-travelers; a full group (or
-                # anything setting _full) closes it early.
-                self._full.clear()
-                try:
-                    await asyncio.wait_for(self._full.wait(), self.window_s)
-                except asyncio.TimeoutError:
-                    pass
-            # Claim a group, then block only on the in-flight bound — NOT
-            # on the dispatch itself, so up to max_inflight groups ride
-            # overlapping device round trips.
-            await self._inflight.acquire()
+            if continuous:
+                # Admission at the dispatch boundary: claim the in-flight
+                # slot FIRST (the declared _inflight -> _fetch_ring order
+                # is unchanged — the wait below holds no other lock), then
+                # give an empty pipe a short, measured co-traveler wait.
+                await self._inflight.acquire()
+                admit = self._admit_deadline_s()
+                if admit > 0 and len(self._pending) < self.max_group:
+                    self._full.clear()
+                    try:
+                        await asyncio.wait_for(self._full.wait(), admit)
+                    except asyncio.TimeoutError:
+                        pass
+            else:
+                if len(self._pending) < self.max_group:
+                    # Hold the window open for co-travelers; a full group
+                    # (or anything setting _full) closes it early.
+                    self._full.clear()
+                    try:
+                        await asyncio.wait_for(
+                            self._full.wait(), self.window_s
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+                # Claim a group, then block only on the in-flight bound —
+                # NOT on the dispatch itself, so up to max_inflight groups
+                # ride overlapping device round trips.
+                await self._inflight.acquire()
             # Claim-time purge, two kinds of dead entry: ABANDONED ones
             # (the server's request deadline cancelled the caller's
             # future, e.g. during a device stall) are dropped — without
@@ -247,6 +310,17 @@ class MicroBatcher:
         # without awaiting). In-flight dispatch tasks complete on their
         # own; their futures don't need the drain loop.
 
+    def _observe_dispatch_s(self, seconds: float) -> None:
+        """Fold one measured dispatch-phase duration into the EWMA the
+        continuous admit deadline reads (event-loop confined, like every
+        other mutable batcher field)."""
+        if self._dispatch_ewma_s <= 0.0:
+            self._dispatch_ewma_s = seconds
+        else:
+            self._dispatch_ewma_s = (
+                0.8 * self._dispatch_ewma_s + 0.2 * seconds
+            )
+
     async def _dispatch(
         self,
         batch: list[tuple[list[dict], asyncio.Future, float | None, Any]],
@@ -269,15 +343,20 @@ class MicroBatcher:
         dispatch = getattr(self.engine, "dispatch_group", None)
         fetch = getattr(self.engine, "fetch_group", None)
         released = False
+        t_dispatch = loop.time()
         try:
             if dispatch is None or fetch is None:
                 responses = await loop.run_in_executor(
                     self._executor, self.engine.predict_group, requests
                 )
+                # One-phase engines: the whole call is the best available
+                # dispatch-time proxy for the continuous admit deadline.
+                self._observe_dispatch_s(loop.time() - t_dispatch)
             else:
                 handle = await loop.run_in_executor(
                     self._executor, dispatch, requests
                 )
+                self._observe_dispatch_s(loop.time() - t_dispatch)
                 for span in spans:
                     if span is not None:
                         # Encode rides inside dispatch_group on this plane

@@ -158,6 +158,7 @@ class InferenceEngine:
         warmup_workers: int = 0,
         model_shards: int = 1,
         device_index: int | None = None,
+        serve_tier: str = "exact",
     ):
         self.bundle = bundle
         # Bundle turnover (mlops_tpu/lifecycle/): the generation counts
@@ -220,6 +221,17 @@ class InferenceEngine:
         self._mesh = None
         self._replicated = None
         self._placement = None
+        # Serving tier (ISSUE 17): the quantized student
+        # (`ops/quant_kernel.py` — int8/bf16 params, Pallas-fused on TPU)
+        # is a different (program, params, temperature) TRIPLE behind the
+        # SAME dispatch machinery: the 7-arg packed signature, the AOT
+        # table, the accumulator chain, degraded mode, and the lifecycle
+        # locks are all tier-blind. "quant" demands the tier (raises when
+        # the bundle lacks a GATED one — an explicit ask must never be
+        # silently downgraded); "auto" takes it when admissible and logs
+        # the fallback otherwise. Single-device by contract: the quant
+        # params are a flat dict the partition rules don't cover.
+        self.serve_tier = self._resolve_tier(serve_tier, bundle)
         if bundle.flavor == "sklearn":
             # CPU tree-ensemble floor: host classifier + device monitors.
             # No grouped path — trees run on host threads anyway (and no
@@ -242,6 +254,15 @@ class InferenceEngine:
             # follows the committed shardings, and warmup bakes them
             # into the AOT artifacts (keyed by mesh shape, so sharded
             # and unsharded executables can never mix).
+            quant = self.serve_tier == "quant"
+            if quant:
+                # The quant triple: int8/bf16 params + the tier's own
+                # refit temperature (quantization shifts the logit scale;
+                # `train/distill.py distill_quant_student`).
+                serve_variables = bundle.quant_params
+                temperature = bundle.quant_temperature
+            else:
+                serve_variables = bundle.variables
             if self.model_shards > 1:
                 from mlops_tpu.parallel.sharding import (
                     param_shardings,
@@ -273,7 +294,7 @@ class InferenceEngine:
                     jax.devices()[device_index]
                 )
                 self._variables = jax.device_put(
-                    bundle.variables, self._placement
+                    serve_variables, self._placement
                 )
                 self._monitor = jax.device_put(
                     bundle.monitor, self._placement
@@ -287,7 +308,7 @@ class InferenceEngine:
                 # trees would re-pay the full host->device param
                 # transfer on every request; committed device arrays
                 # pass by reference.
-                self._variables = jax.device_put(bundle.variables)
+                self._variables = jax.device_put(serve_variables)
                 self._monitor = jax.device_put(bundle.monitor)
                 self._temperature = jax.device_put(np.float32(temperature))
             # Base-form packed programs, jitted with the same 7-arg
@@ -296,13 +317,26 @@ class InferenceEngine:
             donate = _acc_donation()
             # Warmed shapes never touch these jits (warmup fills the AOT
             # table through compilecache); they exist only so
-            # `_compile_novel` can AOT-lower a shape warmup missed.
+            # `_compile_novel` can AOT-lower a shape warmup missed. The
+            # tier picks the program family here, ONCE — every dispatch
+            # below is tier-blind.
+            if quant:
+                from mlops_tpu.ops.quant_kernel import (
+                    make_quant_grouped_base,
+                    make_quant_packed_base,
+                )
+
+                predict_base = make_quant_packed_base()
+                grouped_base = make_quant_grouped_base()
+            else:
+                predict_base = make_packed_predict_base(bundle.model)
+                grouped_base = make_packed_grouped_base(bundle.model)
             self._predict = jax.jit(  # tpulint: disable=TPU203
-                make_packed_predict_base(bundle.model), donate_argnums=donate
+                predict_base, donate_argnums=donate
             )
             self._predict_group = (
                 jax.jit(  # tpulint: disable=TPU203
-                    make_packed_grouped_base(bundle.model),
+                    grouped_base,
                     donate_argnums=donate,
                 )
                 if enable_grouping
@@ -342,6 +376,40 @@ class InferenceEngine:
             # failure — exported as mlops_tpu_degraded_dispatch_total.
             self._degraded = 0
         self.ready = False
+
+    def _resolve_tier(self, serve_tier: str, bundle: Bundle) -> str:
+        """Resolve the requested serving tier against what the bundle can
+        admissibly serve. "quant" is a demand (raise rather than silently
+        serve different bits than asked for); "auto" is a preference (take
+        the quant tier when gated and single-device, log the fallback)."""
+        if serve_tier not in ("exact", "quant", "auto"):
+            raise ValueError(
+                f"serve_tier must be 'exact', 'quant' or 'auto', "
+                f"got {serve_tier!r}"
+            )
+        if serve_tier == "exact":
+            return "exact"
+        admissible, why = True, ""
+        if bundle.flavor == "sklearn":
+            admissible, why = False, "sklearn bundles have no quant tier"
+        elif not bundle.has_quant:
+            admissible, why = False, "bundle carries no quant params"
+        elif not bundle.quant_gates_passed:
+            admissible, why = False, (
+                "quant tier failed (or was never graded by) the promotion "
+                "gates — lifecycle/promote.py quant_tier_gates"
+            )
+        elif self.model_shards > 1:
+            admissible, why = False, (
+                "quant tier is single-device; model_shards > 1 shards the "
+                "exact params only"
+            )
+        if admissible:
+            return "quant"
+        if serve_tier == "quant":
+            raise ValueError(f"serve_tier='quant' refused: {why}")
+        logger.info("serve_tier='auto' falling back to exact tier: %s", why)
+        return "exact"
 
     def _place_replicated(self, tree: Any) -> Any:
         """Device-put a host tree onto the engine's committed placement:
@@ -416,6 +484,8 @@ class InferenceEngine:
             run_jobs,
             serve_group_jobs,
             serve_predict_jobs,
+            serve_quant_group_jobs,
+            serve_quant_jobs,
         )
 
         bundle = self.bundle
@@ -428,34 +498,56 @@ class InferenceEngine:
             f"@dev{self.device_index}" if self.device_index is not None
             else ""
         )
-        jobs = serve_predict_jobs(
-            bundle.model,
-            bundle.model_config,
-            self._variables,  # device-resident (init): avals identical,
-            self._monitor,  # and the execute-once pass skips a transfer
-            tuple(self.buckets),
-            temperature=bundle.temperature,
-            mesh=self._mesh,  # sharded layouts bake into the artifacts
-            placement=self._placement,
-            device_tag=device_tag,
-        )
-        if self._predict_group is not None:
-            grid = [
-                (slots, rows)
-                for rows in GROUP_ROW_BUCKETS
-                for slots in GROUP_SLOT_BUCKETS
-            ]
-            jobs += serve_group_jobs(
-                bundle.model,
-                bundle.model_config,
-                self._variables,
+        grid = [
+            (slots, rows)
+            for rows in GROUP_ROW_BUCKETS
+            for slots in GROUP_SLOT_BUCKETS
+        ]
+        if self.serve_tier == "quant":
+            # The quant tier's own entry family (distinct cache ids:
+            # serve-predict-quant-*): same shapes, same dispatch-table
+            # keys, different programs + params tree.
+            jobs = serve_quant_jobs(
+                self._variables,  # the committed quant tree
                 self._monitor,
-                grid,
-                temperature=bundle.temperature,
-                mesh=self._mesh,
+                tuple(self.buckets),
+                temperature=bundle.quant_temperature,
                 placement=self._placement,
                 device_tag=device_tag,
             )
+            if self._predict_group is not None:
+                jobs += serve_quant_group_jobs(
+                    self._variables,
+                    self._monitor,
+                    grid,
+                    temperature=bundle.quant_temperature,
+                    placement=self._placement,
+                    device_tag=device_tag,
+                )
+        else:
+            jobs = serve_predict_jobs(
+                bundle.model,
+                bundle.model_config,
+                self._variables,  # device-resident (init): avals identical,
+                self._monitor,  # and the execute-once pass skips a transfer
+                tuple(self.buckets),
+                temperature=bundle.temperature,
+                mesh=self._mesh,  # sharded layouts bake into the artifacts
+                placement=self._placement,
+                device_tag=device_tag,
+            )
+            if self._predict_group is not None:
+                jobs += serve_group_jobs(
+                    bundle.model,
+                    bundle.model_config,
+                    self._variables,
+                    self._monitor,
+                    grid,
+                    temperature=bundle.temperature,
+                    mesh=self._mesh,
+                    placement=self._placement,
+                    device_tag=device_tag,
+                )
         for job, fn in run_jobs(
             jobs, cache=self.compile_cache, workers=self.warmup_workers
         ):

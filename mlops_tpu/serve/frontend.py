@@ -925,6 +925,7 @@ def _engine_main(
         warmup_workers=config.cache.warmup_workers,
         model_shards=serve_cfg.model_shards,
         device_index=device_index,
+        serve_tier=serve_cfg.serve_tier,
     )
     engines = registry.engines
     if trace is not None:

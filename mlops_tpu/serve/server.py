@@ -221,6 +221,8 @@ class HttpServer(HttpProtocol):
                 max_group=config.max_group,
                 max_inflight=t_inflight,
                 fetch_inflight=t_fetch,
+                batch_mode=config.batch_mode,
+                admit_fraction=config.batch_admit_fraction,
             )
             for eng in self.engines
         ]

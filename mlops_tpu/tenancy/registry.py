@@ -82,6 +82,7 @@ class TenantRegistry:
         warmup_workers: int = 0,
         model_shards: int = 1,
         device_index: int | None = None,
+        serve_tier: str = "exact",
     ) -> None:
         from mlops_tpu.bundle import load_bundle
         from mlops_tpu.serve.engine import InferenceEngine
@@ -109,6 +110,10 @@ class TenantRegistry:
                 warmup_workers=warmup_workers,
                 model_shards=model_shards,
                 device_index=device_index,
+                # Fleet-global like model_shards: per-tenant tier mixing
+                # would break architecture-twin executable sharing (the
+                # tiers are different program families).
+                serve_tier=serve_tier,
             )
             for bundle in self.bundles
         ]

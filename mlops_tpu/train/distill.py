@@ -26,9 +26,18 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from mlops_tpu.config import ModelConfig
+from mlops_tpu.config import LifecycleConfig, ModelConfig
 from mlops_tpu.data.encode import EncodedDataset
 from mlops_tpu.models import build_model, init_params
+from mlops_tpu.ops.quant import (
+    QUANT_EMBED_DIM,
+    QUANT_HIDDEN,
+    init_quant_master,
+    master_student_logits,
+    quant_student_logits,
+    quantize_student,
+)
+from mlops_tpu.train.calibrate import fit_temperature
 from mlops_tpu.train.metrics import binary_metrics
 
 
@@ -37,6 +46,28 @@ class DistillResult:
     student_config: ModelConfig
     student_params: Any
     fidelity: dict[str, float]  # prob-space agreement + AUC delta on valid
+
+
+@dataclasses.dataclass
+class QuantDistillResult:
+    """The quantized serving tier, fully graded at packaging time.
+
+    - ``qparams``: the int8/bf16 tree `ops/quant.py` serves from.
+    - ``fidelity``: POST-quantization numbers on the held-out split
+      (prob deltas vs teacher, AUC delta, calibrated ECE) — measured on
+      the exact tree that will serve, not the f32 master.
+    - ``temperature``: post-hoc refit (`train/calibrate.py`) on the QUANT
+      logits; quantization shifts the logit scale, so the teacher's
+      temperature does not transfer.
+    - ``gates``: the stamped promotion decision
+      (`lifecycle/promote.py quant_tier_gates`) plus the thresholds it
+      was graded against — the record `serve/engine.py` trusts.
+    """
+
+    qparams: Any
+    fidelity: dict[str, float]
+    temperature: float
+    gates: dict[str, Any]
 
 
 def teacher_logits(model, variables, ds: EncodedDataset, chunk: int = 16_384):
@@ -145,4 +176,138 @@ def distill_for_bulk(
         student_config=student_config,
         student_params=jax.device_get(params),
         fidelity=fidelity,
+    )
+
+
+def _quant_logits_chunked(
+    qparams: Any, ds: EncodedDataset, chunk: int = 16_384
+) -> np.ndarray:
+    """Quant-student forward over a dataset at one fixed chunk shape
+    (same padding discipline as `teacher_logits`)."""
+
+    @jax.jit
+    def fwd(cat, num):
+        return quant_student_logits(qparams, cat, num)
+
+    out = np.empty(ds.n, np.float32)
+    for start in range(0, ds.n, chunk):
+        stop = min(start + chunk, ds.n)
+        cat, num = ds.cat_ids[start:stop], ds.numeric[start:stop]
+        pad = chunk - (stop - start)
+        if pad:
+            cat = np.pad(cat, ((0, pad), (0, 0)))
+            num = np.pad(num, ((0, pad), (0, 0)))
+        out[start:stop] = np.asarray(
+            fwd(jnp.asarray(cat, jnp.int32), jnp.asarray(num))
+        )[: stop - start]
+    return out
+
+
+def distill_quant_student(
+    teacher_model,
+    teacher_variables,
+    train_ds: EncodedDataset,
+    valid_ds: EncodedDataset,
+    embed_dim: int = QUANT_EMBED_DIM,
+    hidden: int = QUANT_HIDDEN,
+    steps: int = 800,
+    batch_size: int = 2048,
+    learning_rate: float = 3e-3,
+    seed: int = 0,
+    lifecycle: LifecycleConfig | None = None,
+) -> QuantDistillResult:
+    """Distill the teacher into the QUANTIZED serving tier and grade it.
+
+    Same logit-MSE scan fit as `distill_for_bulk`, but against the
+    hand-written `ops/quant.py` student (one-hot embeds + a single
+    relu trunk — the architecture the Pallas fused kernel serves), then:
+
+    1. quantize the fitted f32 master (int8 dense / bf16 embeds),
+    2. refit the calibration temperature on the QUANT logits
+       (`train/calibrate.py fit_temperature` — quantization shifts the
+       logit scale, so the teacher's T does not transfer),
+    3. measure fidelity POST-quantization on the held-out split, and
+    4. stamp the promotion decision (`quant_tier_gates` — the same
+       ``max_auc_drop`` / ``max_ece`` knobs the shadow gates use).
+
+    The result is self-contained evidence: the bundle carries it, the
+    engine trusts it, the fidelity-pin test re-derives it.
+    """
+    from mlops_tpu.lifecycle.promote import (
+        expected_calibration_error,
+        quant_tier_gates,
+    )
+
+    lifecycle = lifecycle or LifecycleConfig()
+    t_train = teacher_logits(teacher_model, teacher_variables, train_ds)
+
+    master = init_quant_master(seed, embed_dim, hidden)
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(master)
+
+    cat = jnp.asarray(train_ds.cat_ids, jnp.int32)
+    num = jnp.asarray(train_ds.numeric)
+    target = jnp.asarray(t_train)
+    n = train_ds.n
+
+    def scan_step(carry, i):
+        master, opt_state = carry
+        idx = jax.random.randint(
+            jax.random.fold_in(jax.random.PRNGKey(seed + 1), i),
+            (batch_size,),
+            0,
+            n,
+        )
+
+        def loss_of(p):
+            pred = master_student_logits(p, cat[idx], num[idx])
+            return jnp.mean(jnp.square(pred - target[idx]))
+
+        loss, grads = jax.value_and_grad(loss_of)(master)
+        updates, opt_state = optimizer.update(grads, opt_state)
+        return (optax.apply_updates(master, updates), opt_state), loss
+
+    @jax.jit
+    def fit(master, opt_state):
+        return jax.lax.scan(scan_step, (master, opt_state), jnp.arange(steps))
+
+    (master, _), _ = fit(master, opt_state)
+    qparams = quantize_student(jax.device_get(master))
+
+    # Everything below grades the QUANTIZED tree — the exact tensor bits
+    # that will serve — never the f32 master.
+    t_valid = teacher_logits(teacher_model, teacher_variables, valid_ds)
+    s_valid = _quant_logits_chunked(qparams, valid_ds)
+    p_t = 1.0 / (1.0 + np.exp(-t_valid))
+    p_s = 1.0 / (1.0 + np.exp(-s_valid))
+    fidelity = {
+        "mean_abs_prob_delta": float(np.mean(np.abs(p_t - p_s))),
+        "max_abs_prob_delta": float(np.max(np.abs(p_t - p_s))),
+    }
+    temperature = 1.0
+    if valid_ds.labels is not None:
+        lab = np.asarray(valid_ds.labels, np.float32)
+        temperature = fit_temperature(s_valid, lab)
+        auc_t = float(
+            binary_metrics(jnp.asarray(t_valid), jnp.asarray(lab))["roc_auc"]
+        )
+        auc_s = float(
+            binary_metrics(jnp.asarray(s_valid), jnp.asarray(lab))["roc_auc"]
+        )
+        fidelity["teacher_roc_auc"] = auc_t
+        fidelity["student_roc_auc"] = auc_s
+        fidelity["roc_auc_delta"] = auc_s - auc_t
+        fidelity["ece"] = expected_calibration_error(
+            1.0 / (1.0 + np.exp(-s_valid / temperature)), lab
+        )
+    decision = quant_tier_gates(fidelity, lifecycle)
+    gates = decision.as_dict() | {
+        "max_auc_drop": lifecycle.max_auc_drop,
+        "max_ece": lifecycle.max_ece,
+    }
+    return QuantDistillResult(
+        qparams=qparams,
+        fidelity=fidelity,
+        temperature=float(temperature),
+        gates=gates,
     )

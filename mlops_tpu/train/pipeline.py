@@ -117,6 +117,7 @@ def _package_and_register(
     calibration: dict[str, float] | None = None,
     model_config=None,
     bulk=None,
+    quant=None,
 ) -> tuple[Path, str | None]:
     """Shared packaging tail: fit monitors, write the bundle, register it
     (notebook 02's role — `02-register-model.ipynb` cells 6-15).
@@ -142,6 +143,7 @@ def _package_and_register(
         tags=bundle_tags,
         calibration=calibration,
         bulk=bulk,
+        quant=quant,
     )
     model_uri = None
     if register:
@@ -176,6 +178,26 @@ def _maybe_distill(config, model_config, model, params, train_ds, valid_ds):
         train_ds,
         valid_ds,
         seed=config.train.seed,
+    )
+
+
+def _maybe_distill_quant(config, model, params, train_ds, valid_ds):
+    """Package-time quant-tier gate: opt-in (``train.distill_quant``),
+    flax teachers only. The quantized student ships with its own fidelity
+    record, refit temperature, and a STAMPED promotion decision
+    (`lifecycle/promote.py quant_tier_gates`) — the engine admits the
+    tier from the stamp alone."""
+    if model is None or not config.train.distill_quant:
+        return None
+    from mlops_tpu.train.distill import distill_quant_student
+
+    return distill_quant_student(
+        model,
+        {"params": params},
+        train_ds,
+        valid_ds,
+        seed=config.train.seed,
+        lifecycle=config.lifecycle,
     )
 
 
@@ -258,6 +280,9 @@ def run_training(
     bulk = _maybe_distill(
         config, config.model, calibration_model, result.params, train_ds, valid_ds
     )
+    quant = _maybe_distill_quant(
+        config, calibration_model, result.params, train_ds, valid_ds
+    )
     bundle_dir, model_uri = _package_and_register(
         config,
         run_dir,
@@ -276,6 +301,7 @@ def run_training(
         register=register,
         calibration=calibration,
         bulk=bulk,
+        quant=quant,
     )
     return PipelineResult(
         bundle_dir=bundle_dir,

@@ -36,20 +36,42 @@ _PEAKS: tuple[tuple[str, float], ...] = (
     ("v6e", 918e12),
 )
 
+# MXU throughput of each executing precision relative to the bf16 base
+# above (published TPU ratios: int8 doubles the bf16 peak, f32 halves it).
+# An MFU whose numerator is an f32 program but whose denominator is the
+# bf16 peak understates utilization 2x — the ISSUE 17 `mfu_bulk` fix: the
+# caller states the precision the measured program EXECUTES in, and the
+# bench payload records it next to the number.
+_DTYPE_SCALE: dict[str, float] = {
+    "bf16": 1.0,
+    "bfloat16": 1.0,
+    "f32": 0.5,
+    "float32": 0.5,
+    "int8": 2.0,
+}
 
-def peak_flops(device: Any) -> float | None:
-    """Best-known peak FLOP/s for ``device``, or None when unknown.
 
-    ``MLOPS_TPU_PEAK_FLOPS`` overrides (e.g. a CPU's measured GEMM peak,
-    letting CPU bench runs report a real MFU too).
+def peak_flops(device: Any, dtype: str = "bf16") -> float | None:
+    """Best-known peak FLOP/s for ``device`` at executing precision
+    ``dtype`` ("bf16"/"f32"/"int8" and aliases), or None when unknown.
+
+    ``MLOPS_TPU_PEAK_FLOPS`` overrides VERBATIM — no dtype scaling (the
+    user measured it at whatever precision they measured it at; e.g. a
+    CPU's measured GEMM peak, letting CPU bench runs report a real MFU
+    too).
     """
+    if dtype not in _DTYPE_SCALE:
+        raise ValueError(
+            f"unknown executing dtype {dtype!r}; expected one of "
+            f"{sorted(_DTYPE_SCALE)}"
+        )
     override = os.environ.get("MLOPS_TPU_PEAK_FLOPS")
     if override:
         return float(override)
     kind = getattr(device, "device_kind", "").lower()
     for needle, peak in _PEAKS:
         if needle in kind:
-            return peak
+            return peak * _DTYPE_SCALE[dtype]
     return None
 
 
@@ -96,19 +118,37 @@ def compiled_flops(fn, *args) -> float | None:
     return compile_with_flops(fn, *args)[1]
 
 
-def measured_gemm_peak(n: int = 1024, reps: int = 5) -> float:
+def measured_gemm_peak(
+    n: int = 1024, reps: int = 5, dtype: str = "f32"
+) -> float:
     """Empirical dense-matmul peak of the CURRENT backend (FLOP/s): best
-    of ``reps`` timed ``n×n @ n×n`` f32 matmuls. The honest denominator
-    for CPU fallback benches, where no published peak exists — reported
-    MFU then reads "fraction of this host's measured GEMM rate", which is
-    the comparable quantity to a TPU's spec-sheet peak."""
+    of ``reps`` timed ``n×n @ n×n`` matmuls at executing precision
+    ``dtype``. The honest denominator for CPU fallback benches, where no
+    published peak exists — reported MFU then reads "fraction of this
+    host's measured GEMM rate at the SAME precision", which is the
+    comparable quantity to a TPU's spec-sheet peak."""
     import time
 
     import jax.numpy as jnp
 
-    a = jnp.ones((n, n), jnp.float32)
-    b = jnp.ones((n, n), jnp.float32)
-    f = jax.jit(lambda a, b: a @ b)
+    jdt = {
+        "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+        "f32": jnp.float32, "float32": jnp.float32,
+        "int8": jnp.int8,
+    }[dtype]
+    if jdt == jnp.int8:
+        # int8 GEMM accumulates in int32 on every backend that has it.
+        a = jnp.ones((n, n), jnp.int8)
+        b = jnp.ones((n, n), jnp.int8)
+        f = jax.jit(
+            lambda a, b: jax.lax.dot(
+                a, b, preferred_element_type=jnp.int32
+            )
+        )
+    else:
+        a = jnp.ones((n, n), jdt)
+        b = jnp.ones((n, n), jdt)
+        f = jax.jit(lambda a, b: a @ b)
     jax.block_until_ready(f(a, b))
     best = float("inf")
     for _ in range(reps):

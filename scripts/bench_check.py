@@ -41,8 +41,11 @@ HEADLINE_KEYS = (
     "monitor_fetch_per_s", "fault_overhead_pct", "degraded_p99_ms",
     "trace_overhead_pct", "padding_waste_pct", "useful_rows_per_s",
     "slo_overhead_pct", "slo_armed_p50_ms",
-    # bulk + streaming
+    # bulk + streaming + quant tier (ISSUE 17)
     "bulk_rows_per_s_bulkpath", "bulk_stream_rows_per_s_pipelined",
+    "quant_rows_per_s", "quant_auc_delta",
+    # continuous micro-batching (ISSUE 17)
+    "batch1_p50_ms_continuous",
     # roofline + cold start
     "mfu_bulk", "engine_cold_start_s", "engine_warm_start_s",
     # serve planes
@@ -70,6 +73,11 @@ BOUNDS = (
     # the armed delta must stay single-digit percent (negative values
     # are measurement noise on a quiet box).
     ("slo_overhead_pct", -10.0, 10.0),
+    # Quant tier (ISSUE 17): the int8/bf16 student must beat the f32 bulk
+    # path by the acceptance ratio, at a held-out AUC delta no worse than
+    # the promotion gate's epsilon (LifecycleConfig.max_auc_drop).
+    ("quant_speedup_vs_student", 2.0, 1000.0),
+    ("quant_auc_delta", -0.01, 1.0),
 )
 
 

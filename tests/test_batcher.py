@@ -328,6 +328,119 @@ def test_idle_fast_path_skips_window(engine):
     assert not batcher._pending and not batcher._dispatch_tasks
 
 
+def test_continuous_and_windowed_bit_identical_under_load(
+    engine, sample_request
+):
+    """ISSUE 17 acceptance: the continuous batcher's responses are
+    BIT-IDENTICAL to the windowed batcher's (and to the solo path) at any
+    load — admission policy changes WHEN groups form, never the
+    per-request math (each slot's drift is over its own rows)."""
+    rng = np.random.default_rng(17)
+    requests = []
+    for i in range(60):
+        rec = dict(sample_request[0])
+        rec["age"] = float(20 + (i % 45))
+        rec["bill_amount_2"] = float(rng.integers(50, 9000))
+        requests.append([rec] * int(rng.integers(1, GROUP_ROW_BUCKET + 1)))
+
+    expected = [engine.predict_records(r) for r in requests]
+
+    def drive(mode):
+        async def run():
+            executor = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+            batcher = MicroBatcher(
+                engine, executor, window_ms=1.0, batch_mode=mode
+            )
+            try:
+                return await asyncio.gather(
+                    *[batcher.predict(r) for r in requests]
+                )
+            finally:
+                executor.shutdown(wait=True)
+
+        return asyncio.run(run())
+
+    continuous = drive("continuous")
+    windowed = drive("windowed")
+    assert continuous == windowed == expected
+
+
+def test_continuous_mode_still_coalesces(engine, sample_request):
+    """Continuous admission must keep the batcher's reason to exist:
+    concurrent arrivals ride shared dispatches (in-flight round trips are
+    the coalescing window), not 1 dispatch per request."""
+    calls = {"group": 0, "requests": 0}
+    real_dispatch = engine.dispatch_group
+
+    def counting_dispatch(reqs):
+        calls["group"] += 1
+        calls["requests"] += len(reqs)
+        return real_dispatch(reqs)
+
+    async def drive():
+        executor = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        batcher = MicroBatcher(
+            engine, executor, window_ms=1.0, batch_mode="continuous"
+        )
+        engine.dispatch_group = counting_dispatch
+        try:
+            reqs = _requests(sample_request, 24)
+            return await asyncio.gather(*(batcher.predict(r) for r in reqs))
+        finally:
+            del engine.dispatch_group
+            executor.shutdown(wait=True)
+
+    responses = asyncio.run(drive())
+    assert len(responses) == 24
+    # The idle fast-path may take the first arrival solo; the rest must
+    # share dispatches.
+    assert calls["group"] < calls["requests"], "nothing coalesced"
+
+
+def test_continuous_admit_deadline_policy(engine):
+    """The empty-pipe admit deadline: full window on cold start (no
+    measurement yet), ZERO while dispatches are in flight (their round
+    trips already coalesced arrivals for free), admit_fraction x the
+    dispatch EWMA once measured — always capped by window_ms."""
+    executor = concurrent.futures.ThreadPoolExecutor(max_workers=2)
+    b = MicroBatcher(
+        engine, executor, window_ms=10.0, batch_mode="continuous",
+        admit_fraction=0.5,
+    )
+    executor.shutdown(wait=False)
+    assert b._admit_deadline_s() == b.window_s  # cold start: full cap
+    b._observe_dispatch_s(0.004)
+    assert b._dispatch_ewma_s == pytest.approx(0.004)  # first sample sets
+    assert b._admit_deadline_s() == pytest.approx(0.002)  # fraction of it
+    b._observe_dispatch_s(0.008)  # EWMA folds 0.8 old + 0.2 new
+    assert b._dispatch_ewma_s == pytest.approx(0.8 * 0.004 + 0.2 * 0.008)
+    b._dispatch_ewma_s = 1.0  # a slow dispatch never exceeds the cap
+    assert b._admit_deadline_s() == b.window_s
+    b._dispatch_tasks.add(object())  # in flight: admission is free
+    assert b._admit_deadline_s() == 0.0
+    b._dispatch_tasks.clear()
+
+    with pytest.raises(ValueError, match="batch_mode"):
+        MicroBatcher(engine, None, batch_mode="adaptive")
+
+
+def test_server_wires_batch_mode_from_config(engine):
+    """ServeConfig.batch_mode / batch_admit_fraction reach the batcher
+    (TPU503 liveness: a knob that never reaches its consumer is dead)."""
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.serve.server import HttpServer
+
+    server = HttpServer(
+        engine, ServeConfig(batch_mode="windowed", batch_admit_fraction=0.25)
+    )
+    assert server.batcher.batch_mode == "windowed"
+    assert server.batcher.admit_fraction == 0.25
+    server._executor.shutdown(wait=False)
+    server = HttpServer(engine, ServeConfig())
+    assert server.batcher.batch_mode == "continuous"  # shipped default
+    server._executor.shutdown(wait=False)
+
+
 def test_stalled_solo_pushes_arrivals_back_to_batcher():
     """A hung fast-path call must not let later arrivals bypass the
     batcher's backpressure: while a solo dispatch is in flight, new

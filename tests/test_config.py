@@ -134,6 +134,31 @@ def test_serve_drain_deadline_knobs_validate():
     ServeConfig(workers=2, engine_respawn_eta_s=2.5).validate()
 
 
+def test_serve_batching_and_tier_knobs_validate():
+    """ISSUE 17 knobs: the hoisted micro-batcher geometry (batch_window_ms
+    / max_group), the admission mode pair (batch_mode /
+    batch_admit_fraction), and the serving tier selector are all rejected
+    by name when inconsistent."""
+    from mlops_tpu.config import ServeConfig, ServeConfigError
+
+    ServeConfig().validate()  # shipped defaults are consistent
+    ServeConfig(batch_mode="windowed", batch_window_ms=2.5).validate()
+    ServeConfig(serve_tier="auto").validate()
+    ServeConfig(batch_window_ms=0.0).validate()  # 0 = batching disabled
+    with pytest.raises(ServeConfigError, match="batch_window_ms"):
+        ServeConfig(batch_window_ms=-1.0).validate()
+    with pytest.raises(ServeConfigError, match="max_group"):
+        ServeConfig(max_group=1).validate()
+    with pytest.raises(ServeConfigError, match="batch_mode"):
+        ServeConfig(batch_mode="adaptive").validate()
+    with pytest.raises(ServeConfigError, match="batch_admit_fraction"):
+        ServeConfig(batch_admit_fraction=0.0).validate()
+    with pytest.raises(ServeConfigError, match="batch_admit_fraction"):
+        ServeConfig(batch_admit_fraction=1.5).validate()
+    with pytest.raises(ServeConfigError, match="serve_tier"):
+        ServeConfig(serve_tier="int8").validate()
+
+
 def test_lifecycle_breaker_knobs_validate():
     from mlops_tpu.config import LifecycleConfig, LifecycleConfigError
 
