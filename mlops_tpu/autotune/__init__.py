@@ -1,0 +1,32 @@
+"""gridtuner — the traffic-shape autotuner (ROADMAP item 2).
+
+The closed loop that re-grids the serving plane from its own telemetry:
+
+- `costmodel.py` (jax-free) fits a measured per-entry dispatch cost
+  model from the device-time cost ledger (slo/ledger.py);
+- `search.py` (jax-free) searches candidate bucket grids against the
+  observed requested-rows histogram (trace/shapes.py) and emits the
+  winner as a warmup **plan**;
+- `apply.py` pre-compiles the plan OFF the request path through the AOT
+  cache warmers (compilecache/warmup.py) and hot-applies it through the
+  lifecycle controller's bit-stable ``swap_bundle`` machinery — a regrid
+  is a promotion whose candidate differs in exec table, not params.
+
+Runs as the in-process `AutotuneController` (``autotune.enabled``) or
+one-shot offline via ``mlops-tpu autotune`` (ledger + spans in, plan
+out, `lifecycle`-style exit codes).
+"""
+
+from mlops_tpu.autotune.costmodel import (  # noqa: F401
+    CostModel,
+    demand_from_shapes,
+    demand_from_spans,
+    fit_cost_model,
+    ledger_rows_from_snapshot,
+)
+from mlops_tpu.autotune.search import GridPlan, search_plan  # noqa: F401
+from mlops_tpu.autotune.apply import (  # noqa: F401
+    AutotuneController,
+    apply_plan,
+    warm_plan,
+)

@@ -1,0 +1,416 @@
+"""Hot regrid: warm the plan off-path, swap it in with ~0 ms downtime.
+
+A regrid is a promotion whose candidate differs in EXEC TABLE, not
+params (lifecycle/promote.py's machinery, reused bit-for-bit):
+
+1. **Warm** (`warm_plan`): the plan's missing solo-bucket entries are
+   AOT-compiled (or cache-deserialized) through compilecache/warmup.py
+   jobs and installed into the LIVE engine's shared exec table under
+   ``_compile_lock`` per write — exactly `InferenceEngine.warmup`'s
+   discipline. A regrid never compiles under ``_acc_lock``, and a crash
+   mid-warm leaves only harmless extra warmed entries behind.
+2. **Twin** (`build_grid_twin`): an architecture twin of the live
+   engine is built with the plan's bucket set and adopts the live exec
+   table BY REFERENCE (`adopt_executables`) — no compile, no transfer
+   of executables.
+3. **Swap** (`apply_plan`): `swap_bundle(twin)` re-points the dispatch
+   refs (including ``buckets``/``max_bucket``) under the existing
+   ``_compile_lock`` -> ``_acc_lock`` order. Because the table is
+   SHARED, a request racing the swap still hits every old entry — no
+   hot-path compile is ever introduced; `rollback()` restores the old
+   grid in one call.
+
+`AutotuneController` runs the loop periodically off the request path
+(the LifecycleController thread discipline): gather ShapeStats demand +
+ledger costs, fit, search, gate on ``min_gain_pct``, apply (or dry-run
+"planned"), and audit predicted-vs-measured gain from windowed ledger
+deltas. On the ring plane the LEAD replica computes and persists the
+plan (``plan_dir/plan.json``, atomic); sibling controllers run in
+``adopt`` mode and apply the lead's plan locally, warming through the
+SHARED compile cache (deserialize, not compile).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from mlops_tpu import faults
+from mlops_tpu.autotune.costmodel import (
+    demand_from_shapes,
+    fit_cost_model,
+    ledger_rows_from_snapshot,
+)
+from mlops_tpu.autotune.search import GridPlan, search_plan
+from mlops_tpu.serve.metrics import AUTOTUNE_OUTCOMES
+from mlops_tpu.utils.io import atomic_write
+
+logger = logging.getLogger(__name__)
+
+PLAN_FILE = "plan.json"
+
+# Declared lock universe (tpulint TPU401): the controller's leaf lock
+# guards its own counters/gauges only — every engine call (warm, swap,
+# rollback) happens OUTSIDE it, so the engine's _compile_lock/_acc_lock
+# order composes with this lock held by nobody.
+TPULINT_LOCK_ORDER = {"AutotuneController": ("_lock",)}
+
+
+class RegridAborted(RuntimeError):
+    """The live bundle was promoted between warm and swap: the twin's
+    state snapshot is stale, and swapping it would silently ROLL BACK
+    the promotion. The plan is simply recomputed next tick."""
+
+
+def warm_plan(engine, buckets, workers: int = 0) -> int:
+    """Pre-compile the plan's missing solo-bucket entries into the LIVE
+    engine's exec table, off the request path. Returns how many entries
+    were actually warmed (0 = the table already covered the plan)."""
+    if not engine.monitor_accumulating:
+        raise ValueError(
+            "autotune requires the device-accumulating (flax) serving "
+            "flavor — the sklearn flavor has no AOT exec table to regrid"
+        )
+    from mlops_tpu.compilecache.warmup import (
+        run_jobs,
+        serve_predict_jobs,
+        serve_quant_jobs,
+    )
+
+    wanted = sorted(int(b) for b in buckets)
+    with engine._compile_lock:
+        missing = tuple(
+            b for b in wanted if ("bucket", b) not in engine._exec
+        )
+    if not missing:
+        return 0
+    bundle = engine.bundle
+    device_tag = (
+        f"@dev{engine.device_index}" if engine.device_index is not None
+        else ""
+    )
+    if engine.serve_tier == "quant":
+        jobs = serve_quant_jobs(
+            engine._variables,
+            engine._monitor,
+            missing,
+            temperature=bundle.quant_temperature,
+            placement=engine._placement,
+            device_tag=device_tag,
+        )
+    else:
+        jobs = serve_predict_jobs(
+            bundle.model,
+            bundle.model_config,
+            engine._variables,
+            engine._monitor,
+            missing,
+            temperature=bundle.temperature,
+            mesh=engine._mesh,
+            placement=engine._placement,
+            device_tag=device_tag,
+        )
+    for job, fn in run_jobs(
+        jobs, cache=engine.compile_cache,
+        workers=workers or engine.warmup_workers,
+    ):
+        # Per-write lock hold, never across run_jobs — warmup()'s
+        # discipline: live novel-shape compiles keep flowing.
+        with engine._compile_lock:
+            engine._exec[("bucket", job.meta["bucket"])] = fn
+    return len(missing)
+
+
+def build_grid_twin(engine, buckets):
+    """An architecture twin of the live engine carrying the plan's
+    bucket set, sharing the live exec table (and compile lock) BY
+    REFERENCE — `swap_bundle`-ready with zero additional compiles."""
+    from mlops_tpu.serve.engine import InferenceEngine
+
+    twin = InferenceEngine(
+        engine.bundle,
+        buckets=tuple(int(b) for b in buckets),
+        service_name=engine.service_name,
+        enable_grouping=engine.supports_grouping,
+        compile_cache=engine.compile_cache,
+        warmup_workers=engine.warmup_workers,
+        model_shards=engine.model_shards,
+        device_index=engine.device_index,
+        serve_tier=engine.serve_tier,
+    )
+    twin.adopt_executables(engine)
+    return twin
+
+
+def apply_plan(engine, buckets, workers: int = 0) -> int:
+    """Warm + twin + swap: the full hot regrid. Returns the engine's new
+    ``grid_generation``. Raises `RegridAborted` if a lifecycle promotion
+    landed between warm and swap (the twin would reinstall pre-promotion
+    params); the caller retries from fresh telemetry next tick."""
+    generation = engine.bundle_generation
+    warm_plan(engine, buckets, workers=workers)
+    # Injection point (mlops_tpu/faults): kill -9 here = a crash after
+    # the warm compiles landed but BEFORE the swap — the most state a
+    # regrid ever has in flight. Nothing durable or shared is mid-
+    # mutation at this point (the exec table only gained valid warmed
+    # entries; the grid refs are untouched), which is what the chaos
+    # smoke's mid-regrid scenario proves: a restart serves on the old
+    # grid and a re-run regrid completes cleanly.
+    faults.fire("autotune.regrid.midswap")
+    twin = build_grid_twin(engine, buckets)
+    if engine.bundle_generation != generation:
+        raise RegridAborted(
+            f"bundle generation moved {generation} -> "
+            f"{engine.bundle_generation} during warm; regrid plan is stale"
+        )
+    engine.swap_bundle(twin)
+    return engine.grid_generation
+
+
+class AutotuneController:
+    """The periodic gridtuner loop — one per engine process, started
+    after warmup, stopped at drain (the LifecycleController thread
+    pattern: daemon worker, `_stop` event, `run_once` as the testable
+    unit, a leaf `_lock` over counters only)."""
+
+    def __init__(
+        self,
+        engine,
+        config,
+        adopt: bool = False,
+        replica: int = 0,
+    ) -> None:
+        self.engine = engine
+        self.config = config
+        self.adopt = bool(adopt)
+        self.replica = int(replica)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._plans = {outcome: 0 for outcome in AUTOTUNE_OUTCOMES}
+        self._last_plan: GridPlan | None = None
+        self._predicted_gain: float | None = None
+        self._measured_gain: float | None = None
+        self._cooldown_until = 0.0
+        # Windowed goodput audit state: last ledger totals over solo
+        # entries (useful rows, device seconds) and the rate measured
+        # in the window before the last apply.
+        self._window_totals: tuple[float, float] | None = None
+        self._window_rate: float | None = None
+        self._pre_apply_rate: float | None = None
+        self._adopted_plan_gen = 0
+
+    # ------------------------------------------------------------ thread
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop,
+            name=f"autotune-controller-r{self.replica}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.run_once()
+            except Exception:  # tpulint: disable=TPU201
+                # The LifecycleController contract: a failed tick (bad
+                # telemetry, a compile error mid-warm, a promotion race)
+                # is counted and logged — the controller can never take
+                # the serving engine down with it.
+                logger.exception("autotune tick failed")
+                self._count("failed")
+
+    # ------------------------------------------------------------- ticks
+    def _count(self, outcome: str) -> None:
+        with self._lock:
+            self._plans[outcome] += 1
+
+    def run_once(self, now: float | None = None) -> str:
+        """One evaluation. Returns a short status string (tests + the
+        offline trace of what the loop decided and why)."""
+        if self.adopt:
+            return self._run_adopt()
+        now = time.monotonic() if now is None else now
+        self._measure_window()
+        if now < self._cooldown_until:
+            return "cooling"
+        stats = self.engine.shape_stats
+        ledger = self.engine.cost_ledger
+        if stats is None or ledger is None:
+            return "disarmed"
+        shape_entries = stats.snapshot()
+        demand = demand_from_shapes(shape_entries)
+        dispatches = sum(w for _, w in demand)
+        if dispatches < self.config.min_dispatches:
+            return f"held: {int(dispatches)} dispatches < min"
+        model = fit_cost_model(
+            ledger_rows_from_snapshot(ledger.snapshot())
+        )
+        if model is None:
+            return "held: no solo ledger observations"
+        plan = search_plan(
+            demand, model, tuple(self.engine.buckets),
+            self.config.max_entries,
+        )
+        with self._lock:
+            self._last_plan = plan
+            self._predicted_gain = plan.predicted_gain_pct
+        if (
+            plan.buckets == tuple(self.engine.buckets)
+            or plan.predicted_gain_pct < self.config.min_gain_pct
+        ):
+            self._count("rejected")
+            return (
+                f"rejected: gain {plan.predicted_gain_pct:.1f}% "
+                f"(min {self.config.min_gain_pct:g}%)"
+            )
+        if not self.config.apply:
+            self._count("planned")
+            self._persist(plan, applied=False)
+            return f"planned (dry-run): {list(plan.buckets)}"
+        try:
+            grid_generation = apply_plan(self.engine, plan.buckets)
+        except RegridAborted as exc:
+            logger.warning("regrid aborted: %s", exc)
+            self._count("failed")
+            return "failed: promotion raced the warm phase"
+        with self._lock:
+            self._pre_apply_rate = self._window_rate
+            self._measured_gain = None
+        self._cooldown_until = now + self.config.cooldown_s
+        self._count("applied")
+        self._persist(plan, applied=True, grid_generation=grid_generation)
+        return f"applied: grid_generation={grid_generation}"
+
+    def rollback(self) -> str:
+        """Restore the pre-regrid grid in one call (the runbook's manual
+        bail-out; the table still holds every retired entry, so the old
+        grid dispatches warm immediately)."""
+        self.engine.rollback()
+        self._count("rolled_back")
+        with self._lock:
+            self._pre_apply_rate = None
+            self._measured_gain = None
+        return f"rolled_back: grid_generation={self.engine.grid_generation}"
+
+    def _measure_window(self) -> None:
+        """Windowed measured goodput from ledger deltas: useful rows per
+        device-second over THIS tick's window — directly comparable to
+        the plan's predicted ``useful_rows_per_s`` and load-shape
+        independent (both numerator and denominator come from the same
+        dispatched window)."""
+        ledger = self.engine.cost_ledger
+        if ledger is None:
+            return
+        rows = device_s = 0.0
+        for row in ledger_rows_from_snapshot(ledger.snapshot()):
+            if not str(row["entry"]).startswith("bucket_"):
+                continue
+            rows += row["rows"]
+            device_s += row["device_s"]
+        prev = self._window_totals
+        self._window_totals = (rows, device_s)
+        if prev is None:
+            return
+        d_rows, d_dev = rows - prev[0], device_s - prev[1]
+        if d_dev <= 0 or d_rows <= 0:
+            return
+        rate = d_rows / d_dev
+        with self._lock:
+            self._window_rate = rate
+            if self._pre_apply_rate and self._pre_apply_rate > 0:
+                self._measured_gain = (
+                    100.0 * (rate - self._pre_apply_rate)
+                    / self._pre_apply_rate
+                )
+
+    # ---------------------------------------------------- plan file (ring)
+    def _plan_path(self) -> Path:
+        return Path(self.config.plan_dir) / PLAN_FILE
+
+    def _persist(
+        self, plan: GridPlan, applied: bool, grid_generation: int = 0
+    ) -> None:
+        if not self.config.plan_dir:
+            return
+        doc = plan.as_dict()
+        doc["applied"] = bool(applied)
+        doc["grid_generation"] = int(grid_generation)
+        doc["replica"] = self.replica
+        try:
+            path = self._plan_path()
+            path.parent.mkdir(parents=True, exist_ok=True)
+            atomic_write(path, (json.dumps(doc) + "\n").encode())
+        except OSError:
+            # The plan file is adoption/audit metadata, never
+            # load-bearing for the plane that already applied the grid.
+            logger.exception("failed to persist autotune plan")
+
+    def _run_adopt(self) -> str:
+        """Sibling-replica mode (ring plane): apply the lead's persisted
+        plan locally. The shared compile cache turns the warm phase into
+        deserialization — the lead paid the compiles once."""
+        path = self._plan_path()
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            return "adopt: no plan"
+        plan_gen = int(doc.get("grid_generation", 0))
+        if not doc.get("applied") or plan_gen <= self._adopted_plan_gen:
+            return "adopt: current"
+        buckets = tuple(int(b) for b in doc.get("buckets", ()))
+        if not buckets:
+            return "adopt: malformed plan"
+        if buckets == tuple(self.engine.buckets):
+            self._adopted_plan_gen = plan_gen
+            return "adopt: already on plan grid"
+        try:
+            grid_generation = apply_plan(self.engine, buckets)
+        except RegridAborted:
+            self._count("failed")
+            return "failed: promotion raced the adopt warm"
+        self._adopted_plan_gen = plan_gen
+        self._count("applied")
+        return f"adopted: grid_generation={grid_generation}"
+
+    # ------------------------------------------------------------ reads
+    def metrics_snapshot(self) -> dict[str, Any]:
+        """The shared-formatter input (`ServingMetrics.autotune_lines`)
+        — also what the ring telemetry loop mirrors into shm."""
+        with self._lock:
+            return {
+                "grid_generation": int(self.engine.grid_generation),
+                "plans": dict(self._plans),
+                "predicted_gain_pct": self._predicted_gain,
+                "measured_gain_pct": self._measured_gain,
+            }
+
+    def status(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "adopt": self.adopt,
+                "replica": self.replica,
+                "grid": list(self.engine.buckets),
+                "grid_generation": int(self.engine.grid_generation),
+                "plans": dict(self._plans),
+                "predicted_gain_pct": self._predicted_gain,
+                "measured_gain_pct": self._measured_gain,
+                "last_plan": (
+                    self._last_plan.as_dict() if self._last_plan else None
+                ),
+            }

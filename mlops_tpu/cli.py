@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
         ("trace-report", "aggregate a traced server's span JSONL "
                          "(trace.dir): p50/p99 per stage per compiled "
                          "entry — where each request spent its latency"),
+        ("autotune", "one-shot offline gridtuner pass: fit the per-entry "
+                     "dispatch cost model from the device-time ledger "
+                     "(slo.ledger_dir), search bucket grids against the "
+                     "observed traffic shape, and print the winning "
+                     "warmup plan (exit 3 when the current grid already "
+                     "wins)"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument(

@@ -549,6 +549,32 @@ def _serve(config) -> int:
     config.serve.validate()
     config.trace.validate()
     config.slo.validate()
+    config.autotune.validate()
+    if config.autotune.enabled:
+        # Cross-section contract, named HERE before anything warms: the
+        # gridtuner's demand input is the tracewire shape table and its
+        # cost input is the device-time ledger — without both armed the
+        # loop would tick forever disarmed.
+        if not config.trace.enabled:
+            raise SystemExit(
+                "autotune.enabled requires trace.enabled (the shape "
+                "histograms are the demand input)"
+            )
+        if not config.slo.ledger_dir:
+            raise SystemExit(
+                "autotune.enabled requires slo.ledger_dir (the cost "
+                "ledger is the cost-model input)"
+            )
+        if config.serve.tenants_path:
+            # One tunable grid per plane: a tenant fleet shares ONE
+            # shape table across engines with per-tenant grids, so
+            # per-tenant demand cannot be attributed — named here for
+            # BOTH planes, not silently mistuned.
+            raise SystemExit(
+                "autotune.enabled supports single-tenant planes only "
+                "(the shared shape table cannot attribute demand per "
+                "tenant grid)"
+            )
     if config.serve.workers > 1:
         # Multi-worker plane: N SO_REUSEPORT front-end processes + one
         # ENGINE child process, all forked and supervised by this
@@ -643,9 +669,17 @@ def _serve(config) -> int:
             ]
         else:
             lifecycle = LifecycleController(engine, config)
+    autotune = None
+    if config.autotune.enabled:
+        # gridtuner (mlops_tpu/autotune/): periodic cost-model fit +
+        # grid search + hot regrid on the live engine (single-tenant —
+        # the tenants_path guard above already ran).
+        from mlops_tpu.autotune import AutotuneController
+
+        autotune = AutotuneController(engine, config.autotune)
     serve_forever(
         engine, config.serve, lifecycle=lifecycle, trace=config.trace,
-        registry=registry, slo=config.slo,
+        registry=registry, slo=config.slo, autotune=autotune,
     )
     return 0
 
@@ -755,6 +789,71 @@ def _lifecycle(config) -> int:
         )
     )
     return 0 if decision.passed else 3
+
+
+def _autotune(config) -> int:
+    """One-shot OFFLINE gridtuner pass (the CI/cron twin of the
+    serve-integrated loop, `lifecycle`'s discipline): persisted ledger
+    shards + optional span history in -> one plan JSON line on stdout.
+    Exit 0 = a regrid is warranted (plan emitted), 3 = the searched grid
+    does not clear ``autotune.min_gain_pct`` (plan still printed for the
+    audit trail), SystemExit when the telemetry cannot produce a model
+    at all. jax-free end to end — runs anywhere the ledger dir mounts."""
+    from mlops_tpu.autotune import demand_from_spans, fit_cost_model
+    from mlops_tpu.autotune.search import search_plan
+    from mlops_tpu.slo import ledger_report
+
+    config.autotune.validate()
+    if not config.slo.ledger_dir:
+        raise SystemExit(
+            "autotune needs slo.ledger_dir (the directory a served "
+            "plane's cost ledger flushed into)"
+        )
+    report = ledger_report(config.slo.ledger_dir)
+    rows = report.get("entries", [])
+    model = fit_cost_model(rows)
+    if model is None:
+        raise SystemExit(
+            "autotune: no solo bucket_N entries in the ledger — serve "
+            "traffic with slo.ledger_dir armed first"
+        )
+    # Demand: span history when the trace dir has it (exact per-request
+    # rows), else the ledger's per-entry mean rows per dispatch (coarse
+    # — one point per warmed bucket — but measured).
+    demand = []
+    if config.trace.dir:
+        from mlops_tpu.trace import load_spans
+
+        try:
+            demand = demand_from_spans(load_spans(config.trace.dir))
+        except OSError:
+            demand = []
+    if not demand:
+        demand = [
+            (
+                max(1, int(round(r["rows"] / r["dispatches"]))),
+                float(r["dispatches"]),
+            )
+            for r in rows
+            if str(r.get("entry", "")).startswith("bucket_")
+            and float(r.get("dispatches", 0)) > 0
+        ]
+    if not demand:
+        raise SystemExit("autotune: no demand observations")
+    plan = search_plan(
+        demand,
+        model,
+        tuple(config.serve.warmup_batch_sizes),
+        config.autotune.max_entries,
+    )
+    doc = plan.as_dict()
+    warranted = (
+        plan.buckets != plan.baseline_buckets
+        and plan.predicted_gain_pct >= config.autotune.min_gain_pct
+    )
+    doc["regrid_warranted"] = warranted
+    print(json.dumps(doc))
+    return 0 if warranted else 3
 
 
 def _trace_report(config) -> int:
@@ -873,6 +972,7 @@ _HANDLERS = {
     "bench": _bench,
     "serve": _serve,
     "lifecycle": _lifecycle,
+    "autotune": _autotune,
     "warmup": _warmup,
     "trace-report": _trace_report,
     "flightrec": _flightrec,

@@ -887,6 +887,78 @@ class SLOConfig:
         return self
 
 
+class AutotuneConfigError(ValueError):
+    """An inconsistent autotuner geometry, named at startup (the
+    ``ServeConfigError`` discipline applied to the gridtuner knobs)."""
+
+
+@dataclasses.dataclass
+class AutotuneConfig:
+    """gridtuner (`mlops_tpu/autotune/`): the traffic-shape autotuner —
+    fit a measured per-entry cost model from the device-time ledger,
+    search bucket grids against the observed shape histogram, and
+    hot-apply the winner through the swap machinery. Disabled by
+    default; the one-shot offline pass runs via ``mlops-tpu autotune``."""
+
+    enabled: bool = False
+    interval_s: float = 60.0  # periodic evaluation cadence (its own
+    # thread, off the request path — the LifecycleController discipline)
+    min_dispatches: int = 512  # observed dispatches required before a
+    # plan is even considered: a near-empty shape histogram is noise,
+    # and regridding on noise churns the compile cache for nothing
+    max_entries: int = 16  # compile budget: the most solo-bucket entries
+    # a plan may carry (each is one AOT compile at warm time; group
+    # geometries stay the full fixed grid and don't count against this)
+    min_gain_pct: float = 5.0  # predicted useful_rows_per_s gain below
+    # which a plan is rejected (outcome="rejected"): swapping grids for
+    # sub-noise gains invalidates warm telemetry for nothing
+    apply: bool = True  # False = dry-run: plans are computed, exported,
+    # and persisted, but never hot-applied (the human-in-the-loop mode —
+    # read the plan, then `mlops-tpu serve autotune.apply=true`)
+    plan_dir: str = "autotune"  # plan root: the controller (and the
+    # offline CLI) writes plan.json here atomically; on the ring plane
+    # sibling replicas ADOPT the lead's applied plan from this file,
+    # warming through the shared compile cache instead of re-searching
+    cooldown_s: float = 300.0  # dead time after any apply/rollback
+    # before the next evaluation: measured-gain audit needs a full
+    # observation window on the new grid before anyone moves again
+
+    def validate(self) -> "AutotuneConfig":
+        problems: list[str] = []
+        if self.interval_s <= 0:
+            problems.append(
+                f"autotune.interval_s={self.interval_s} must be > 0 (a "
+                "zero interval busy-loops the controller thread)"
+            )
+        if self.min_dispatches < 1:
+            problems.append(
+                f"autotune.min_dispatches={self.min_dispatches} must be "
+                ">= 1 (0 would regrid on an empty histogram)"
+            )
+        if self.max_entries < 2:
+            problems.append(
+                f"autotune.max_entries={self.max_entries} must be >= 2 "
+                "(every grid needs at least a batch-1 bucket and a tail "
+                "bucket)"
+            )
+        if self.min_gain_pct < 0:
+            problems.append(
+                f"autotune.min_gain_pct={self.min_gain_pct} must be >= 0"
+            )
+        if self.cooldown_s < 0:
+            problems.append(
+                f"autotune.cooldown_s={self.cooldown_s} must be >= 0"
+            )
+        if self.enabled and not self.plan_dir:
+            problems.append(
+                "autotune.enabled=true requires autotune.plan_dir (the "
+                "plan root sibling replicas adopt from)"
+            )
+        if problems:
+            raise AutotuneConfigError("; ".join(problems))
+        return self
+
+
 @dataclasses.dataclass
 class CacheConfig:
     """Persistent AOT executable cache (`mlops_tpu/compilecache/`)."""
@@ -916,6 +988,9 @@ class Config:
     )
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    autotune: AutotuneConfig = dataclasses.field(
+        default_factory=AutotuneConfig
+    )
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     # (mesh: MeshConfig was removed — its data_axis/model_axis index knobs
     # were never read; the mesh axis layout is the hardcoded

@@ -59,4 +59,8 @@ POINTS: dict[str, str] = {
     "(raise = repeated retrain failure -> circuit breaker)",
     "lifecycle.shadow.evaluate": "entry of the shadow gate evaluation "
     "(raise = repeated evaluation failure -> circuit breaker)",
+    "autotune.regrid.midswap": "between a regrid's warm phase and its "
+    "bucket-set swap (kill = crashed apply at maximum in-flight state: "
+    "the exec table keeps only valid warmed entries, serving continues "
+    "on the old grid, and a restarted plane re-plans cleanly)",
 }

@@ -21,6 +21,7 @@ TPU serving mechanics (SURVEY.md SS7 "hard parts" — batch-1 latency):
 from __future__ import annotations
 
 import bisect
+import json
 import logging
 import threading
 import time
@@ -139,10 +140,12 @@ class _GroupHandle:
 # tests, and the compile-cache warmers have always imported them from the
 # engine.
 from mlops_tpu.serve.wire import (  # noqa: E402, F401  (re-exports)
+    EMPTY_RESPONSE_BYTES,
     GROUP_ROW_BUCKET,
     GROUP_ROW_BUCKETS,
     GROUP_SLOT_BUCKETS,
     empty_response,
+    encode_response,
     format_response,
 )
 
@@ -171,6 +174,12 @@ class InferenceEngine:
         # raise (the tee guards itself; a mirror bug must not 500 live
         # traffic).
         self.bundle_generation = 1
+        # Grid turnover (mlops_tpu/autotune/): counts hot REGRIDS — swaps
+        # (or rollbacks) whose candidate carried a different bucket set.
+        # A plain promotion (same grid, new params) leaves it untouched,
+        # so `mlops_tpu_grid_generation` moves only when the autotuner
+        # (or an operator) actually re-gridded the plane.
+        self.grid_generation = 1
         self._retired: tuple | None = None
         self._tee = None
         # tracewire shape telemetry (mlops_tpu/trace/shapes.py), armed by
@@ -769,13 +778,24 @@ class InferenceEngine:
                 "candidate engine lacks the grouped path the live engine "
                 "serves — build it with enable_grouping=True"
             )
+        if candidate.max_bucket < self.max_bucket:
+            # The front ends clamp max_batch against max_bucket at START
+            # (server.py / the ring slab geometry) — a swap that shrinks
+            # coverage would admit requests no warmed entry can hold.
+            # Regrids may re-tile below the ceiling, never lower it.
+            raise ValueError(
+                f"candidate max_bucket {candidate.max_bucket} < live "
+                f"{self.max_bucket}: a swap may never shrink shape "
+                "coverage below the admission ceiling"
+            )
         with self._compile_lock:
             with self._acc_lock:
                 self._retired = (
                     self.bundle, self._variables, self._monitor,
                     self._temperature, self._exec, self._predict,
-                    self._predict_group,
+                    self._predict_group, self.buckets, self.max_bucket,
                 )
+                regrid = candidate.buckets != self.buckets
                 self.bundle = candidate.bundle
                 self._variables = candidate._variables
                 self._monitor = candidate._monitor
@@ -783,7 +803,11 @@ class InferenceEngine:
                 self._exec = candidate._exec
                 self._predict = candidate._predict
                 self._predict_group = candidate._predict_group
+                self.buckets = candidate.buckets
+                self.max_bucket = candidate.max_bucket
                 self.bundle_generation += 1
+                if regrid:
+                    self.grid_generation += 1
         if self.cost_ledger is not None:
             # Re-key the ledger to the promoted architecture (outside the
             # locks: hashing a config dict must not extend the swap's
@@ -805,12 +829,16 @@ class InferenceEngine:
                 self._retired = (
                     self.bundle, self._variables, self._monitor,
                     self._temperature, self._exec, self._predict,
-                    self._predict_group,
+                    self._predict_group, self.buckets, self.max_bucket,
                 )
+                regrid = retired[7] != self.buckets
                 (self.bundle, self._variables, self._monitor,
                  self._temperature, self._exec, self._predict,
-                 self._predict_group) = retired
+                 self._predict_group, self.buckets,
+                 self.max_bucket) = retired
                 self.bundle_generation += 1
+                if regrid:
+                    self.grid_generation += 1
         if self.cost_ledger is not None:
             self._cost_tag = self._model_tag(self.bundle)  # see swap_bundle
         return self.bundle_generation
@@ -930,6 +958,29 @@ class InferenceEngine:
             span.stamp("encode")
         return self.predict_arrays(ds.cat_ids, ds.numeric, span=span)
 
+    def predict_records_wire(
+        self, records: list[dict[str, Any]], span=None
+    ) -> bytes:
+        """`predict_records` straight to wire bytes: the whole
+        encode→dispatch→fetch→json pipeline stays in the executor thread,
+        so the event loop only ever writes pre-encoded bytes (the
+        encode-bound residue the bench's http_vs_engine_ratio measured)."""
+        columns = records_to_columns(records)
+        ds = self.bundle.preprocessor.encode(columns)
+        if span is not None:
+            span.stamp("encode")
+        handle = self.dispatch_arrays(ds.cat_ids, ds.numeric)
+        if handle is None:
+            return EMPTY_RESPONSE_BYTES
+        if span is not None:
+            span.stamp("dispatch")
+            span.entry = f"bucket_{handle.rows}"
+        handle.start_copy()
+        response = self.fetch_arrays_wire(handle)
+        if span is not None:
+            span.stamp("device_fetch")
+        return response
+
     def predict_arrays(
         self, cat_ids: np.ndarray, numeric: np.ndarray, span=None
     ) -> dict[str, Any]:
@@ -1037,6 +1088,13 @@ class InferenceEngine:
         (~70-90 ms each through the remote-chip tunnel — measured), the
         packed buffer pays exactly one."""
         return format_response(*self.fetch_arrays_raw(handle))
+
+    def fetch_arrays_wire(self, handle: _ArraysHandle) -> bytes:
+        """`fetch_arrays` straight to wire bytes (serve/wire.py
+        `encode_response` — byte-identical to the dict path's json). The
+        batcher runs this in the executor thread, so the event loop never
+        pays the per-response encode again."""
+        return encode_response(*self.fetch_arrays_raw(handle))
 
     def fetch_arrays_raw(
         self, handle: _ArraysHandle
@@ -1236,6 +1294,22 @@ class InferenceEngine:
         sizes, preds, outs, drifts = self.fetch_group_raw(handle)
         return [
             format_response(preds[i, :n], outs[i, :n], drifts[i])
+            for i, n in enumerate(sizes)
+        ]
+
+    def fetch_group_wire(self, handle: _GroupHandle) -> list[bytes]:
+        """`fetch_group` straight to per-request wire bytes (executor-side
+        encode; see `fetch_arrays_wire`). Degenerate handles carry already
+        formatted dicts from the solo fallback — encode those here too so
+        the caller always gets bytes."""
+        if handle.responses is not None:
+            return [
+                json.dumps(r, separators=(",", ":")).encode()
+                for r in handle.responses
+            ]
+        sizes, preds, outs, drifts = self.fetch_group_raw(handle)
+        return [
+            encode_response(preds[i, :n], outs[i, :n], drifts[i])
             for i, n in enumerate(sizes)
         ]
 

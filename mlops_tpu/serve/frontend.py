@@ -69,10 +69,10 @@ from mlops_tpu.serve.metrics import (
     render_ring_metrics,
 )
 from mlops_tpu.serve.wire import (
+    EMPTY_RESPONSE_BYTES,
     RESP_EXPIRED,
     RESP_OK,
-    empty_response,
-    format_response,
+    encode_response,
 )
 
 logger = logging.getLogger("mlops_tpu.serve")
@@ -103,9 +103,9 @@ class FrontendServer(HttpProtocol):
     """The ring-backed front end: the same HTTP protocol, validation, and
     two-event logging as the single-process server, with the engine call
     replaced by claim slot -> write pre-encoded arrays -> await the
-    completion doorbell -> format the raw response arrays (the identical
-    `format_response` the engine-side fetch uses, so responses are
-    bit-identical to the single-process path)."""
+    completion doorbell -> encode the raw response arrays (the identical
+    `encode_response` wire formatter the engine-side fetch uses, so
+    responses are bit-identical to the single-process path)."""
 
     def __init__(
         self,
@@ -326,7 +326,7 @@ class FrontendServer(HttpProtocol):
         preprocessor, tags the slot so the engine dispatches the right
         bundle, and is the quota/metrics dimension."""
         if not record_dicts:
-            return empty_response()
+            return EMPTY_RESPONSE_BYTES
         if self.quota is None:
             # 1-tenant fleet: fairness is trivial; admission is exactly
             # the pre-tenancy slot path.
@@ -531,11 +531,13 @@ class FrontendServer(HttpProtocol):
             if span is not None:
                 self._stitch_engine_half(span, slot)
             pred, out, drift = self.client.response_arrays(slot)
-            # format_response materializes Python floats, so the slab is
+            # encode_response (serve/wire.py) goes straight from the slab
+            # views to wire bytes — byte-identical to the old
+            # format_response + json.dumps, but the handler's event loop
+            # never re-serializes the dict (the encode-bound residue).
+            # The encode materializes every float, so the slab is
             # quiescent before release.
-            response = format_response(
-                np.array(pred), np.array(out), np.array(drift)
-            )
+            response = encode_response(pred, out, drift)
             self.client.release(slot)
             slot = None
             return response
@@ -1065,6 +1067,31 @@ def _engine_main(
             "lifecycle controllers started (engine process, %d tenants)",
             len(service.lifecycles),
         )
+    autotune = None
+    if getattr(config, "autotune", None) is not None and config.autotune.enabled:
+        # gridtuner (mlops_tpu/autotune/), engine-side like the
+        # lifecycle loops: the LEAD replica fits/searches/applies and
+        # persists the plan (plan_dir/plan.json, atomic); every sibling
+        # runs an ADOPT-mode controller that applies the lead's plan
+        # locally — warming through the SHARED compile cache, so the
+        # lead paid each new bucket's compile exactly once and siblings
+        # deserialize. Started after warmup (it measures the warmed
+        # grid); gauges mirror into this replica's shm row each
+        # telemetry tick.
+        from mlops_tpu.autotune import AutotuneController
+
+        autotune = AutotuneController(
+            engines[0],
+            config.autotune,
+            adopt=(replica != 0),
+            replica=replica,
+        )
+        autotune.start()
+        service.autotune = autotune
+        logger.info(
+            "autotune controller started (replica %d, %s mode)",
+            replica, "adopt" if replica != 0 else "plan",
+        )
 
     supervisor = os.getppid()
     rc = 0
@@ -1090,6 +1117,8 @@ def _engine_main(
         ring.set_ready(False)
         for _, controller in service._tenant_lifecycles():
             controller.stop()
+        if autotune is not None:
+            autotune.stop()
         service.stop()
         if ledger is not None:
             ledger.close()  # final atomic flush
@@ -1184,8 +1213,12 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
     # one ring. The lifecycle loop is single-writer machinery (one
     # controller hot-swaps ONE engine's bundle); running it against a
     # replica fleet would promote replica 0 alone and silently serve
-    # mixed generations — refuse at startup until the fleet-wide
-    # promotion protocol (ROADMAP item 2's regrid/swap plane) lands.
+    # mixed generations. The gridtuner (mlops_tpu/autotune/) shipped a
+    # fleet-wide lead-plans/siblings-adopt protocol for EXEC-TABLE
+    # changes (docs/operations.md "Hot regrid runbook"), but bundle
+    # promotion also moves params/preprocessor state, which that
+    # adoption path deliberately does not carry — lifting this
+    # restriction stays out of scope here; refuse at startup.
     replicas = serve_cfg.engine_replicas
     if replicas > 1 and config.lifecycle.enabled:
         raise SystemExit(
@@ -1193,7 +1226,9 @@ def serve_multi_worker(config: Config, bundle_dir: str) -> int:
             "lifecycle.enabled: the lifecycle controller hot-swaps one "
             "engine process's bundle, and a replica fleet would serve "
             "mixed generations — run E=1 with the lifecycle loop, or "
-            "the replica set without it"
+            "the replica set without it. (The autotune plane's "
+            "lead-plans/siblings-adopt regrid protocol covers exec-table "
+            "changes only, not bundle promotion — see docs/operations.md)"
         )
     preprocess_paths: list[str] = []
     for spec in tenancy.tenants:

@@ -32,8 +32,20 @@ logger = logging.getLogger("mlops_tpu.serve")
 # Compact separators: the default ", "/": " pads every response body (and
 # both structured log events) with bytes pure of whitespace — on the c128
 # throughput path serialization is measurable hot-path CPU.
+def _json_default(obj):
+    # Wire-mode responses are pre-encoded json bytes (serve/wire.py
+    # encode_response); a sampled ModelOutput log event embeds one as its
+    # "data" field, and re-parsing here — only when the sampler actually
+    # fires — keeps the logged JSON identical to the dict-mode event.
+    if isinstance(obj, (bytes, bytearray)):
+        return json.loads(obj)
+    raise TypeError(
+        f"Object of type {type(obj).__name__} is not JSON serializable"
+    )
+
+
 def _dumps(payload) -> str:
-    return json.dumps(payload, separators=(",", ":"))
+    return json.dumps(payload, separators=(",", ":"), default=_json_default)
 
 
 class _LazyJson:
@@ -193,7 +205,9 @@ class HttpProtocol:
         respond. The SHELL — validation, the 422/413/504 contracts, and
         the two-event structured logging — is shared verbatim by every
         plane; subclasses provide only `_score` (engine call or ring
-        round trip), which returns the response dict, or a pre-built
+        round trip), which returns the response dict — or its
+        pre-encoded wire bytes (serve/wire.py `encode_response`), which
+        `_write_response` sends as-is — or a pre-built
         (status, payload, content_type[, headers]) tuple for its error
         paths (deadline 504, shed 503, failure 500).
 

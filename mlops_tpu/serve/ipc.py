@@ -99,6 +99,13 @@ import numpy as np
 from mlops_tpu import faults
 from mlops_tpu.schema import SCHEMA
 from mlops_tpu.serve.metrics import (
+    AUTO_GRID_GEN,
+    AUTO_HAS,
+    AUTO_HAS_MEAS,
+    AUTO_HAS_PRED,
+    AUTO_MEAS_GAIN,
+    AUTO_PRED_GAIN,
+    AUTOTUNE_OUTCOMES,
     ENG_INCARNATION,
     ENG_REPLAYED,
     ENG_ROWS_DISPATCHED,
@@ -265,6 +272,11 @@ TPULINT_SHM_OWNERSHIP = {
     "ledger_vals": "telemetry-loop",
     "life_vals": "telemetry-loop",
     "life_promos": "telemetry-loop",
+    # gridtuner (mlops_tpu/autotune/): per-replica controller state +
+    # the shape-mirror overflow marker, published per telemetry tick
+    "auto_vals": "telemetry-loop",
+    "auto_plans": "telemetry-loop",
+    "shape_evicted": "telemetry-loop",
 }
 
 # Which process role a lexical context runs as. Most specific wins:
@@ -279,6 +291,7 @@ TPULINT_SHM_ROLES = {
     "RingClient": "frontend-worker",
     "RingService": "engine-replica",
     "RingService._telemetry_loop": "telemetry-loop",
+    "RingService._write_autotune": "telemetry-loop",
     "RingService._write_ledger": "telemetry-loop",
     "RingService._write_robustness": "telemetry-loop",
     "RingService._write_shapes": "telemetry-loop",
@@ -298,6 +311,7 @@ TPULINT_SHM_ROLES = {
     "RequestRing.arm_slo": "supervisor",
     "RequestRing.write_monitor": "telemetry-loop",
     "RequestRing.write_lifecycle": "telemetry-loop",
+    "RequestRing.write_autotune": "telemetry-loop",
     # module-level process mains
     "_engine_main": "engine-replica",
     "serve_multi_worker": "supervisor",
@@ -689,6 +703,18 @@ class RequestRing:
             # gauges from shm.
             ("life_vals", np.dtype(np.float64), (T, 8)),
             ("life_promos", np.dtype(np.float64), (T, len(LIFE_OUTCOMES))),
+            # gridtuner state (mlops_tpu/autotune/), ONE ROW PER REPLICA
+            # (single writer: that replica's telemetry loop —
+            # serve/metrics.py AUTO_* indices): grid generation plus the
+            # predicted/measured gain audit pair in auto_vals,
+            # per-outcome plan counters in auto_plans. shape_evicted[r]
+            # mirrors replica r's ShapeStats mirror-overflow count
+            # (trace/shapes.py evicted_total) so a saturated shape table
+            # is VISIBLE on ring scrapes, not a silent demand bias.
+            ("auto_vals", np.dtype(np.float64), (R, 6)),
+            ("auto_plans", np.dtype(np.float64),
+             (R, len(AUTOTUNE_OUTCOMES))),
+            ("shape_evicted", np.dtype(np.float64), (R,)),
         ]
         offset = 0
         offsets = {}
@@ -1115,6 +1141,29 @@ class RequestRing:
         for i, outcome in enumerate(LIFE_OUTCOMES):
             self.life_promos[tenant, i] = float(promotions.get(outcome, 0))
         row[LIFE_HAS] = 1.0
+
+    def write_autotune(
+        self, snapshot: dict[str, Any], replica: int = 0
+    ) -> None:
+        """Engine-process single writer: install one replica's autotune
+        controller snapshot (`autotune/apply.py metrics_snapshot`) for
+        the front ends' /metrics renders. Same tearing contract as
+        `write_monitor`: per-field f64 stores are individually atomic
+        and a mid-update mix is gauge-tolerable."""
+        if not snapshot:
+            return
+        row = self.auto_vals[replica]
+        row[AUTO_GRID_GEN] = float(snapshot["grid_generation"])
+        predicted = snapshot.get("predicted_gain_pct")
+        row[AUTO_PRED_GAIN] = 0.0 if predicted is None else float(predicted)
+        row[AUTO_HAS_PRED] = 0.0 if predicted is None else 1.0
+        measured = snapshot.get("measured_gain_pct")
+        row[AUTO_MEAS_GAIN] = 0.0 if measured is None else float(measured)
+        row[AUTO_HAS_MEAS] = 0.0 if measured is None else 1.0
+        plans = snapshot.get("plans", {})
+        for i, outcome in enumerate(AUTOTUNE_OUTCOMES):
+            self.auto_plans[replica, i] = float(plans.get(outcome, 0))
+        row[AUTO_HAS] = 1.0
 
     def close(self) -> None:
         for bell in (*self.engine_doorbells, *self.worker_doorbells):
@@ -1599,6 +1648,12 @@ class RingService:
         # before start() (engine-process wiring in _engine_main).
         self.slo: Any = None
         self.cost_ledger: Any = None
+        # gridtuner (ISSUE 18): each replica's engine process attaches
+        # its `autotune.AutotuneController` (lead = planner, siblings =
+        # adopt mode) after warmup; the telemetry loop mirrors its gauge
+        # snapshot into this replica's auto_vals/auto_plans rows so any
+        # front end renders the fleet's regrid state.
+        self.autotune: Any = None
         self._slo_last = 0.0  # telemetry-thread private tick clock
         self._prof_handled = 0  # collector-thread private
         self._requests_since_fetch = 0  # collector-thread private counter;
@@ -2126,6 +2181,7 @@ class RingService:
             self._write_shapes()
             self._tick_slo()
             self._write_ledger()
+            self._write_autotune()
             if not (self._any_accumulating and self._mon_period > 0):
                 continue
             due_k = self._mon_every and (
@@ -2221,6 +2277,28 @@ class RingService:
         rep = self.replica
         stats.write_table(self.ring.shape_keys[rep], self.ring.shape_vals[rep])
         self.ring.shape_meta[rep] = stats.t0
+        # Eviction mirror (ISSUE 18 satellite): max() keeps the exported
+        # counter monotone across an engine respawn — fresh stats restart
+        # at zero, the shm row remembers the dead incarnation's total.
+        self.ring.shape_evicted[rep] = max(
+            float(self.ring.shape_evicted[rep]), float(stats.evicted_total)
+        )
+
+    def _write_autotune(self) -> None:
+        """Mirror the attached autotune controller's gauge snapshot into
+        this replica's shm row (host-dict reads plus f64 stores — no
+        device work) so every front end's /metrics renders the fold."""
+        controller = self.autotune
+        if controller is None:
+            return
+        try:
+            self.ring.write_autotune(
+                controller.metrics_snapshot(), self.replica
+            )
+        # Telemetry breadth contract: a controller mid-regrid (or a
+        # snapshot bug) costs one gauge refresh, never the thread.
+        except Exception:  # tpulint: disable=TPU201
+            logger.exception("ring autotune write failed; gauges stale")
 
     def _tenant_lifecycles(self) -> list[tuple[int, Any]]:
         """(tenant index, controller) pairs: the per-tenant list when the

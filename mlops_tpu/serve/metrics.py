@@ -61,6 +61,28 @@ ROB_EXPIRED_ENGINE, ROB_DEGRADED = range(2)
 # render_ring_metrics and the single-process render share this tuple so
 # the label sets can never diverge between telemetry planes).
 LIFE_OUTCOMES = ("promoted", "rejected", "rolled_back")
+# gridtuner plan outcomes (mlops_tpu/autotune/), in their ring-array
+# order (write_autotune / render_ring_metrics and the single-process
+# render share this tuple — same discipline as LIFE_OUTCOMES):
+# applied = hot regrid landed; planned = dry-run winner persisted but
+# not applied (autotune.apply=false); rejected = searched but below
+# min_gain_pct (or already optimal); rolled_back = operator bail-out;
+# failed = tick error / promotion raced the warm phase.
+AUTOTUNE_OUTCOMES = (
+    "applied", "planned", "rejected", "rolled_back", "failed"
+)
+# Field indices of the ring's per-replica autotune gauge block
+# (engine-process telemetry-loop writer; see RequestRing.write_autotune).
+# Gains ride value + has-flag pairs because 0.0 is a legitimate gain,
+# not "no audit yet" (the LIFE_AUC_DELTA convention).
+(
+    AUTO_GRID_GEN,
+    AUTO_PRED_GAIN,
+    AUTO_HAS_PRED,
+    AUTO_MEAS_GAIN,
+    AUTO_HAS_MEAS,
+    AUTO_HAS,
+) = range(6)
 
 
 DEFAULT_TENANT_LABEL = "default"
@@ -244,6 +266,12 @@ class ServingMetrics:
         # when a loop is actually running, so a loop-less deployment's
         # scrape is byte-identical to pre-lifecycle builds.
         self.lifecycle: dict[str, dict] = {}
+        # gridtuner gauges (mlops_tpu/autotune/): one block per PLANE,
+        # not per tenant — twin tenants share one exec table, so the
+        # grid (and its generation) is plane-level state. None until a
+        # controller installs a snapshot (same export-only-when-running
+        # contract as the lifecycle block).
+        self.autotune: dict | None = None
 
     # Known routes only: arbitrary request paths must not become unbounded
     # (and injectable) Prometheus label values.
@@ -336,6 +364,14 @@ class ServingMetrics:
             return
         with self._lock:
             self.lifecycle[tenant] = dict(snapshot)
+
+    def set_autotune(self, snapshot: dict | None) -> None:
+        """Install an autotune-controller snapshot
+        (`autotune/apply.py metrics_snapshot`) for the next render."""
+        if not snapshot:
+            return
+        with self._lock:
+            self.autotune = dict(snapshot)
 
     def slo_counts(
         self, latency_threshold_ms: float, tenants: tuple[str, ...]
@@ -504,6 +540,50 @@ class ServingMetrics:
             )
         return lines
 
+    @staticmethod
+    def autotune_lines(snapshot: dict | None) -> list[str]:
+        """The gridtuner gauge block — ONE definition shared by the
+        single-process render and the ring render so both telemetry
+        planes export identical series names. Plane-level (no tenant
+        label): the grid is the exec table's geometry, shared by every
+        tenant adopted onto it. Empty until a controller runs."""
+        if not snapshot:
+            return []
+        lines = [
+            "# TYPE mlops_tpu_grid_generation gauge",
+            f"mlops_tpu_grid_generation "
+            f"{int(snapshot['grid_generation'])}",
+            "# TYPE mlops_tpu_autotune_plans_total counter",
+        ]
+        plans = snapshot.get("plans", {})
+        for outcome in AUTOTUNE_OUTCOMES:
+            lines.append(
+                f'mlops_tpu_autotune_plans_total{{outcome="{outcome}"}} '
+                f"{int(plans.get(outcome, 0))}"
+            )
+        predicted = snapshot.get("predicted_gain_pct")
+        if predicted is not None:
+            # The audit pair: what the cost model promised for the last
+            # searched plan vs what the post-apply ledger window
+            # measured — the divergence IS the model's error bar.
+            lines.append(
+                "# TYPE mlops_tpu_autotune_predicted_gain_pct gauge"
+            )
+            lines.append(
+                f"mlops_tpu_autotune_predicted_gain_pct "
+                f"{float(predicted):.3f}"
+            )
+        measured = snapshot.get("measured_gain_pct")
+        if measured is not None:
+            lines.append(
+                "# TYPE mlops_tpu_autotune_measured_gain_pct gauge"
+            )
+            lines.append(
+                f"mlops_tpu_autotune_measured_gain_pct "
+                f"{float(measured):.3f}"
+            )
+        return lines
+
     def render(self) -> str:
         """Prometheus text format. Per-traffic series carry the
         ``tenant`` label (constant "default" on a single-tenant plane,
@@ -612,6 +692,7 @@ class ServingMetrics:
                 lines.extend(
                     self.lifecycle_lines(self.lifecycle[tenant], tenant)
                 )
+            lines.extend(self.autotune_lines(self.autotune))
             return "\n".join(lines) + "\n"
 
 
@@ -892,7 +973,18 @@ def render_ring_metrics(ring) -> str:
             ]
         )
         elapsed = time.monotonic() - min(metas[r] for r in armed)
-        lines.extend(render_entries_lines(entries, elapsed))
+        # Eviction fold: per-replica mirror rows are independent tables,
+        # so the fleet total is the plain sum (each row is already
+        # respawn-monotone — max()'d at write time).
+        evicted = getattr(ring, "shape_evicted", None)
+        evicted_total = (
+            int(sum(float(evicted[r]) for r in armed))
+            if evicted is not None
+            else 0
+        )
+        lines.extend(
+            render_entries_lines(entries, elapsed, evicted=evicted_total)
+        )
     if getattr(ring, "slo_armed", False):
         # sloscope (mlops_tpu/slo/): the SLO/alert block the LEAD engine
         # replica's telemetry loop last mirrored into shm — rendered by
@@ -933,6 +1025,52 @@ def render_ring_metrics(ring) -> str:
             ]
         )
         lines.extend(render_entry_lines(entries))
+    auto_vals = getattr(ring, "auto_vals", None)
+    auto_armed = (
+        [r for r in range(R) if float(auto_vals[r, AUTO_HAS])]
+        if auto_vals is not None
+        else []
+    )
+    if auto_armed:
+        # gridtuner block, rebuilt as a snapshot dict so the SAME
+        # formatter emits it (identical series names across planes).
+        # grid_generation folds to the MIN over armed replicas — the
+        # fleet's adopted floor: the gauge moves only once every sibling
+        # has adopted the lead's plan, which is the convergence signal a
+        # regrid runbook watches. Plan counters sum across replicas; the
+        # gain audit gauges come from the LEAD (lowest armed) replica —
+        # the one that fit the model and searched the plan.
+        lead = auto_armed[0]
+        lines.extend(
+            ServingMetrics.autotune_lines(
+                {
+                    "grid_generation": int(
+                        min(
+                            ring.auto_vals[r, AUTO_GRID_GEN]
+                            for r in auto_armed
+                        )
+                    ),
+                    "plans": {
+                        outcome: int(
+                            sum(
+                                ring.auto_plans[r, i] for r in auto_armed
+                            )
+                        )
+                        for i, outcome in enumerate(AUTOTUNE_OUTCOMES)
+                    },
+                    "predicted_gain_pct": (
+                        float(ring.auto_vals[lead, AUTO_PRED_GAIN])
+                        if ring.auto_vals[lead, AUTO_HAS_PRED]
+                        else None
+                    ),
+                    "measured_gain_pct": (
+                        float(ring.auto_vals[lead, AUTO_MEAS_GAIN])
+                        if ring.auto_vals[lead, AUTO_HAS_MEAS]
+                        else None
+                    ),
+                }
+            )
+        )
     for t, tenant in enumerate(tenants):
         if not ring.life_vals[t, LIFE_HAS]:
             continue

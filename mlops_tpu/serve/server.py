@@ -170,6 +170,10 @@ class HttpServer(HttpProtocol):
         # on scrapes. Both None = every hook is one is-None check.
         self.slo_engine = None
         self.cost_ledger = None
+        # gridtuner (mlops_tpu/autotune/), armed by _serve when
+        # autotune.enabled: the controller loops on its own thread; the
+        # server's only job is rendering its gauges on scrapes.
+        self.autotune = None
         self._slo_task: asyncio.Task | None = None
         # Device-resident monitor aggregate telemetry (serve/engine.py
         # monitor_snapshot): the request path only counts requests; the
@@ -223,8 +227,16 @@ class HttpServer(HttpProtocol):
                 fetch_inflight=t_fetch,
                 batch_mode=config.batch_mode,
                 admit_fraction=config.batch_admit_fraction,
+                # Accumulating engines fold monitor totals on device, so
+                # _score's else-branch (observe_prediction, which needs the
+                # dict) never runs for them — they can take the wire path:
+                # responses come back as pre-encoded bytes built in the
+                # executor, and the event loop skips the per-response
+                # json.dumps (the encode-bound residue, ~7% of loop time
+                # at c128). Non-accumulating (sklearn) engines keep dicts.
+                wire_responses=self._accumulating[i],
             )
-            for eng in self.engines
+            for i, eng in enumerate(self.engines)
         ]
         self.batcher = self.batchers[
             registry.default_index if registry else 0
@@ -274,6 +286,11 @@ class HttpServer(HttpProtocol):
                 self.metrics.set_lifecycle(
                     controller.metrics_snapshot(), tenant=tenant_label
                 )
+        if self.autotune is not None:
+            # gridtuner gauges (host-dict read under the controller's
+            # leaf lock, no device work).
+            with contextlib.suppress(Exception):
+                self.metrics.set_autotune(self.autotune.metrics_snapshot())
         # Robustness counters (host-side reads, no device work): degraded
         # dispatches live on the engines (`_dispatch_padded`), deadline
         # sheds accumulate in the metrics object itself.
@@ -563,8 +580,10 @@ async def _serve(
     trace=None,
     registry=None,
     slo=None,
+    autotune=None,
 ) -> None:
     server = HttpServer(engine, config, lifecycle=lifecycle, registry=registry)
+    server.autotune = autotune
     flightrec = None
     ledger = None
     if slo is not None and (slo.enabled or slo.ledger_dir):
@@ -689,6 +708,12 @@ async def _serve(
                 controller.start()
             if lifecycle is not None:
                 logger.info("lifecycle controller(s) started")
+            if autotune is not None:
+                # Same post-warmup gate as lifecycle: the regrid loop
+                # measures the warmed grid and warms new entries into
+                # the live exec table — both need it fully built first.
+                autotune.start()
+                logger.info("autotune controller started")
         # Compile failure/OOM: die loudly so the orchestrator restarts the
         # pod instead of a forever-503 zombie. Not swallowed — the error is
         # stored and re-raised by _serve after the server closes.
@@ -756,6 +781,11 @@ async def _serve(
             # executor: stop() joins a thread, which must not block the
             # event loop mid-drain.
             await loop.run_in_executor(None, controller.stop)
+        if autotune is not None:
+            # Joins the gridtuner thread (a mid-warm tick finishes its
+            # current compile-cache write, then exits) — executor, same
+            # reason as the lifecycle drains above.
+            await loop.run_in_executor(None, autotune.stop)
         await warm_task
         if draining.is_set():
             # Warmup may have finished AFTER the drain flip and
@@ -795,6 +825,7 @@ def serve_forever(
     trace=None,
     registry=None,
     slo=None,
+    autotune=None,
 ) -> None:
     """Blocking entry point (the uvicorn.run analogue, `app/main.py:92-93`).
     ``lifecycle`` is an optional `LifecycleController` (or a per-tenant
@@ -804,10 +835,13 @@ def serve_forever(
     <trace.dir>/spans.jsonl and the engine exports shape histograms
     (mlops_tpu/trace/). ``registry`` (a `TenantRegistry`) serves N
     tenants from this one plane; None = the 1-tenant fleet around
-    ``engine``."""
+    ``engine``. ``autotune`` is an optional `AutotuneController`
+    (mlops_tpu/autotune/): started once warmup completes (it warms new
+    grid entries into the live exec table), drained on shutdown, gauges
+    on /metrics."""
     asyncio.run(
         _serve(
             engine, config, lifecycle=lifecycle, trace=trace,
-            registry=registry, slo=slo,
+            registry=registry, slo=slo, autotune=autotune,
         )
     )

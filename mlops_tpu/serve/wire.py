@@ -12,6 +12,7 @@ here, so historical imports keep working.
 
 from __future__ import annotations
 
+import json
 from typing import Any
 
 import numpy as np
@@ -80,3 +81,62 @@ def empty_response() -> dict[str, Any]:
         "outliers": [],
         "feature_drift_batch": dict.fromkeys(SCHEMA.feature_names, 0.0),
     }
+
+
+# Pre-encoded response scaffolding (ISSUE 18 satellite — the encode-bound
+# HTTP residue): the response's entire static skeleton — braces, key
+# names, the 20+ drift feature keys with their quoting/escaping — is
+# identical on every response, yet `json.dumps` of the formatted dict
+# rebuilt the dict AND re-serialized the skeleton per request (on the
+# single-process plane's event loop — its bottleneck thread at high
+# concurrency). `encode_response` serializes ONLY the floats, in one C
+# `json.dumps` call over the three flat lists, and splices the baked
+# skeleton around them. Because every float goes through the SAME C
+# encoder the dict path used, the wire bytes are EXACTLY what
+# `json.dumps(format_response(...), separators=(",", ":"))` produced —
+# for every input, non-finite included (NaN/Infinity render identically;
+# no fallback needed). The parity suite pins it
+# (tests/test_wire_encode.py), and the encode runs wherever the caller
+# already holds the arrays (the engine's executor thread, the ring front
+# end's handler) — cheaper in total CPU than dict-build + dumps, not
+# just moved off the loop.
+_DRIFT_KEYS = tuple(
+    json.dumps(name) + ":" for name in SCHEMA.feature_names
+)
+
+
+def encode_response(
+    predictions: np.ndarray, outliers: np.ndarray, drift: np.ndarray
+) -> bytes:
+    """Raw response arrays -> pre-encoded wire bytes, byte-identical to
+    ``json.dumps(format_response(...), separators=(",", ":")).encode()``
+    for every input (pinned by tests/test_wire_encode.py)."""
+    # One C-encoder pass over all the floats. The "],[" delimiter can
+    # never occur inside a rendered float (digits, sign, dot, eE,
+    # NaN/Infinity letters only), so the three segments split back out
+    # exactly — including the empty-list edges.
+    floats = json.dumps(
+        [
+            np.asarray(predictions).tolist(),
+            np.asarray(outliers).tolist(),
+            np.asarray(drift).tolist(),
+        ],
+        separators=(",", ":"),
+    )
+    preds, outs, drifts = floats[2:-2].split("],[")
+    return (
+        '{"predictions":['
+        + preds
+        + '],"outliers":['
+        + outs
+        + '],"feature_drift_batch":{'
+        + ",".join(map(str.__add__, _DRIFT_KEYS, drifts.split(",")))
+        + "}}"
+    ).encode()
+
+
+# The zero-row fast path's cached bytes (the dict is static, so the
+# encode is too).
+EMPTY_RESPONSE_BYTES = json.dumps(
+    empty_response(), separators=(",", ":")
+).encode()

@@ -62,6 +62,18 @@ class ShapeStats:
         # another entry's counters (a non-monotone _total is a Prometheus
         # counter reset). Entries past the table stay engine-side only.
         self._table_rows: dict[str, int] = {}
+        # Entries the shm MIRROR cannot hold (table saturated): updated
+        # by write_table, exported as
+        # ``mlops_tpu_shape_table_evicted_total`` on both planes.
+        # Nonzero means the ring scrape's histograms — and anything fed
+        # from them, like the gridtuner's demand reconstruction — are
+        # SILENTLY MISSING entries that the engine-side stats still
+        # hold; 0 on the single-process plane by construction (it
+        # renders the in-memory dict, no mirror, nothing evicted).
+        # Monotone: entries only accumulate and row assignment is
+        # first-seen-forever, so once the table saturates the overflow
+        # set can only grow.
+        self._evicted = 0
         # Armed-at monotonic time: the useful_rows_per_s rate base, also
         # mirrored into shm so the ring renderer shares the same base.
         self.t0 = time.monotonic()
@@ -130,8 +142,18 @@ class ShapeStats:
         elapsed = max(time.monotonic() - self.t0, 1e-9)
         return round(requested / elapsed, 1)
 
+    @property
+    def evicted_total(self) -> int:
+        """Entries the shm mirror has dropped (first-seen 32-row cap):
+        the silent-staleness observable. Always 0 until `write_table`
+        runs (the single-process plane has no mirror to overflow)."""
+        with self._lock:
+            return self._evicted
+
     def render_lines(self) -> list[str]:
-        return _lines(self.snapshot(), self.useful_rows_per_s())
+        return _lines(
+            self.snapshot(), self.useful_rows_per_s(), self.evicted_total
+        )
 
     # ----------------------------------------------------------- shm mirror
     def write_table(self, keys: np.ndarray, vals: np.ndarray) -> None:
@@ -152,6 +174,11 @@ class ShapeStats:
                 ):
                     self._table_rows[entry] = len(self._table_rows)
             rows = dict(self._table_rows)
+            # Overflow accounting: every entry that exists engine-side
+            # but holds no mirror row is invisible to ring scrapes (and
+            # to the autotuner's demand input) — count them instead of
+            # letting a saturated table quietly bias the grid search.
+            self._evicted = len(snap) - len(rows)
         for entry, i in rows.items():
             vals[i] = snap[entry]
             raw = entry.encode()[:TABLE_KEY_BYTES]
@@ -192,25 +219,31 @@ def merge_entries(
 
 
 def render_table_lines(
-    keys: np.ndarray, vals: np.ndarray, elapsed_s: float
+    keys: np.ndarray, vals: np.ndarray, elapsed_s: float,
+    evicted: int = 0,
 ) -> list[str]:
     """The ring renderer's half: same series as `ShapeStats.render_lines`
     but from the shm mirror (any front end serves the scrape)."""
-    return render_entries_lines(read_table(keys, vals), elapsed_s)
+    return render_entries_lines(read_table(keys, vals), elapsed_s, evicted)
 
 
 def render_entries_lines(
-    entries: dict[str, list[float]], elapsed_s: float
+    entries: dict[str, list[float]], elapsed_s: float, evicted: int = 0
 ) -> list[str]:
     """Format an already-merged entry table (the multi-replica render):
     identical series to `render_table_lines`, rate base = the merged
-    fleet's oldest armed clock."""
+    fleet's oldest armed clock, ``evicted`` = the fleet's summed mirror
+    overflow (serve/ipc.py ``shape_evicted``)."""
     requested = sum(v[1] for v in entries.values())
     rate = round(requested / max(elapsed_s, 1e-9), 1)
-    return _lines(entries, rate)
+    return _lines(entries, rate, evicted)
 
 
-def _lines(entries: dict[str, list[float]], useful_rows_per_s: float) -> list[str]:
+def _lines(
+    entries: dict[str, list[float]],
+    useful_rows_per_s: float,
+    evicted: int = 0,
+) -> list[str]:
     """ONE formatting rule for both telemetry planes (the
     `ServingMetrics.robustness_lines` discipline): identical series names
     whether the scrape lands on the single-process server or a ring
@@ -263,4 +296,11 @@ def _lines(entries: dict[str, list[float]], useful_rows_per_s: float) -> list[st
     lines.append(f"mlops_tpu_padding_waste_pct {round(waste, 3)}")
     lines.append("# TYPE mlops_tpu_useful_rows_per_s gauge")
     lines.append(f"mlops_tpu_useful_rows_per_s {useful_rows_per_s}")
+    # Mirror-overflow marker (always emitted with the block — the zero
+    # baseline keeps chaos-smoke monotonicity checkable): nonzero means
+    # these histograms are MISSING entries the engine still tracks, so
+    # any consumer — a dashboard, the gridtuner's demand input — is
+    # seeing a biased shape distribution.
+    lines.append("# TYPE mlops_tpu_shape_table_evicted_total counter")
+    lines.append(f"mlops_tpu_shape_table_evicted_total {int(evicted)}")
     return lines

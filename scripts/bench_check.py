@@ -51,6 +51,8 @@ HEADLINE_KEYS = (
     # serve planes
     "engine_group_req_per_s", "http_req_per_s_best",
     "http_vs_engine_ratio", "shed_503_pct",
+    # traffic-shape autotuner (ISSUE 18)
+    "autotune_goodput_gain_pct", "regrid_downtime_ms",
     # tenancy + replica set + survivability + lifecycle
     "tenants_shared_exec_count", "starvation_cold_p99_ratio",
     "replica_scaling_efficiency", "engine_respawn_gap_ms",
@@ -78,6 +80,13 @@ BOUNDS = (
     # the promotion gate's epsilon (LifecycleConfig.max_auc_drop).
     ("quant_speedup_vs_student", 2.0, 1000.0),
     ("quant_auc_delta", -0.01, 1.0),
+    # Gridtuner (ISSUE 18): the autotuned grid must beat the hand grid
+    # on the skewed trace (measured, not predicted — the floor is the
+    # acceptance claim), and the hot swap must stay pointer-cheap: the
+    # warm happens off-path, so worst-observed added latency during the
+    # swap window stays far under one dispatch's worth of stall.
+    ("autotune_goodput_gain_pct", 0.0, 100000.0),
+    ("regrid_downtime_ms", 0.0, 250.0),
 )
 
 

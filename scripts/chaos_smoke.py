@@ -27,6 +27,10 @@ end to end:
    `utils.io.atomic_write`: no torn file ever lands.
 7. cache corruption — seeded bit flips at `compilecache.read`: counted
    discard + recompile, correct outputs, self-healed store.
+8. mid-regrid kill -9 (ISSUE 18) — SIGKILL a hot regrid between its
+   warm phase and its swap, under a live serving hammer: the crash must
+   leave nothing wedged — a fresh process over the same bundle + cache
+   serves bit-identically, completes the regrid cleanly, and rolls back.
 
 Global assertions: every /predict status is in {200, 413, 422, 503, 504},
 at least one 504 was produced by the stall scenario, no request hangs
@@ -209,6 +213,84 @@ healed.load_or_compile(job)
 assert healed.stats()["hits"] == 1, healed.stats()  # store self-healed
 print("CORRUPTION-HANDLED")
 """
+
+
+# --------------------------------------------------- mid-regrid kill -9
+# Phase 1: a hot regrid (ISSUE 18 gridtuner) is SIGKILLed between its
+# warm phase and its swap — the most in-flight state a regrid ever
+# holds. A serving hammer runs throughout, so the kill lands on a plane
+# that is actively dispatching.
+_REGRID_KILL = """
+import threading, time
+from mlops_tpu import faults
+from mlops_tpu.autotune import apply_plan
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.compilecache.cache import CompileCache
+from mlops_tpu.serve.engine import InferenceEngine
+
+engine = InferenceEngine(
+    load_bundle({bundle!r}), buckets=(1, 8),
+    compile_cache=CompileCache({cache!r}), enable_grouping=False)
+engine.warmup()
+record = [{record!r}]
+ref = engine.predict_records(record)["predictions"]
+stop = threading.Event()
+def hammer():
+    while not stop.is_set():
+        assert engine.predict_records(record)["predictions"] == ref
+        time.sleep(0.005)
+t = threading.Thread(target=hammer, daemon=True); t.start()
+time.sleep(0.1)
+faults.arm(faults.FaultPlan.from_rules(
+    [{"point": "autotune.regrid.midswap", "mode": "kill"}]))
+apply_plan(engine, (1, 2, 8))
+raise SystemExit("kill fault did not fire")
+"""
+
+# Phase 2: a fresh process over the SAME bundle + compile cache must
+# serve bit-identically (the crash left nothing durable mid-mutation),
+# complete the interrupted regrid cleanly, keep responses bit-stable
+# across the swap, and roll back in one call.
+_REGRID_RECOVER = """
+from mlops_tpu.autotune import apply_plan
+from mlops_tpu.bundle import load_bundle
+from mlops_tpu.compilecache.cache import CompileCache
+from mlops_tpu.serve.engine import InferenceEngine
+
+engine = InferenceEngine(
+    load_bundle({bundle!r}), buckets=(1, 8),
+    compile_cache=CompileCache({cache!r}), enable_grouping=False)
+engine.warmup()
+record = [{record!r}]
+before = engine.predict_records(record)
+gen0 = engine.grid_generation
+gen = apply_plan(engine, (1, 2, 8))  # the crashed regrid, re-run clean
+assert gen == gen0 + 1 and tuple(engine.buckets) == (1, 2, 8)
+assert engine.predict_records(record) == before, "regrid changed bytes"
+engine.rollback()
+assert tuple(engine.buckets) == (1, 8)
+assert engine.predict_records(record) == before, "rollback changed bytes"
+print("REGRID-RECOVERED")
+"""
+
+
+def regrid_kill_scenario(tmp: str, bundle: str) -> None:
+    cache_dir = os.path.join(tmp, "regrid-cache")
+    script = (
+        _REGRID_KILL
+        .replace("{bundle!r}", repr(bundle))
+        .replace("{cache!r}", repr(cache_dir))
+        .replace("{record!r}", repr(RECORD))
+    )
+    run_subprocess_scenario("mid-regrid kill -9", script, expect_kill=True)
+    recover = run_subprocess_scenario(
+        "post-crash regrid recovery",
+        _REGRID_RECOVER
+        .replace("{bundle!r}", repr(bundle))
+        .replace("{cache!r}", repr(cache_dir))
+        .replace("{record!r}", repr(RECORD)),
+    )
+    assert "REGRID-RECOVERED" in recover.stdout
 
 
 def midwrite_and_corruption_scenarios(tmp: str) -> None:
@@ -685,6 +767,9 @@ def main() -> int:
         raise SystemExit("train failed")
     bundle = json.loads(train.stdout.strip().splitlines()[-1])["bundle"]
     print(f"# chaos-smoke: bundle at {bundle}", flush=True)
+
+    print("# chaos-smoke: mid-regrid kill scenario", flush=True)
+    regrid_kill_scenario(tmp, bundle)
 
     live_plane_scenarios(tmp, bundle)
     print("# chaos-smoke: OK (all seeded scenarios green)", flush=True)

@@ -1,0 +1,139 @@
+"""Wire-bytes parity: `encode_response` must be byte-identical to the
+dict path it replaced (`json.dumps(format_response(...),
+separators=(",", ":")).encode()`).
+
+The encode-residue optimization (ISSUE 18 satellite) moved response
+serialization off the event loop by pre-encoding bytes in the executor —
+but both serving planes' responses are contractually bit-identical, so
+the splice encoder (one C json.dumps pass over the floats, static
+skeleton baked at import) must reproduce the dict path's output
+exactly. These tests pin that contract; the HTTP-level
+parity suite (tests/test_frontend.py) re-proves it end to end through
+real sockets. Jax-free: only serve/wire.py and the batcher's fallback
+resolution are under test.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.serve.wire import (
+    EMPTY_RESPONSE_BYTES,
+    empty_response,
+    encode_response,
+    format_response,
+)
+
+D = len(SCHEMA.feature_names)
+
+
+def _dict_bytes(p, o, d) -> bytes:
+    return json.dumps(
+        format_response(np.asarray(p), np.asarray(o), np.asarray(d)),
+        separators=(",", ":"),
+    ).encode()
+
+
+@pytest.mark.parametrize("n", [1, 2, 8, 64, 256])
+def test_encode_response_matches_dict_path(n):
+    rng = np.random.default_rng(n)
+    p = rng.standard_normal(n)
+    o = rng.uniform(size=n)
+    d = rng.standard_normal(D).round(6)  # the fetch contract: rounded f64
+    assert encode_response(p, o, d) == _dict_bytes(p, o, d)
+
+
+def test_encode_response_float_repr_edges():
+    # Shortest-repr floats the encoder must match the dict path on:
+    # sub-epsilon, negative zero, exact zero, integral floats, and values
+    # whose repr needs all 17 digits.
+    edge = [1e-07, -0.5, 0.0, -0.0, 1.0, 0.1 + 0.2, 1e300, 5e-324]
+    p = np.array(edge)
+    o = np.array(edge[::-1])
+    d = np.resize(np.array(edge), D)
+    assert encode_response(p, o, d) == _dict_bytes(p, o, d)
+
+
+def test_encode_response_nonfinite_stays_identical():
+    # A healthy fetch never produces these; because the floats ride the
+    # SAME C encoder as the dict path, even degenerate NaN/Infinity
+    # bytes are identical — no fallback branch to diverge.
+    p = np.array([np.nan, 1.0])
+    o = np.array([np.inf, -np.inf])
+    d = np.zeros(D)
+    assert encode_response(p, o, d) == _dict_bytes(p, o, d)
+
+
+def test_empty_response_bytes_matches_dict():
+    assert EMPTY_RESPONSE_BYTES == json.dumps(
+        empty_response(), separators=(",", ":")
+    ).encode()
+
+
+def test_decoded_wire_bytes_equal_reference_dict():
+    # The wire bytes must PARSE back to the reference response: keys in
+    # schema order, every drift feature present.
+    rng = np.random.default_rng(7)
+    p, o = rng.uniform(size=3), rng.uniform(size=3)
+    d = rng.standard_normal(D).round(6)
+    decoded = json.loads(encode_response(p, o, d))
+    assert decoded == format_response(p, o, d)
+    assert list(decoded["feature_drift_batch"]) == list(SCHEMA.feature_names)
+
+
+# ---------------------------------------------------- batcher resolution
+class _DictOnlyStub:
+    """Engine-API stub WITHOUT the wire methods: wire_responses=True must
+    degrade to the dict path (the sklearn/stub contract)."""
+
+    supports_grouping = False
+    ready = True
+
+    def predict_records(self, records, span=None):
+        return {"predictions": [0.5] * len(records)}
+
+
+class _WireStub(_DictOnlyStub):
+    def predict_records_wire(self, records, span=None):
+        return b'{"predictions":[0.5]}'
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_batcher_wire_mode_falls_back_without_wire_methods():
+    from mlops_tpu.serve.batcher import MicroBatcher
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        batcher = MicroBatcher(
+            _DictOnlyStub(), pool, window_ms=0.0, wire_responses=True
+        )
+        out = _run(batcher.predict([{}]))
+    assert out == {"predictions": [0.5]}
+
+
+def test_batcher_wire_mode_prefers_wire_methods():
+    from mlops_tpu.serve.batcher import MicroBatcher
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        batcher = MicroBatcher(
+            _WireStub(), pool, window_ms=0.0, wire_responses=True
+        )
+        out = _run(batcher.predict([{}]))
+    assert out == b'{"predictions":[0.5]}'
+
+
+def test_batcher_default_stays_on_dict_path():
+    from mlops_tpu.serve.batcher import MicroBatcher
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        batcher = MicroBatcher(_WireStub(), pool, window_ms=0.0)
+        out = _run(batcher.predict([{}]))
+    assert out == {"predictions": [0.5]}
