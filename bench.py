@@ -1448,6 +1448,222 @@ def _http_multi_stage(engine, bundle, record, base: dict) -> dict:
     return out
 
 
+_BROWNOUT_CLIENT = r"""
+import asyncio, json, sys, time
+
+port, concurrency = int(sys.argv[1]), int(sys.argv[2])
+duration_s, backoff_s = float(sys.argv[3]), float(sys.argv[4])
+body = sys.stdin.buffer.read()
+head = (
+    "POST /predict HTTP/1.1\r\nhost: x\r\n"
+    "content-type: application/json\r\n"
+    f"content-length: {len(body)}\r\n\r\n"
+).encode()
+counts = {"ok": 0, "shed": 0, "other": 0, "errors": 0}
+deadline = time.perf_counter() + duration_s
+
+
+async def client():
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    except OSError:
+        counts["errors"] += 1
+        return
+    try:
+        while time.perf_counter() < deadline:
+            writer.write(head + body)
+            await writer.drain()
+            line = await reader.readline()
+            status = int(line.split(b" ")[1])
+            length = 0
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n"):
+                    break
+                if h.lower().startswith(b"content-length:"):
+                    length = int(h.split(b":")[1])
+            await reader.readexactly(length)
+            if status == 200:
+                counts["ok"] += 1
+            elif status == 503:
+                counts["shed"] += 1
+                # honor the shed's Retry-After spirit: back off instead
+                # of hammering the admission edge with instant retries
+                await asyncio.sleep(backoff_s)
+            else:
+                counts["other"] += 1
+    except (OSError, asyncio.IncompleteReadError, ValueError):
+        counts["errors"] += 1
+    finally:
+        writer.close()
+
+
+async def main():
+    t0 = time.perf_counter()
+    await asyncio.gather(*[client() for _ in range(concurrency)])
+    counts["wall_s"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps(counts))
+
+
+asyncio.run(main())
+"""
+
+
+def _tierroute_stage(bundle, record) -> dict:
+    """Tiered SLO serving evidence (serve/tierroute.py, ISSUE 19) in two
+    measurements:
+
+    - per-class routed throughput on a `tier_routing=True` engine
+      (``tier_req_per_s_{default,cheap,accurate}`` + the headline
+      ``tier_routed_req_per_s`` = the cheap class through its routed
+      tier) — cheap rides the gated quant student, accurate pins exact;
+    - a 10x-overload A/B on a live 1-worker plane with the SAME engine:
+      brownout-on (tier_routing, default traffic demotes at
+      `brownout_demote_depth` occupancy) vs brownout-off (pure shed),
+      compared on useful responses/s —
+      ``brownout_goodput_gain_pct`` is the headline, plus the raw
+      ok/shed/demotion counts for both arms.
+    """
+    import dataclasses
+    import subprocess
+    import tempfile
+
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.serve.engine import InferenceEngine
+    from mlops_tpu.serve.frontend import reuseport_socket, start_frontends
+    from mlops_tpu.serve.ipc import RequestRing, RingService
+    from mlops_tpu.serve.tierroute import SLO_ACCURATE, SLO_CHEAP
+
+    if not (bundle.has_quant and bundle.quant_gates_passed):
+        return {"tierroute_skipped": "bundle has no gate-passed quant tier"}
+
+    routed = InferenceEngine(bundle, buckets=(1, 8, 64), tier_routing=True)
+    routed.warmup()
+    out: dict = {"tier_ladder": list(routed.available_tiers)}
+
+    # Per-class routed throughput: the class->tier mapping the plane
+    # would apply, measured on the engine's own dispatch path.
+    for label, slo in (
+        ("default", None),
+        ("cheap", SLO_CHEAP),
+        ("accurate", SLO_ACCURATE),
+    ):
+        tier = routed.route_tier(slo) if slo is not None else None
+        if tier is None:
+            p50 = _p50_ms(lambda: routed.predict_records([record]))
+        else:
+            p50 = _p50_ms(
+                lambda t=tier: routed.predict_records([record], tier=t)
+            )
+        out[f"tier_req_per_s_{label}"] = round(1e3 / p50, 1)
+    out["tier_routed_req_per_s"] = out["tier_req_per_s_cheap"]
+
+    # Brownout-vs-shed A/B: one worker, a small slot partition, a
+    # closed-loop fleet of 10x-partition clients hammering for a fixed
+    # window (503s back off per the Retry-After contract). The offered
+    # unit is a 64-ROW request — past GROUP_ROW_BUCKET, so each request
+    # is one solo device dispatch and the default tier's compute (not
+    # the HTTP edge) is the contended resource; demoting to the quant
+    # student is then a real capacity change, which is exactly the
+    # brownout claim. Same engine, same ring geometry — the only
+    # difference between arms is serve.tier_routing (the governor arms
+    # with it), so any goodput delta is the demotion path. The demote
+    # depth is drill-tuned to the tiny partition (3 of 6 slots busy
+    # activates) the way chaos_smoke tunes its plane.
+    rows = 64
+    body = json.dumps([record] * rows).encode()
+    slots_small, slots_large = 1, 5
+    partition = slots_small + slots_large
+    concurrency = 10 * partition
+    duration_s = 8.0
+    backoff_s = 0.3
+    arms: dict[str, dict] = {}
+    with tempfile.TemporaryDirectory() as td:
+        prep_path = os.path.join(td, "preprocess.npz")
+        bundle.preprocessor.save(prep_path)
+        for arm, routing in (("on", True), ("off", False)):
+            _note(f"tierroute stage: brownout {arm}")
+            cfg = ServeConfig(
+                host="127.0.0.1", port=0, workers=1,
+                ring_slots_small=slots_small,
+                ring_slots_large=slots_large,
+                max_batch=rows,
+                tier_routing=routing,
+                brownout_demote_depth=0.5,
+                brownout_restore_depth=0.25,
+            ).validate()
+            ring = RequestRing(
+                workers=1,
+                slots_small=cfg.ring_slots_small,
+                slots_large=cfg.ring_slots_large,
+                large_rows=cfg.max_batch,
+            )
+            placeholder = reuseport_socket(cfg.host, cfg.port)
+            child_cfg = dataclasses.replace(
+                cfg, port=placeholder.getsockname()[1]
+            )
+            procs = start_frontends(child_cfg, ring, prep_path)
+            service = RingService(
+                routed, ring,
+                max_group=cfg.max_group,
+                max_inflight=cfg.max_inflight,
+                threads=cfg.max_workers,
+            )
+            service.start()
+            ring.set_ready(True)
+            try:
+                _wait_port(child_cfg.port)
+                proc = subprocess.run(
+                    [sys.executable, "-c", _BROWNOUT_CLIENT,
+                     str(child_cfg.port), str(concurrency),
+                     str(duration_s), str(backoff_s)],
+                    input=body, stdout=subprocess.PIPE, timeout=600,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError("tierroute burst client failed")
+                counts = json.loads(
+                    proc.stdout.decode().strip().splitlines()[-1]
+                )
+                counts["demotions"] = int(ring.tier_demote.sum())
+                counts["brownout_demotions"] = int(
+                    ring.brownout_demote.sum()
+                )
+                arms[arm] = counts
+            finally:
+                ring.set_draining()
+                ring.set_ready(False)
+                for p in procs:
+                    if p.is_alive() and p.pid:
+                        os.kill(p.pid, 15)
+                for p in procs:
+                    p.join(timeout=15)
+                    if p.is_alive():
+                        p.terminate()
+                        p.join(timeout=5)
+                service.stop()
+                placeholder.close()
+                ring.close()
+
+    for arm, counts in arms.items():
+        wall = max(counts.get("wall_s", 0.0), 1e-6)
+        arms[arm]["goodput_req_per_s"] = round(counts["ok"] / wall, 1)
+        out[f"brownout_{arm}_ok"] = counts["ok"]
+        out[f"brownout_{arm}_shed"] = counts["shed"]
+        out[f"brownout_{arm}_goodput_req_per_s"] = arms[arm][
+            "goodput_req_per_s"
+        ]
+    out["brownout_demotions"] = arms["on"]["brownout_demotions"]
+    off_goodput = arms["off"]["goodput_req_per_s"]
+    if off_goodput:
+        out["brownout_goodput_gain_pct"] = round(
+            100.0
+            * (arms["on"]["goodput_req_per_s"] - off_goodput)
+            / off_goodput,
+            1,
+        )
+    return out
+
+
 def _tenancy_stage(engine, bundle, record) -> dict:
     """Multi-tenant multiplexing evidence (mlops_tpu/tenancy/, ISSUE 12)
     on an in-process 2-worker plane serving TWO tenants from one engine
@@ -2320,6 +2536,13 @@ def main() -> None:
         http.update(_http_multi_stage(engine, bundle, record, http))
     except Exception as err:
         http["http_multi_error"] = f"{type(err).__name__}: {err}"
+    _note("tierroute stage (per-class routing + brownout-vs-shed A/B)")
+    try:
+        # Tiered SLO serving evidence (ISSUE 19), guarded like the
+        # other plane stages.
+        http.update(_tierroute_stage(bundle, record))
+    except Exception as err:
+        http["tierroute_error"] = f"{type(err).__name__}: {err}"
     _note("tenancy stage (2-tenant fleet, shared exec, 10x hot flood)")
     try:
         # Multi-tenant multiplexing evidence (ISSUE 12), guarded like

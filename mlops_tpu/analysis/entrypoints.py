@@ -27,6 +27,11 @@ jaxpr the analyzer inspects is the program production compiles:
   the same packed 7-arg cacheable form; Pallas-fused on TPU, traced here
   through the jnp composite route, which is the same program family the
   parity tests pin bit-identical).
+- ``serve-predict-gbm-packed`` / ``serve-predict-gbm-group-packed`` —
+  `ops/gbm_tensor.py make_gbm_packed_base` / ``make_gbm_grouped_base``
+  (the Hummingbird-style HistGBM tensorization in the same packed 7-arg
+  form; f64 tree compares by bit-parity contract, so these entries
+  declare ``x64=True`` and trace inside the x64 context).
 - ``bulk-score-chunk``   — `parallel/bulk.py make_bulk_fused` (the fused
   chunk program the pipelined bulk/stream scorers dispatch per chunk),
   traced at two chunk sizes with the production int8 categorical ids.
@@ -285,6 +290,79 @@ def _build_serve_quant_group():
     return entry, {smallest: args(smallest), largest: args(largest)}
 
 
+def _build_serve_gbm():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.config import ServeConfig
+    from mlops_tpu.ops.gbm_tensor import (
+        GbmGeometry,
+        abstract_gbm_variables,
+        make_gbm_packed_base,
+    )
+
+    # Smallest real geometry: the traced STRUCTURE depends on the static
+    # depth (gather-loop iterations) and tree count (the serial add
+    # chain), not on node width — keep tracing cheap. The entry declares
+    # ``x64=True``, so the analyzer traces it inside the x64 context
+    # exactly as production lowers it.
+    geometry = GbmGeometry(n_trees=4, max_nodes=7, depth=2)
+    variables = abstract_gbm_variables(geometry)
+    monitor = _abstract_monitor()
+    entry = make_gbm_packed_base(geometry.depth)
+
+    def args(bucket: int):
+        import numpy as np
+
+        cat, num = _schema_batch(bucket)
+        mask = jax.ShapeDtypeStruct((bucket,), jnp.bool_)
+        # f64 temperature — the gbm tier's one dtype deviation from the
+        # packed contract (bit-parity with the host hybrid's full-float
+        # logit division, compilecache/warmup.py _gbm_serve_avals).
+        temp = jax.ShapeDtypeStruct((), np.float64)
+        return (variables, monitor, _abstract_accumulator(), temp, cat, num, mask)
+
+    buckets = ServeConfig().warmup_batch_sizes
+    return entry, {b: args(b) for b in buckets}
+
+
+def _build_serve_gbm_group():
+    import jax
+    import jax.numpy as jnp
+
+    from mlops_tpu.ops.gbm_tensor import (
+        GbmGeometry,
+        abstract_gbm_variables,
+        make_gbm_grouped_base,
+    )
+    from mlops_tpu.schema import SCHEMA
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKET, GROUP_SLOT_BUCKETS
+
+    geometry = GbmGeometry(n_trees=4, max_nodes=7, depth=2)
+    variables = abstract_gbm_variables(geometry)
+    monitor = _abstract_monitor()
+    entry = make_gbm_grouped_base(geometry.depth)
+
+    import numpy as np
+
+    S = jax.ShapeDtypeStruct
+
+    def args(slots: int):
+        rows = GROUP_ROW_BUCKET
+        return (
+            variables,
+            monitor,
+            _abstract_accumulator(),
+            S((), np.float64),  # see _build_serve_gbm
+            S((slots, rows, SCHEMA.num_categorical), jnp.int32),
+            S((slots, rows, SCHEMA.num_numeric), jnp.float32),
+            S((slots, rows), jnp.bool_),
+        )
+
+    smallest, largest = GROUP_SLOT_BUCKETS[0], GROUP_SLOT_BUCKETS[-1]
+    return entry, {smallest: args(smallest), largest: args(largest)}
+
+
 def _build_bulk_score_chunk():
     import jax
     import jax.numpy as jnp
@@ -367,6 +445,25 @@ def registered_entry_points() -> list[EntryPoint]:
             name="serve-predict-quant-group-packed",
             build=_build_serve_quant_group,
             params_in_spec=None,
+        ),
+        EntryPoint(
+            name="serve-predict-gbm-packed",
+            build=_build_serve_gbm,
+            params_in_spec=None,
+            # f64 is this entry's CONTRACT (bit-parity with sklearn's f64
+            # tree compares — ops/gbm_tensor.py): traced inside the x64
+            # context, TPU301 suppressed, f64-endpoint cast round-trips
+            # allowed (the calibration boundary's narrowing semantics).
+            x64=True,
+            # Same monitor family split as the exact tier: dense masked
+            # K-S at buckets <= 64, the sort-based form at 256.
+            bucket_families=((1, 8, 64), (256,)),
+        ),
+        EntryPoint(
+            name="serve-predict-gbm-group-packed",
+            build=_build_serve_gbm_group,
+            params_in_spec=None,
+            x64=True,
         ),
         EntryPoint(
             name="bulk-score-chunk",

@@ -29,6 +29,7 @@ Checks (rule IDs continue the tpulint catalog):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable
 
@@ -67,6 +68,13 @@ class EntryPoint:
     # small-batch K-S below 64 rows vs the sort-based one above it,
     # monitor/state.py). None = all buckets are one family.
     bucket_families: tuple[tuple[int, ...], ...] | None = None
+    # Declared x64 entry (the gbm-tensor tier): the trace runs inside
+    # `jax.experimental.enable_x64()` — exactly how production lowers it
+    # (ops/gbm_tensor.py) — and the dtype rules treat f64 as the entry's
+    # CONTRACT rather than a leak: TPU301 is skipped, and TPU303 ignores
+    # round-trips through an f64 endpoint (the f64->f32->f64 narrowing at
+    # the calibration boundary is the bit-parity semantics, not waste).
+    x64: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,8 +142,12 @@ def primitive_signature(jaxpr) -> tuple[str, ...]:
     return tuple(eqn.primitive.name for eqn in _iter_eqns(jaxpr))
 
 
-def check_dtypes(entry_name: str, bucket: int, jaxpr) -> list[Finding]:
-    """TPU301 (f64 anywhere) + TPU303 (convert round-trips)."""
+def check_dtypes(
+    entry_name: str, bucket: int, jaxpr, x64_entry: bool = False
+) -> list[Finding]:
+    """TPU301 (f64 anywhere) + TPU303 (convert round-trips).
+    ``x64_entry`` relaxes both for a DECLARED f64 program (see
+    `EntryPoint.x64`)."""
     import numpy as np
 
     findings: list[Finding] = []
@@ -146,7 +158,7 @@ def check_dtypes(entry_name: str, bucket: int, jaxpr) -> list[Finding]:
             dtype = getattr(aval, "dtype", None)
             if dtype is not None and dtype == np.float64:
                 f64_hits += 1
-    if f64_hits:
+    if f64_hits and not x64_entry:
         findings.append(
             _flag(
                 "TPU301",
@@ -171,6 +183,7 @@ def check_dtypes(entry_name: str, bucket: int, jaxpr) -> list[Finding]:
                 start is not None
                 and end is not None
                 and start.dtype == end.dtype
+                and not (x64_entry and start.dtype == np.float64)
             ):
                 findings.append(
                     _flag(
@@ -347,11 +360,22 @@ def run_trace_checks(
             )
             continue
         try:
-            fn, args_by_bucket = entry.build()
-            jaxprs = {
-                bucket: jax.make_jaxpr(fn)(*args)
-                for bucket, args in args_by_bucket.items()
-            }
+            # A declared-x64 entry traces inside the x64 context — the
+            # same context production lowers it in (ops/gbm_tensor.py);
+            # aval canonicalization would otherwise silently demote its
+            # f64 signature to f32 and trace a program nobody compiles.
+            if entry.x64:
+                from jax.experimental import enable_x64
+
+                ctx = enable_x64()
+            else:
+                ctx = contextlib.nullcontext()
+            with ctx:
+                fn, args_by_bucket = entry.build()
+                jaxprs = {
+                    bucket: jax.make_jaxpr(fn)(*args)
+                    for bucket, args in args_by_bucket.items()
+                }
         # Any trace failure IS the finding (TPU306) — nothing is swallowed.
         except Exception as err:  # tpulint: disable=TPU201
             findings.append(
@@ -370,7 +394,9 @@ def run_trace_checks(
             f"({ops} primitives, abstract — no device code executed)"
         )
         for bucket, jaxpr in jaxprs.items():
-            findings.extend(check_dtypes(entry.name, bucket, jaxpr))
+            findings.extend(
+                check_dtypes(entry.name, bucket, jaxpr, x64_entry=entry.x64)
+            )
             findings.extend(check_weak_types(entry.name, bucket, jaxpr))
         findings.extend(
             check_bucket_stability(entry.name, jaxprs, entry.bucket_families)

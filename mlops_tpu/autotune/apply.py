@@ -141,6 +141,10 @@ def build_grid_twin(engine, buckets):
         model_shards=engine.model_shards,
         device_index=engine.device_index,
         serve_tier=engine.serve_tier,
+        # A regrid twin must carry the whole tier ladder (ISSUE 19): a
+        # hot swap that dropped the gated tiers would silently break
+        # per-request SLO routing mid-flight.
+        tier_routing=engine.tier_routing,
     )
     twin.adopt_executables(engine)
     return twin

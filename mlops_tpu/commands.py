@@ -620,6 +620,7 @@ def _serve(config) -> int:
             warmup_workers=config.cache.warmup_workers,
             model_shards=config.serve.model_shards,
             serve_tier=config.serve.serve_tier,
+            tier_routing=config.serve.tier_routing,
         )
         engine = registry.default_engine
     else:
@@ -637,6 +638,7 @@ def _serve(config) -> int:
             warmup_workers=config.cache.warmup_workers,
             model_shards=config.serve.model_shards,
             serve_tier=config.serve.serve_tier,
+            tier_routing=config.serve.tier_routing,
         )
     lifecycle = None
     if config.lifecycle.enabled:

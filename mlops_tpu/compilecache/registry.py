@@ -40,6 +40,13 @@ CACHE_ENTRY_IDS: tuple[str, ...] = (
     # never a silent hit.
     "serve-predict-quant-packed",
     "serve-predict-quant-group-packed",
+    # GBM-tensor tier (ops/gbm_tensor.py, ISSUE 19): the Hummingbird-style
+    # tensorization of the HistGBM baseline in the same packed 7-arg form —
+    # f64 tree compares lowered inside the x64 context (the jobs carry
+    # warmup._X64Jitted), keyed apart by the ensemble's static geometry
+    # plus an explicit x64 marker in the config hash.
+    "serve-predict-gbm-packed",
+    "serve-predict-gbm-group-packed",
     "bulk-score-chunk",
 )
 

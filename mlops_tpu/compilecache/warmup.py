@@ -366,6 +366,186 @@ def serve_quant_group_jobs(
     return jobs
 
 
+def _gbm_serve_avals(variables, monitor, batch_shape, placement):
+    """`_serve_avals` with the gbm tier's ONE dtype deviation: a f64
+    temperature argument. The host hybrid this tier must match bit-for-bit
+    divides logits by the FULL python float (`train/calibrate.py
+    apply_temperature`); an f32 rounding of T shifts tempered
+    probabilities by one ulp."""
+    import jax
+    import numpy as np
+
+    avals = list(_serve_avals(variables, monitor, batch_shape, None, placement))
+    avals[3] = (
+        jax.ShapeDtypeStruct((), np.float64)
+        if placement is None
+        else jax.ShapeDtypeStruct((), np.float64, sharding=placement)
+    )
+    return tuple(avals)
+
+
+class _X64Lowered:
+    """See `_X64Jitted` — the lowering-side half of the wrapper."""
+
+    def __init__(self, lowered):
+        self._lowered = lowered
+
+    def compile(self):
+        from mlops_tpu.ops.gbm_tensor import x64_context
+
+        with x64_context():
+            return self._lowered.compile()
+
+
+class _X64Jitted:
+    """A jitted program whose AOT ``lower``/``compile`` must run inside
+    the thread-local x64 context (the gbm-tensor tier: f64 tree compares
+    — `ops/gbm_tensor.py`). Both cache consumers only ever call
+    ``job.jitted.lower(*avals).compile()`` (`cache.py
+    CompileCache._compile` and `run_jobs`'s cacheless path), and
+    ``compile()`` returns the REAL compiled executable — persistence
+    (executable serialize) and execution see a plain jax object, never
+    this wrapper."""
+
+    def __init__(self, jitted):
+        self._jitted = jitted
+
+    def lower(self, *args):
+        from mlops_tpu.ops.gbm_tensor import x64_context
+
+        with x64_context():
+            return _X64Lowered(self._jitted.lower(*args))
+
+
+def serve_gbm_jobs(
+    variables,
+    monitor,
+    buckets: tuple[int, ...],
+    geometry=None,
+    temperature: float = 1.0,
+    placement=None,
+    device_tag: str = "",
+) -> list[CacheJob]:
+    """One job per warmup bucket of the GBM-TENSOR packed predict (entry
+    ``serve-predict-gbm-packed`` — `ops/gbm_tensor.py
+    make_gbm_packed_base`): the tensorized HistGBM ensemble in the same
+    packed 7-arg form. The tree tensors are f64 and the program lowers
+    inside the x64 context, so the jobs carry the `_X64Jitted` wrapper;
+    the ensemble's static ``geometry`` rides the config hash
+    (`gbm_fingerprint` — with an explicit x64 marker). Single-device by
+    contract like the quant tier: only the replica ``placement``/
+    ``device_tag`` pin, no mesh axis. ``variables`` must be COMMITTED
+    under the x64 context (or host f64 numpy) so the avals stay f64."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.gbm_tensor import (
+        device_put_x64,
+        gbm_fingerprint,
+        make_gbm_packed_base,
+    )
+    from mlops_tpu.ops.predict import _acc_donation
+
+    concrete = _is_concrete(variables)
+    if placement is not None and not concrete:
+        raise ValueError(
+            "placed gbm warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    config_hash = gbm_fingerprint(geometry) + device_tag
+    donate = _acc_donation()
+    # Committed f64 scalar: a host np.float64 fed to the compiled
+    # executable outside the x64 context would canonicalize to f32 and
+    # miss the f64 temperature signature.
+    temp = device_put_x64(np.float64(temperature)) if concrete else None
+    jobs = []
+    for bucket in buckets:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict-gbm-packed",
+                jitted=_X64Jitted(
+                    jax.jit(
+                        make_gbm_packed_base(geometry.depth),
+                        donate_argnums=donate,
+                    )
+                ),
+                abstract_args=_gbm_serve_avals(
+                    variables, monitor, (bucket,), placement
+                ),
+                config_hash=config_hash,
+                donated=bool(donate),
+                label=f"serve-predict-gbm-packed/b{bucket}",
+                meta={"bucket": bucket},
+                execute_args=(
+                    (variables, monitor, _acc_zeros(),
+                     temp, *_schema_zeros((bucket,)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
+def serve_gbm_group_jobs(
+    variables,
+    monitor,
+    grid: list[tuple[int, int]],
+    geometry=None,
+    temperature: float = 1.0,
+    placement=None,
+    device_tag: str = "",
+) -> list[CacheJob]:
+    """One job per (slots, rows) shape of the gbm-tensor tier's vmapped
+    grouped dispatch (entry ``serve-predict-gbm-group-packed``)."""
+    import jax
+    import numpy as np
+
+    from mlops_tpu.ops.gbm_tensor import (
+        device_put_x64,
+        gbm_fingerprint,
+        make_gbm_grouped_base,
+    )
+    from mlops_tpu.ops.predict import _acc_donation
+
+    concrete = _is_concrete(variables)
+    if placement is not None and not concrete:
+        raise ValueError(
+            "placed gbm warmup needs committed device trees (their "
+            "shardings are the lowered layout)"
+        )
+    config_hash = gbm_fingerprint(geometry) + device_tag
+    donate = _acc_donation()
+    temp = device_put_x64(np.float64(temperature)) if concrete else None
+    jobs = []
+    for slots, rows in grid:
+        jobs.append(
+            CacheJob(
+                entry_id="serve-predict-gbm-group-packed",
+                jitted=_X64Jitted(
+                    jax.jit(
+                        make_gbm_grouped_base(geometry.depth),
+                        donate_argnums=donate,
+                    )
+                ),
+                abstract_args=_gbm_serve_avals(
+                    variables, monitor, (slots, rows), placement
+                ),
+                config_hash=config_hash,
+                donated=bool(donate),
+                label=f"serve-predict-gbm-group-packed/g{slots}x{rows}",
+                meta={"slots": slots, "rows": rows},
+                execute_args=(
+                    (variables, monitor, _acc_zeros(),
+                     temp, *_schema_zeros((slots, rows)))
+                    if concrete
+                    else None
+                ),
+            )
+        )
+    return jobs
+
+
 # ------------------------------------------------------------- bulk entry
 def bulk_chunk_job(
     model,
@@ -645,6 +825,59 @@ def _warm_serve_quant_group(config, bundle) -> list[CacheJob]:
     )
 
 
+def _gbm_serve_state(config, bundle):
+    """(tree variables, monitor, geometry, temperature) for the gbm-tensor
+    serve entries, or None when this deployment never dispatches them.
+    Unlike the flax/quant entries there is NO config-only abstract mode:
+    the traced program's structure (GbmGeometry) is a fact of the FITTED
+    ensemble, so a container build warms these from a bundle or not at
+    all — `warm_entry_points` reports the entry as skipped."""
+    if bundle is None or bundle.flavor != "sklearn":
+        return None
+    from mlops_tpu.ops.gbm_tensor import (
+        device_put_x64,
+        extract_gbm,
+        supports_gbm_tensorization,
+    )
+
+    if not supports_gbm_tensorization(bundle.estimator):
+        return None  # the rf family keeps the host hybrid path
+    variables, geometry = extract_gbm(bundle.estimator)
+    # Committed under the x64 context so the f64 leaves survive both the
+    # aval derivation and the execute-once pass.
+    return (
+        device_put_x64(variables),
+        bundle.monitor,
+        geometry,
+        bundle.temperature,
+    )
+
+
+def _warm_serve_gbm(config, bundle) -> list[CacheJob]:
+    state = _gbm_serve_state(config, bundle)
+    if state is None:
+        return []
+    variables, monitor, geometry, temp = state
+    return serve_gbm_jobs(
+        variables, monitor,
+        tuple(config.serve.warmup_batch_sizes),
+        geometry=geometry, temperature=temp,
+    )
+
+
+def _warm_serve_gbm_group(config, bundle) -> list[CacheJob]:
+    state = _gbm_serve_state(config, bundle)
+    if state is None or config.serve.batch_window_ms <= 0:
+        return []
+    from mlops_tpu.serve.engine import GROUP_ROW_BUCKETS, GROUP_SLOT_BUCKETS
+
+    variables, monitor, geometry, temp = state
+    grid = [(s, r) for r in GROUP_ROW_BUCKETS for s in GROUP_SLOT_BUCKETS]
+    return serve_gbm_group_jobs(
+        variables, monitor, grid, geometry=geometry, temperature=temp
+    )
+
+
 def _warm_bulk(config, bundle) -> list[CacheJob]:
     import jax
 
@@ -781,6 +1014,8 @@ _WARMERS: dict[str, Callable] = {
     "serve-predict-group-packed": _warm_serve_group,
     "serve-predict-quant-packed": _warm_serve_quant,
     "serve-predict-quant-group-packed": _warm_serve_quant_group,
+    "serve-predict-gbm-packed": _warm_serve_gbm,
+    "serve-predict-gbm-group-packed": _warm_serve_gbm_group,
     "bulk-score-chunk": _warm_bulk,
     "train-step-dense": _warm_train_dense,
     "train-step-tp": _warm_train_tp,

@@ -321,6 +321,26 @@ class ServeConfig:
     # the mesh shape so sharded and unsharded artifacts can never mix.
     # Requires at least that many visible jax devices in the engine
     # process
+    tier_routing: bool = False  # per-request SLO tier routing (ISSUE 19,
+    # serve/tierroute.py): the engine commits every OTHER gated tier
+    # alongside the default one (exact-default keeps its gated quant
+    # student; quant-default keeps its exact teacher) and each request
+    # picks its tier by SLO class — x-slo-class: cheap|default|accurate,
+    # defaulting by x-request-deadline-ms budget. Off (default) =
+    # single-tier serving, bit-identical to pre-routing behavior
+    slo_cheap_deadline_ms: float = 50.0  # requests with no explicit
+    # x-slo-class whose x-request-deadline-ms budget is at or under this
+    # route to the CHEAP class (tight budgets can't afford the accurate
+    # tier's latency). <= 0 disables deadline-based classing: only the
+    # explicit header routes
+    brownout_demote_depth: float = 0.75  # brownout-over-shed (ISSUE 19):
+    # when admission pressure (in-flight depth fraction) crosses this,
+    # DEFAULT-class requests demote to the next-cheaper gated tier
+    # instead of shedding 503 — degraded answers beat refused ones.
+    # Explicit cheap/accurate classes are never reclassified
+    brownout_restore_depth: float = 0.5  # pressure must fall back under
+    # this before demotion stops (hysteresis: a gap below
+    # brownout_demote_depth prevents flapping at the threshold)
     tenants_path: str = ""  # multi-tenant fleet declaration
     # (mlops_tpu/tenancy/): a tenants.toml naming N tenants (name,
     # bundle_dir, quota weight, default tenant) served from ONE engine
@@ -390,6 +410,18 @@ class ServeConfig:
             problems.append(
                 f"serve.serve_tier={self.serve_tier!r} must be 'exact', "
                 "'quant' or 'auto'"
+            )
+        if not 0.0 < self.brownout_demote_depth <= 1.0:
+            problems.append(
+                f"serve.brownout_demote_depth={self.brownout_demote_depth} "
+                "must be in (0, 1] — it is a fraction of admission depth"
+            )
+        if not 0.0 <= self.brownout_restore_depth < self.brownout_demote_depth:
+            problems.append(
+                f"serve.brownout_restore_depth={self.brownout_restore_depth}"
+                " must be in [0, serve.brownout_demote_depth ="
+                f" {self.brownout_demote_depth}) — restoring at or above "
+                "the demote threshold flaps the brownout on every sample"
             )
         if self.drain_deadline_s <= 0:
             problems.append(

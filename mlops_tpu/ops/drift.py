@@ -68,7 +68,11 @@ def _kolmogorov_sf(t: jnp.ndarray, terms: int = 32) -> jnp.ndarray:
     signs = jnp.where(k % 2 == 1, 1.0, -1.0)
     large = 2.0 * jnp.sum(signs * jnp.exp(-2.0 * (k**2) * (t_safe**2)))
     odd = 2.0 * k - 1.0
-    small = 1.0 - jnp.sqrt(2.0 * jnp.pi) / t_safe * jnp.sum(
+    # f32-pinned constant: under jax_enable_x64 (the gbm-tensor tier traces
+    # its whole program in an x64 context — ops/gbm_tensor.py) the bare
+    # Python-float expression would promote to f64 and drag the drift
+    # branch with it; the monitors are f32 by contract on every tier.
+    small = 1.0 - jnp.sqrt(jnp.float32(2.0 * jnp.pi)) / t_safe * jnp.sum(
         jnp.exp(-(odd**2) * (jnp.pi**2) / (8.0 * t_safe**2))
     )
     return jnp.clip(jnp.where(t_safe < 1.0, small, large), 0.0, 1.0)
@@ -96,8 +100,16 @@ def ks_two_sample(
     # point equals the value just after the previous distinct point — also a
     # sample point.
     pooled = jnp.concatenate([ref_sorted, batch_sorted])
-    ref_cdf = jnp.searchsorted(ref_sorted, pooled, side="right") / r
-    batch_cdf = jnp.searchsorted(batch_sorted, pooled, side="right") / b
+    # Integer-count / integer-size divisions are f32-pinned: under
+    # jax_enable_x64 searchsorted yields int64 and the true division would
+    # otherwise produce f64 statistics (the gbm-tensor tier traces this
+    # program inside an x64 context; bit-identical in f32 mode).
+    ref_cdf = (
+        jnp.searchsorted(ref_sorted, pooled, side="right") / r
+    ).astype(jnp.float32)
+    batch_cdf = (
+        jnp.searchsorted(batch_sorted, pooled, side="right") / b
+    ).astype(jnp.float32)
     statistic = jnp.abs(ref_cdf - batch_cdf).max()
     en = jnp.sqrt(r * b / jnp.asarray(r + b, jnp.float32))
     # Stephens correction (as used by scipy's asymptotic two-sample mode).
@@ -121,8 +133,11 @@ def ks_small_masked_statistic(
     bvals = jnp.where(mask, batch.astype(jnp.float32), jnp.inf)
     n_valid = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
 
-    # ECDFs at batch points ([B,R] and [B,B] comparisons).
-    f_ref_b = (ref_sorted[None, :] <= bvals[:, None]).sum(axis=1) / r
+    # ECDFs at batch points ([B,R] and [B,B] comparisons). The count
+    # division is f32-pinned (x64-context tracing — see ks_two_sample).
+    f_ref_b = (
+        (ref_sorted[None, :] <= bvals[:, None]).sum(axis=1) / r
+    ).astype(jnp.float32)
     cnt_b = (bvals[None, :] <= bvals[:, None]).sum(axis=1).astype(jnp.float32)
     f_b_b = jnp.minimum(cnt_b, n_valid) / n_valid
     d_b = jnp.where(
@@ -186,7 +201,10 @@ def ks_two_sample_masked(
     n_valid = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
 
     pooled = jnp.concatenate([ref_sorted, batch_sorted])
-    ref_cdf = jnp.searchsorted(ref_sorted, pooled, side="right") / r
+    # f32-pinned count division (x64-context tracing — see ks_two_sample).
+    ref_cdf = (
+        jnp.searchsorted(ref_sorted, pooled, side="right") / r
+    ).astype(jnp.float32)
     batch_counts = jnp.searchsorted(batch_sorted, pooled, side="right")
     batch_cdf = jnp.minimum(batch_counts.astype(jnp.float32), n_valid) / n_valid
     finite = jnp.isfinite(pooled)

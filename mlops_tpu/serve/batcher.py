@@ -116,9 +116,9 @@ class MicroBatcher:
         # seconds (event-loop confined: updated by _dispatch tasks, read
         # by _drain — both on the loop thread, never the executor)
         # (records, future, absolute loop-clock deadline or None,
-        #  tracewire span or None)
+        #  tracewire span or None, routed tier name or None)
         self._pending: list[
-            tuple[list[dict], asyncio.Future, float | None, Any]
+            tuple[list[dict], asyncio.Future, float | None, Any, str | None]
         ] = []
         self._drain_task: asyncio.Task | None = None
         self._full = asyncio.Event()  # set when a full group is waiting
@@ -164,6 +164,7 @@ class MicroBatcher:
         records: list[dict[str, Any]],
         deadline: float | None = None,
         span: Any = None,
+        tier: str | None = None,
     ) -> dict[str, Any] | bytes:
         """Entry point for the request handler. ``deadline`` (absolute
         loop-clock time, from the request's ``x-request-deadline-ms``
@@ -173,21 +174,30 @@ class MicroBatcher:
         shed engine-side, before it costs a device dispatch, not just
         abandoned by the waiting handler. ``span`` (tracewire) rides the
         same way and gets the queue/dispatch/fetch stage stamps; None
-        (the default, tracing disarmed) costs one branch per path."""
+        (the default, tracing disarmed) costs one branch per path.
+        ``tier`` (ISSUE 19 SLO routing, resolved upstream by
+        `engine.route_tier`) rides the entry too: a group is ONE compiled
+        program, so the drain loop only coalesces same-tier entries and
+        the dispatch carries the tier down to the engine. None (the
+        default and the single-tier fast path) is the engine's default
+        tier — stub engines without the keyword never see it."""
         loop = asyncio.get_running_loop()
         if (
             not self.enabled
             or not (1 <= len(records) <= GROUP_ROW_BUCKET)
         ):
-            if span is None:
+            if span is None and tier is None:
                 return await loop.run_in_executor(
                     self._executor, self._predict_solo, records
                 )
-            # Span threading needs the keyword form; stub engines (tests,
-            # sklearn shims) only see it with tracing armed.
+            # Span/tier threading needs the keyword form; stub engines
+            # (tests, sklearn shims) only see it with tracing armed or
+            # tier routing on.
             return await loop.run_in_executor(
                 self._executor,
-                lambda: self._predict_solo(records, span=span),
+                lambda: self._predict_solo(records, span=span, tier=tier)
+                if tier is not None
+                else self._predict_solo(records, span=span),
             )
 
         # Idle fast-path: a request arriving with nothing queued, nothing
@@ -214,14 +224,16 @@ class MicroBatcher:
             # the fast-path for the next victim — re-creating the
             # unbounded-dead-backlog failure the counter exists to stop.
             self._solo_inflight += 1
-            if span is None:
+            if span is None and tier is None:
                 fut = loop.run_in_executor(
                     self._executor, self._predict_solo, records
                 )
             else:
                 fut = loop.run_in_executor(
                     self._executor,
-                    lambda: self._predict_solo(records, span=span),
+                    lambda: self._predict_solo(records, span=span, tier=tier)
+                    if tier is not None
+                    else self._predict_solo(records, span=span),
                 )
 
             def _done(f: asyncio.Future) -> None:
@@ -237,7 +249,7 @@ class MicroBatcher:
             return await asyncio.shield(fut)
 
         future: asyncio.Future = loop.create_future()
-        self._pending.append((records, future, deadline, span))
+        self._pending.append((records, future, deadline, span, tier))
         if len(self._pending) >= self.max_group:
             self._full.set()  # close the window early
         if self._drain_task is None or self._drain_task.done():
@@ -300,7 +312,7 @@ class MicroBatcher:
             now = asyncio.get_running_loop().time()
             live = []
             for entry in self._pending:
-                _, future, entry_deadline, _ = entry
+                _, future, entry_deadline, _, _ = entry
                 if future.done():
                     continue
                 if entry_deadline is not None and now >= entry_deadline:
@@ -308,12 +320,24 @@ class MicroBatcher:
                     continue
                 live.append(entry)
             self._pending = live
-            batch = self._pending[: self.max_group]
-            del self._pending[: self.max_group]
-            if not batch:
+            if not self._pending:
                 self._inflight.release()
                 continue
-            task = asyncio.create_task(self._dispatch(batch))
+            # Same-tier claim (ISSUE 19): a group rides ONE compiled
+            # program, so a mixed-tier queue splits into per-tier
+            # dispatches — take the head entry's tier and every queued
+            # co-traveler on it (FIFO within the tier); other tiers stay
+            # queued and dispatch on the next loop iteration.
+            head_tier = self._pending[0][4]
+            batch: list = []
+            rest: list = []
+            for entry in self._pending:
+                if len(batch) < self.max_group and entry[4] == head_tier:
+                    batch.append(entry)
+                else:
+                    rest.append(entry)
+            self._pending = rest
+            task = asyncio.create_task(self._dispatch(batch, head_tier))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)
         # Exit with an empty queue: predict() observes the done() task and
@@ -335,11 +359,14 @@ class MicroBatcher:
 
     async def _dispatch(
         self,
-        batch: list[tuple[list[dict], asyncio.Future, float | None, Any]],
+        batch: list[
+            tuple[list[dict], asyncio.Future, float | None, Any, str | None]
+        ],
+        tier: str | None = None,
     ) -> None:
         loop = asyncio.get_running_loop()
-        requests = [records for records, _, _, _ in batch]
-        spans = [span for _, _, _, span in batch]
+        requests = [records for records, _, _, _, _ in batch]
+        spans = [span for _, _, _, span, _ in batch]
         if any(span is not None for span in spans):
             # Queue stage ends at claim: the window wait + any
             # inflight-bound wait the entry paid before this task ran.
@@ -363,14 +390,20 @@ class MicroBatcher:
         try:
             if dispatch is None or fetch is None:
                 responses = await loop.run_in_executor(
-                    self._executor, self.engine.predict_group, requests
+                    self._executor,
+                    (lambda: self.engine.predict_group(requests, tier=tier))
+                    if tier is not None
+                    else (lambda: self.engine.predict_group(requests)),
                 )
                 # One-phase engines: the whole call is the best available
                 # dispatch-time proxy for the continuous admit deadline.
                 self._observe_dispatch_s(loop.time() - t_dispatch)
             else:
                 handle = await loop.run_in_executor(
-                    self._executor, dispatch, requests
+                    self._executor,
+                    (lambda: dispatch(requests, tier=tier))
+                    if tier is not None
+                    else (lambda: dispatch(requests)),
                 )
                 self._observe_dispatch_s(loop.time() - t_dispatch)
                 for span in spans:
@@ -401,11 +434,11 @@ class MicroBatcher:
         # encode bug) is re-routed onto every waiter's future, where the
         # request handler surfaces it as a 500.
         except Exception as err:  # tpulint: disable=TPU201
-            for _, future, _, _ in batch:
+            for _, future, _, _, _ in batch:
                 if not future.done():
                     future.set_exception(err)
         else:
-            for (_, future, _, _), response in zip(batch, responses):
+            for (_, future, _, _, _), response in zip(batch, responses):
                 if not future.done():
                     future.set_result(response)
         finally:

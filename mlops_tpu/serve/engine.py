@@ -32,6 +32,14 @@ import numpy as np
 
 from mlops_tpu import faults
 from mlops_tpu.bundle.bundle import Bundle
+from mlops_tpu.ops.gbm_tensor import (
+    extract_gbm,
+    make_gbm_grouped_base,
+    make_gbm_packed_base,
+    supports_gbm_tensorization,
+    trace_context,
+    x64_context,
+)
 from mlops_tpu.ops.predict import (
     _acc_donation,
     make_hybrid_predict_fn,
@@ -40,6 +48,7 @@ from mlops_tpu.ops.predict import (
     packed_layout,
 )
 from mlops_tpu.schema import SCHEMA, records_to_columns
+from mlops_tpu.serve.tierroute import TIERS, tier_for_class
 
 # Declared lock order, OUTERMOST FIRST — the single source of truth for
 # both halves of tpulint Layer 3: the static analyzer
@@ -89,14 +98,31 @@ def _pad_rows(
     return cat, num, np.arange(rows) < n
 
 
+def _key_tier(key: tuple) -> str | None:
+    """Tier suffix of an exec-table key, None for the default tier:
+    ``("bucket", rows[, tier])`` / ``("group", slots, rows[, tier])`` —
+    the degraded-mode scans filter on it so a fallback never crosses
+    tiers (a demoted request must pay padding, never different bits)."""
+    n = 3 if key[0] == "group" else 2
+    return key[n] if len(key) > n else None
+
+
+def _entry_name(base: str, tier: str | None) -> str:
+    """Telemetry entry label for a dispatch: the geometry, suffixed with
+    the tier for NON-default tiers only — default-tier labels stay
+    byte-identical to every earlier release's series."""
+    return base if tier is None else f"{base}@{tier}"
+
+
 class _ArraysHandle:
     """In-flight padded dispatch: the device output plus everything the
     fetch side needs to slice the packed buffer back into the response."""
 
-    __slots__ = ("out", "n", "rows", "packed", "t0")
+    __slots__ = ("out", "n", "rows", "packed", "t0", "tier")
 
     def __init__(
-        self, out: Any, n: int, rows: int, packed: bool, t0: float = 0.0
+        self, out: Any, n: int, rows: int, packed: bool, t0: float = 0.0,
+        tier: str | None = None,
     ):
         self.out = out
         self.n = n
@@ -106,6 +132,7 @@ class _ArraysHandle:
         # device enqueue, 0.0 when the ledger is disarmed — the fetch
         # side differences it into the entry's device-path seconds.
         self.t0 = t0
+        self.tier = tier  # non-default serving tier, None = default
 
     def start_copy(self) -> None:
         _start_copy(self.out)
@@ -114,21 +141,25 @@ class _ArraysHandle:
 class _GroupHandle:
     """In-flight grouped dispatch (or the degenerate solo-path result)."""
 
-    __slots__ = ("out", "sizes", "rows", "responses", "slots", "entry", "t0")
+    __slots__ = ("out", "sizes", "rows", "responses", "slots", "entry",
+                 "t0", "tier")
 
     def __init__(self, out=None, sizes=None, rows=0, responses=None,
-                 slots=0, t0=0.0):
+                 slots=0, t0=0.0, tier=None):
         self.out = out
         self.sizes = sizes
         self.rows = rows
         self.responses = responses  # set = degenerate path, already done
         self.slots = slots  # slot-bucket geometry actually dispatched
         self.t0 = t0  # cost-ledger dispatch stamp (see _ArraysHandle)
+        self.tier = tier  # non-default serving tier, None = default
         # tracewire compiled-entry key, derived ONCE from the ints the
         # engine chose (degraded fallback included) — consumers carry the
         # ints (serve/ipc.py) or this string (the batcher's span entry),
         # never re-parse it.
-        self.entry = f"group_{slots}x{rows}" if slots else None
+        self.entry = (
+            _entry_name(f"group_{slots}x{rows}", tier) if slots else None
+        )
 
     def start_copy(self) -> None:
         if self.out is not None:
@@ -162,6 +193,7 @@ class InferenceEngine:
         model_shards: int = 1,
         device_index: int | None = None,
         serve_tier: str = "exact",
+        tier_routing: bool = False,
     ):
         self.bundle = bundle
         # Bundle turnover (mlops_tpu/lifecycle/): the generation counts
@@ -241,17 +273,88 @@ class InferenceEngine:
         # the fallback otherwise. Single-device by contract: the quant
         # params are a flat dict the partition rules don't cover.
         self.serve_tier = self._resolve_tier(serve_tier, bundle)
-        if bundle.flavor == "sklearn":
-            # CPU tree-ensemble floor: host classifier + device monitors.
-            # No grouped path — trees run on host threads anyway (and no
-            # AOT table: the classifier is not an XLA program). No device
-            # accumulator either: the server keeps the seed's host-side
-            # metric fold for this flavor.
+        # Per-request tier routing (ISSUE 19, serve/tierroute.py): the
+        # DEFAULT tier keeps the historical attribute slots
+        # (`_variables` / `_temperature` / the base jits / plain exec
+        # keys); every OTHER gated tier this engine holds lives in
+        # ``_tier_extra`` as a (variables, temperature, solo jit, group
+        # jit) quadruple and dispatches through the SAME exec table
+        # under tier-suffixed keys — one accumulator, one lock
+        # discipline, one degraded-mode policy across all tiers.
+        self.tier_routing = bool(tier_routing)
+        self.default_tier = self.serve_tier
+        self._tier_extra: dict[str, tuple] = {}
+        self.gbm_geometry = None
+        if bundle.flavor == "sklearn" and not supports_gbm_tensorization(
+            bundle.estimator
+        ):
+            # CPU tree-ensemble floor (the rf family — unbinned deep
+            # forests don't tensorize): host classifier + device
+            # monitors. No grouped path — trees run on host threads
+            # anyway (and no AOT table: the classifier is not an XLA
+            # program). No device accumulator either: the server keeps
+            # the seed's host-side metric fold for this flavor.
             self._predict = make_hybrid_predict_fn(
                 bundle.estimator, bundle.monitor, temperature
             )
             self._predict_group = None
             self._accumulate = False
+        elif bundle.flavor == "sklearn":
+            # gbm-tensor tier (ISSUE 19, ops/gbm_tensor.py): the fitted
+            # HistGBM ensemble lowers Hummingbird-style to padded
+            # gather/compare tensor programs in the SAME packed 7-arg
+            # contract as every flax family — so the sklearn floor rides
+            # the AOT table, the device accumulator, grouping, degraded
+            # mode, and the compile cache instead of host threads.
+            # Single-device by construction (sklearn has no partition
+            # rules; model_shards is ignored exactly as before).
+            gbm_variables, self.gbm_geometry = extract_gbm(bundle.estimator)
+            self.default_tier = "gbm"
+            if device_index is not None:
+                from jax.sharding import SingleDeviceSharding
+
+                self._placement = SingleDeviceSharding(
+                    jax.devices()[device_index]
+                )
+            with x64_context():
+                # The tree tensors are f64 by the bit-parity contract —
+                # committed under the x64 context or device_put would
+                # silently narrow them (jax 0.4.x semantics).
+                self._variables = (
+                    jax.device_put(gbm_variables, self._placement)
+                    if self._placement is not None
+                    else jax.device_put(gbm_variables)
+                )
+            if self._placement is not None:
+                self._monitor = jax.device_put(
+                    bundle.monitor, self._placement
+                )
+            else:
+                self._monitor = jax.device_put(bundle.monitor)
+            with x64_context():
+                # f64 temperature, unlike every other tier's f32: the
+                # host hybrid divides logits by the FULL python float
+                # (train/calibrate.py apply_temperature), and an f32
+                # rounding of T shifts ~1/3 of tempered probabilities by
+                # one ulp — bit-parity pins would fail.
+                self._temperature = (
+                    jax.device_put(np.float64(temperature), self._placement)
+                    if self._placement is not None
+                    else jax.device_put(np.float64(temperature))
+                )
+            donate = _acc_donation()
+            depth = self.gbm_geometry.depth
+            self._predict = jax.jit(  # tpulint: disable=TPU203
+                make_gbm_packed_base(depth), donate_argnums=donate
+            )
+            self._predict_group = (
+                jax.jit(  # tpulint: disable=TPU203
+                    make_gbm_grouped_base(depth), donate_argnums=donate
+                )
+                if enable_grouping
+                else None
+            )
+            self._accumulate = True
         else:
             # Partition-rule model sharding (ISSUE 13,
             # parallel/sharding.py): model_shards > 1 lays the params
@@ -351,14 +454,24 @@ class InferenceEngine:
                 if enable_grouping
                 else None
             )
-            # Device-resident monitor aggregate, threaded through every
-            # fused dispatch (monitor/state.py MonitorAccumulator). The
-            # lock serializes only the dispatch-order/ref-swap — the
-            # executions chain on device through the data dependency, the
-            # host never blocks here.
+            if self.tier_routing:
+                # Commit every OTHER gated tier alongside the default
+                # one — per-request routing needs them resident before
+                # traffic, not behind a first-request device_put.
+                self._tier_extra = self._build_extra_tiers(
+                    bundle, enable_grouping, donate
+                )
+            self._accumulate = True
+        if self._accumulate:
+            # The accumulating flavors' shared serving state (flax
+            # families and the gbm-tensor tier). Device-resident monitor
+            # aggregate, threaded through every fused dispatch
+            # (monitor/state.py MonitorAccumulator): the lock serializes
+            # only the dispatch-order/ref-swap — executions chain on
+            # device through the data dependency, the host never blocks
+            # here.
             from mlops_tpu.monitor.state import init_accumulator
 
-            self._accumulate = True
             self._acc = self._place_replicated(init_accumulator())
             self._acc_lock = threading.Lock()
             # Novel-shape compiles serialize here, never on _acc_lock: a
@@ -366,10 +479,10 @@ class InferenceEngine:
             # stall every in-flight request, not just the novel one.
             self._compile_lock = threading.Lock()
             # Exact host-side running totals, folded from each fetched
-            # window by `monitor_snapshot` (fetch-and-reset): left to grow
-            # on device, the f32 counters would silently saturate at 2^24
-            # rows (~2 h at the benched request rate) where the seed's
-            # Python-int /metrics totals could not.
+            # window by `monitor_snapshot` (fetch-and-reset): left to
+            # grow on device, the f32 counters would silently saturate
+            # at 2^24 rows (~2 h at the benched request rate) where the
+            # seed's Python-int /metrics totals could not.
             d = SCHEMA.num_categorical + SCHEMA.num_numeric
             self._totals: dict[str, Any] = {
                 "rows": 0.0,
@@ -385,6 +498,62 @@ class InferenceEngine:
             # failure — exported as mlops_tpu_degraded_dispatch_total.
             self._degraded = 0
         self.ready = False
+
+    def _build_extra_tiers(
+        self, bundle: Bundle, enable_grouping: bool, donate
+    ) -> dict[str, tuple]:
+        """Commit the non-default gated tiers (tier_routing=True, flax
+        flavors): an exact-default engine with a GATED quant student adds
+        "quant"; a quant-default engine always retains its "exact"
+        teacher (the accurate-class escape hatch). Each extra tier is a
+        full (params, temperature, solo jit, group jit) quadruple on the
+        same committed placement — `_dispatch_fused` reads it under the
+        same lock hold as the default refs, so tier choice never changes
+        the consistency story."""
+        extra: dict[str, tuple] = {}
+        others: list[str] = []
+        if self.serve_tier == "exact":
+            if (
+                bundle.has_quant
+                and bundle.quant_gates_passed
+                and self.model_shards == 1
+            ):
+                others.append("quant")
+        else:
+            others.append("exact")
+        for tier in others:
+            if tier == "quant":
+                from mlops_tpu.ops.quant_kernel import (
+                    make_quant_grouped_base,
+                    make_quant_packed_base,
+                )
+
+                variables = self._place_replicated(bundle.quant_params)
+                temperature = self._place_replicated(
+                    np.float32(bundle.quant_temperature)
+                )
+                solo_base = make_quant_packed_base()
+                group_base = make_quant_grouped_base()
+            else:
+                variables = self._place_replicated(bundle.variables)
+                temperature = self._place_replicated(
+                    np.float32(bundle.temperature)
+                )
+                solo_base = make_packed_predict_base(bundle.model)
+                group_base = make_packed_grouped_base(bundle.model)
+            extra[tier] = (
+                variables,
+                temperature,
+                jax.jit(  # tpulint: disable=TPU203
+                    solo_base, donate_argnums=donate
+                ),
+                jax.jit(  # tpulint: disable=TPU203
+                    group_base, donate_argnums=donate
+                )
+                if enable_grouping
+                else None,
+            )
+        return extra
 
     def _resolve_tier(self, serve_tier: str, bundle: Bundle) -> str:
         """Resolve the requested serving tier against what the bundle can
@@ -439,6 +608,27 @@ class InferenceEngine:
         return self._predict_group is not None
 
     @property
+    def available_tiers(self) -> tuple[str, ...]:
+        """The gated tiers this engine can dispatch per-request, cheapest
+        -> most accurate (`tierroute.TIERS` order restricted to what is
+        committed). Single-tier engines return a 1-tuple — routing then
+        collapses to the default tier for every class."""
+        held = {self.default_tier, *self._tier_extra}
+        return tuple(t for t in TIERS if t in held)
+
+    def route_tier(self, slo_class: int) -> str | None:
+        """SLO class -> the tier that serves it on THIS engine; None
+        means the default tier (plain un-suffixed exec keys — the
+        historical dispatch, bit-for-bit). The engine owns this mapping
+        so the wire carries only the CLASS: front ends don't know which
+        tiers a bundle gates, and the ring's crash replay re-derives the
+        identical tier from the class tag in shm."""
+        tier = tier_for_class(
+            self.available_tiers, self.default_tier, slo_class
+        )
+        return None if tier == self.default_tier else tier
+
+    @property
     def monitor_accumulating(self) -> bool:
         """True when the fused programs fold the monitor aggregate on
         device (`monitor_snapshot` is then the telemetry read path)."""
@@ -474,7 +664,9 @@ class InferenceEngine:
         import time
 
         t0 = time.perf_counter()
-        if self.bundle.flavor == "sklearn":
+        if not self._accumulate:
+            # Host-hybrid floor (rf): no AOT table — execute each bucket
+            # once so the jitted monitors compile before traffic.
             for bucket in self.buckets:
                 cat = np.zeros((bucket, SCHEMA.num_categorical), np.int32)
                 num = np.zeros((bucket, SCHEMA.num_numeric), np.float32)
@@ -491,6 +683,8 @@ class InferenceEngine:
         from mlops_tpu.compilecache.warmup import (
             default_workers,
             run_jobs,
+            serve_gbm_group_jobs,
+            serve_gbm_jobs,
             serve_group_jobs,
             serve_predict_jobs,
             serve_quant_group_jobs,
@@ -512,7 +706,31 @@ class InferenceEngine:
             for rows in GROUP_ROW_BUCKETS
             for slots in GROUP_SLOT_BUCKETS
         ]
-        if self.serve_tier == "quant":
+        if self.default_tier == "gbm":
+            # The gbm-tensor tier's own entry family (cache ids
+            # serve-predict-gbm-*): the tree tensors are the params tree,
+            # and lowering runs inside the x64 context (the job carries
+            # an x64-wrapping jitted — compilecache/warmup.py).
+            jobs = serve_gbm_jobs(
+                self._variables,  # the committed f64 tree tensors
+                self._monitor,
+                tuple(self.buckets),
+                geometry=self.gbm_geometry,
+                temperature=bundle.temperature,
+                placement=self._placement,
+                device_tag=device_tag,
+            )
+            if self._predict_group is not None:
+                jobs += serve_gbm_group_jobs(
+                    self._variables,
+                    self._monitor,
+                    grid,
+                    geometry=self.gbm_geometry,
+                    temperature=bundle.temperature,
+                    placement=self._placement,
+                    device_tag=device_tag,
+                )
+        elif self.serve_tier == "quant":
             # The quant tier's own entry family (distinct cache ids:
             # serve-predict-quant-*): same shapes, same dispatch-table
             # keys, different programs + params tree.
@@ -557,6 +775,40 @@ class InferenceEngine:
                     placement=self._placement,
                     device_tag=device_tag,
                 )
+        # Extra-tier warmup (tier_routing): every non-default gated tier
+        # warms its OWN job family into the same table under
+        # tier-suffixed keys — per-request routing must never pay a
+        # first-request compile for a tier the config promised.
+        for tier, (variables, _, _, group_jit) in self._tier_extra.items():
+            if tier == "quant":
+                extra = serve_quant_jobs(
+                    variables, self._monitor, tuple(self.buckets),
+                    temperature=bundle.quant_temperature,
+                    placement=self._placement, device_tag=device_tag,
+                )
+                if group_jit is not None:
+                    extra += serve_quant_group_jobs(
+                        variables, self._monitor, grid,
+                        temperature=bundle.quant_temperature,
+                        placement=self._placement, device_tag=device_tag,
+                    )
+            else:
+                extra = serve_predict_jobs(
+                    bundle.model, bundle.model_config, variables,
+                    self._monitor, tuple(self.buckets),
+                    temperature=bundle.temperature,
+                    placement=self._placement, device_tag=device_tag,
+                )
+                if group_jit is not None:
+                    extra += serve_group_jobs(
+                        bundle.model, bundle.model_config, variables,
+                        self._monitor, grid,
+                        temperature=bundle.temperature,
+                        placement=self._placement, device_tag=device_tag,
+                    )
+            for job in extra:
+                job.meta["tier"] = tier
+            jobs += extra
         for job, fn in run_jobs(
             jobs, cache=self.compile_cache, workers=self.warmup_workers
         ):
@@ -564,6 +816,8 @@ class InferenceEngine:
                 key = ("bucket", job.meta["bucket"])
             else:
                 key = ("group", job.meta["slots"], job.meta["rows"])
+            if job.meta.get("tier"):
+                key = key + (job.meta["tier"],)
             # Under _compile_lock (tpulint TPU402): the server binds its
             # socket FIRST and warms concurrently (serve/server.py _serve),
             # so live requests can race this loop — an unlocked table
@@ -585,7 +839,7 @@ class InferenceEngine:
             ),
         }
 
-    def _dispatch_fused(self, key: tuple, *batch):
+    def _dispatch_fused(self, key: tuple, *batch, tier: str | None = None):
         """Dispatch one fused packed call and thread the monitor
         accumulator through it — the ONE critical section shared by the
         solo and grouped paths.
@@ -607,24 +861,34 @@ class InferenceEngine:
         a request in flight during a promotion computes its whole answer
         from exactly one bundle generation — never new params through an
         old program or vice versa. Returns the packed output array; the
-        new accumulator stays device-resident."""
+        new accumulator stays device-resident.
+
+        ``tier`` (None = default) selects which committed (params,
+        temperature) pair feeds the program — ``key`` already carries the
+        matching suffix. All tiers thread the ONE accumulator: the
+        monitors are f32 on every tier by contract, so the fold chain is
+        tier-blind."""
         while True:
             with self._acc_lock:
                 fn = self._exec.get(key)
                 if fn is not None:
+                    if tier is None:
+                        variables = self._variables
+                        temperature = self._temperature
+                    else:
+                        variables, temperature = self._tier_extra[tier][:2]
                     acc = self._acc
                     out, new_acc = fn(
-                        self._variables, self._monitor, acc,
-                        self._temperature, *batch,
+                        variables, self._monitor, acc, temperature, *batch,
                     )
                     self._acc = new_acc
                     return out
             # Miss: compile outside the accumulator lock, then retry the
             # consistent-snapshot dispatch (a swap may have replaced the
             # table meanwhile; the loop re-reads everything together).
-            self._compile_novel(key, batch)
+            self._compile_novel(key, batch, tier=tier)
 
-    def _compile_novel(self, key: tuple, batch):
+    def _compile_novel(self, key: tuple, batch, tier: str | None = None):
         """AOT-compile a shape warmup missed and cache it in the dispatch
         table. Double-checked under ONE shared lock: concurrent first
         requests for the same shape compile once, and warmed traffic
@@ -644,22 +908,33 @@ class InferenceEngine:
         with self._compile_lock:
             fn = self._exec.get(key)
             if fn is None:
-                jitted = (
-                    self._predict if key[0] == "bucket"
-                    else self._predict_group
-                )
+                if tier is None:
+                    jitted = (
+                        self._predict if key[0] == "bucket"
+                        else self._predict_group
+                    )
+                    variables = self._variables
+                    temperature = self._temperature
+                else:
+                    variables, temperature, solo, group = (
+                        self._tier_extra[tier]
+                    )
+                    jitted = solo if key[0] == "bucket" else group
                 # The sync XLA compile DOES block this lock — that is the
                 # design: _compile_lock exists precisely to serialize novel
                 # compiles away from _acc_lock (where the same compile once
                 # stalled every in-flight request). Warmed traffic never
-                # touches this lock on its hot path.
-                fn = jitted.lower(  # tpulint: disable=TPU403
-                    self._variables,
-                    self._monitor,
-                    abstract_accumulator(),
-                    self._temperature,
-                    *batch,
-                ).compile()
+                # touches this lock on its hot path. The lowering runs in
+                # the serving tier's trace context (x64 for gbm-tensor —
+                # thread-local, so concurrent f32 dispatches are untouched).
+                with trace_context(tier or self.default_tier):
+                    fn = jitted.lower(  # tpulint: disable=TPU403
+                        variables,
+                        self._monitor,
+                        abstract_accumulator(),
+                        temperature,
+                        *batch,
+                    ).compile()
                 self._exec[key] = fn
         return fn
 
@@ -680,8 +955,8 @@ class InferenceEngine:
         the shared entries untouched (per-tenant lifecycle isolation)."""
         if not self._accumulate or not donor._accumulate:
             raise ValueError(
-                "executable adoption requires device-accumulating (flax) "
-                "engines on both sides — the sklearn flavor has no "
+                "executable adoption requires device-accumulating engines "
+                "on both sides — the host-hybrid flavor (rf) has no "
                 "shareable compiled entries"
             )
         if not donor.ready:
@@ -770,8 +1045,8 @@ class InferenceEngine:
         (one-deep) for `rollback`. Returns the new generation."""
         if not self._accumulate or not candidate._accumulate:
             raise ValueError(
-                "hot swap requires device-accumulating (flax) engines on "
-                "both sides — the sklearn flavor redeploys instead"
+                "hot swap requires device-accumulating engines on both "
+                "sides — the host-hybrid flavor (rf) redeploys instead"
             )
         if self.supports_grouping and not candidate.supports_grouping:
             raise ValueError(
@@ -794,6 +1069,7 @@ class InferenceEngine:
                     self.bundle, self._variables, self._monitor,
                     self._temperature, self._exec, self._predict,
                     self._predict_group, self.buckets, self.max_bucket,
+                    self._tier_extra, self.default_tier, self.gbm_geometry,
                 )
                 regrid = candidate.buckets != self.buckets
                 self.bundle = candidate.bundle
@@ -805,6 +1081,13 @@ class InferenceEngine:
                 self._predict_group = candidate._predict_group
                 self.buckets = candidate.buckets
                 self.max_bucket = candidate.max_bucket
+                # Tier routing state swaps with the bundle it describes:
+                # the candidate's gated extra tiers (and, for gbm-tensor
+                # bundles, the traversal geometry) belong to the NEW
+                # generation's params, never the old one's.
+                self._tier_extra = candidate._tier_extra
+                self.default_tier = candidate.default_tier
+                self.gbm_geometry = candidate.gbm_geometry
                 self.bundle_generation += 1
                 if regrid:
                     self.grid_generation += 1
@@ -830,12 +1113,14 @@ class InferenceEngine:
                     self.bundle, self._variables, self._monitor,
                     self._temperature, self._exec, self._predict,
                     self._predict_group, self.buckets, self.max_bucket,
+                    self._tier_extra, self.default_tier, self.gbm_geometry,
                 )
                 regrid = retired[7] != self.buckets
                 (self.bundle, self._variables, self._monitor,
                  self._temperature, self._exec, self._predict,
-                 self._predict_group, self.buckets,
-                 self.max_bucket) = retired
+                 self._predict_group, self.buckets, self.max_bucket,
+                 self._tier_extra, self.default_tier,
+                 self.gbm_geometry) = retired
                 self.bundle_generation += 1
                 if regrid:
                     self.grid_generation += 1
@@ -945,8 +1230,23 @@ class InferenceEngine:
         }
 
     # -------------------------------------------------------------- predict
+    def _normalize_tier(self, tier: str | None) -> str | None:
+        """Dispatch-entry tier normalization: None and the default tier
+        both mean the plain un-suffixed dispatch; anything else must be a
+        committed extra tier (routing never invents a tier — a typo'd
+        demand fails loudly, exactly like serve_tier='quant' at init)."""
+        if tier is None or tier == self.default_tier:
+            return None
+        if not self._accumulate or tier not in self._tier_extra:
+            raise ValueError(
+                f"tier {tier!r} is not committed on this engine "
+                f"(available: {self.available_tiers})"
+            )
+        return tier
+
     def predict_records(
-        self, records: list[dict[str, Any]], span=None
+        self, records: list[dict[str, Any]], span=None,
+        tier: str | None = None,
     ) -> dict[str, Any]:
         """Validated records -> reference response dict (`app/model.py:64-70`).
         ``span`` (tracewire, `trace/span.Span`) gets the engine-side stage
@@ -956,10 +1256,11 @@ class InferenceEngine:
         ds = self.bundle.preprocessor.encode(columns)
         if span is not None:
             span.stamp("encode")
-        return self.predict_arrays(ds.cat_ids, ds.numeric, span=span)
+        return self.predict_arrays(ds.cat_ids, ds.numeric, span=span, tier=tier)
 
     def predict_records_wire(
-        self, records: list[dict[str, Any]], span=None
+        self, records: list[dict[str, Any]], span=None,
+        tier: str | None = None,
     ) -> bytes:
         """`predict_records` straight to wire bytes: the whole
         encode→dispatch→fetch→json pipeline stays in the executor thread,
@@ -969,12 +1270,12 @@ class InferenceEngine:
         ds = self.bundle.preprocessor.encode(columns)
         if span is not None:
             span.stamp("encode")
-        handle = self.dispatch_arrays(ds.cat_ids, ds.numeric)
+        handle = self.dispatch_arrays(ds.cat_ids, ds.numeric, tier=tier)
         if handle is None:
             return EMPTY_RESPONSE_BYTES
         if span is not None:
             span.stamp("dispatch")
-            span.entry = f"bucket_{handle.rows}"
+            span.entry = _entry_name(f"bucket_{handle.rows}", handle.tier)
         handle.start_copy()
         response = self.fetch_arrays_wire(handle)
         if span is not None:
@@ -982,16 +1283,17 @@ class InferenceEngine:
         return response
 
     def predict_arrays(
-        self, cat_ids: np.ndarray, numeric: np.ndarray, span=None
+        self, cat_ids: np.ndarray, numeric: np.ndarray, span=None,
+        tier: str | None = None,
     ) -> dict[str, Any]:
-        handle = self.dispatch_arrays(cat_ids, numeric)
+        handle = self.dispatch_arrays(cat_ids, numeric, tier=tier)
         if handle is None:
             # Empty request: nothing to score, no drift signal (an empty
             # batch must not poison the drift gauges with statistic=1).
             return empty_response()
         if span is not None:
             span.stamp("dispatch")
-            span.entry = f"bucket_{handle.rows}"
+            span.entry = _entry_name(f"bucket_{handle.rows}", handle.tier)
         handle.start_copy()
         response = self.fetch_arrays(handle)
         if span is not None:
@@ -999,12 +1301,15 @@ class InferenceEngine:
         return response
 
     def dispatch_arrays(
-        self, cat_ids: np.ndarray, numeric: np.ndarray
+        self, cat_ids: np.ndarray, numeric: np.ndarray,
+        tier: str | None = None,
     ) -> _ArraysHandle | None:
         """Pad to the bucket and fire the device dispatch WITHOUT waiting
         for (or fetching) the result: returns a handle whose ``start_copy``
         begins the async D2H and whose ``fetch_arrays`` blocks. None for
-        the empty request (no device work at all)."""
+        the empty request (no device work at all). ``tier`` selects a
+        committed non-default serving tier (per-request SLO routing)."""
+        tier = self._normalize_tier(tier)
         n = cat_ids.shape[0]
         if n == 0:
             return None
@@ -1030,16 +1335,18 @@ class InferenceEngine:
                 stats.observe(f"bucket_{rows}", n, rows)
             return _ArraysHandle(out, n, rows, packed=False)
         t0 = time.perf_counter() if self.cost_ledger is not None else 0.0
-        out, rows = self._dispatch_padded(cat_ids, numeric, n, rows)
+        out, rows = self._dispatch_padded(cat_ids, numeric, n, rows, tier)
         stats = self.shape_stats
         if stats is not None:
             # rows is the shape that actually SERVED (the degraded
             # fallback bucket when the target failed) — the histogram must
             # describe the compute paid, not the compute intended.
-            stats.observe(f"bucket_{rows}", n, rows)
-        return _ArraysHandle(out, n, rows, packed=True, t0=t0)
+            stats.observe(_entry_name(f"bucket_{rows}", tier), n, rows)
+        return _ArraysHandle(out, n, rows, packed=True, t0=t0, tier=tier)
 
-    def _dispatch_padded(self, cat_ids, numeric, n: int, rows: int):
+    def _dispatch_padded(
+        self, cat_ids, numeric, n: int, rows: int, tier: str | None = None
+    ):
         """Pad to ``rows`` and dispatch the fused packed program, keyed by
         the padded row count (equal to the bucket for bucketed requests,
         the exact size for oversized ones — so a repeated oversized shape
@@ -1053,12 +1360,14 @@ class InferenceEngine:
         request pays extra padded compute, never an outage. Counted in
         ``degraded_dispatch_total``; with no larger warmed bucket the
         original failure propagates (the caller's 500 contract). Returns
-        ``(packed_out, rows_used)``."""
+        ``(packed_out, rows_used)``. Degraded fallbacks stay WITHIN the
+        request's tier: padding is bit-neutral, a tier change is not."""
+        key = ("bucket", rows) if tier is None else ("bucket", rows, tier)
         try:
             cat, num, mask = _pad_rows(cat_ids, numeric, n, rows)
-            return self._dispatch_fused(("bucket", rows), cat, num, mask), rows
+            return self._dispatch_fused(key, cat, num, mask, tier=tier), rows
         except Exception:
-            fallback = self._degraded_rows(rows)
+            fallback = self._degraded_rows(rows, tier)
             if fallback is None:
                 raise
             logger.warning(
@@ -1066,18 +1375,26 @@ class InferenceEngine:
                 rows, fallback, exc_info=True,
             )
             cat, num, mask = _pad_rows(cat_ids, numeric, n, fallback)
-            out = self._dispatch_fused(("bucket", fallback), cat, num, mask)
+            fkey = (
+                ("bucket", fallback) if tier is None
+                else ("bucket", fallback, tier)
+            )
+            out = self._dispatch_fused(fkey, cat, num, mask, tier=tier)
             self._count_degraded()
             return out, fallback
 
-    def _degraded_rows(self, rows: int) -> int | None:
-        """Smallest WARMED bucket strictly larger than ``rows`` (the
-        degraded-dispatch target), or None when nothing larger is warmed."""
+    def _degraded_rows(
+        self, rows: int, tier: str | None = None
+    ) -> int | None:
+        """Smallest WARMED same-tier bucket strictly larger than ``rows``
+        (the degraded-dispatch target), or None when nothing larger is
+        warmed for that tier."""
         with self._compile_lock:
             larger = [
                 key[1]
                 for key in self._exec
                 if key[0] == "bucket" and key[1] > rows
+                and _key_tier(key) == tier
             ]
         return min(larger, default=None)
 
@@ -1123,7 +1440,8 @@ class InferenceEngine:
             # exactly the cost a regrid would re-shape). The np.asarray
             # above is the blocking wait, so the buffer is in hand here.
             ledger.observe(
-                f"bucket_{rows}", self._cost_tag, n, rows,
+                _entry_name(f"bucket_{rows}", handle.tier),
+                self._cost_tag, n, rows,
                 time.perf_counter() - handle.t0,
             )
         return (
@@ -1134,7 +1452,8 @@ class InferenceEngine:
 
     # ----------------------------------------------------- grouped predict
     def predict_group(
-        self, requests: list[list[dict[str, Any]]]
+        self, requests: list[list[dict[str, Any]]],
+        tier: str | None = None,
     ) -> list[dict[str, Any]]:
         """Score several concurrent requests in ONE device dispatch.
 
@@ -1142,10 +1461,11 @@ class InferenceEngine:
         enforces this); responses are exactly what each request would get
         from ``predict_records`` alone — per-request drift included.
         """
-        return self.fetch_group(self.dispatch_group(requests))
+        return self.fetch_group(self.dispatch_group(requests, tier=tier))
 
     def dispatch_group(
-        self, requests: list[list[dict[str, Any]]]
+        self, requests: list[list[dict[str, Any]]],
+        tier: str | None = None,
     ) -> _GroupHandle:
         """Encode + fire the grouped device dispatch and start the packed
         output's async host copy, WITHOUT blocking on the result — the
@@ -1157,7 +1477,9 @@ class InferenceEngine:
             or len(requests) > GROUP_SLOT_BUCKETS[-1]
         ):
             return _GroupHandle(
-                responses=[self.predict_records(r) for r in requests]
+                responses=[
+                    self.predict_records(r, tier=tier) for r in requests
+                ]
             )
         sizes = [len(r) for r in requests]
         if not all(1 <= n <= GROUP_ROW_BUCKET for n in sizes):
@@ -1178,10 +1500,11 @@ class InferenceEngine:
                 (ds.cat_ids[offset : offset + n], ds.numeric[offset : offset + n])
             )
             offset += n
-        return self.dispatch_group_arrays(parts)
+        return self.dispatch_group_arrays(parts, tier=tier)
 
     def dispatch_group_arrays(
-        self, parts: list[tuple[np.ndarray, np.ndarray]]
+        self, parts: list[tuple[np.ndarray, np.ndarray]],
+        tier: str | None = None,
     ) -> _GroupHandle:
         """Grouped dispatch from PRE-ENCODED per-request arrays — the entry
         the shared-memory ring service uses (serve/ipc.py): front-end
@@ -1189,7 +1512,10 @@ class InferenceEngine:
         GIL there), so the engine process scatters rows straight into the
         group buffers without touching records or the preprocessor.
         Requires 2..GROUP_SLOT_BUCKETS[-1] requests of 1..GROUP_ROW_BUCKET
-        rows each (the callers' coalescing policy guarantees it)."""
+        rows each (the callers' coalescing policy guarantees it). The
+        whole group serves ONE tier (per-(tier, tenant) coalescing is the
+        callers' contract — one grouped dispatch is one program)."""
+        tier = self._normalize_tier(tier)
         sizes = [cat.shape[0] for cat, _ in parts]
         tee = self._tee
         if tee is not None:
@@ -1218,7 +1544,7 @@ class InferenceEngine:
         # dispatch on serial backends.
         rows = GROUP_ROW_BUCKETS[0] if max(sizes) == 1 else GROUP_ROW_BUCKET
         try:
-            out = self._dispatch_group_at(parts, sizes, slots, rows)
+            out = self._dispatch_group_at(parts, sizes, slots, rows, tier)
         except Exception:
             # DEGRADED MODE, grouped flavor: a compile/cache failure for
             # this group geometry retries through the smallest warmed
@@ -1226,7 +1552,7 @@ class InferenceEngine:
             # statistic, so responses stay bit-identical) instead of
             # failing the whole coalesced job.
             fallback = self._degraded_group_shape(
-                len(parts), max(sizes), (slots, rows)
+                len(parts), max(sizes), (slots, rows), tier
             )
             if fallback is None:
                 raise
@@ -1234,7 +1560,7 @@ class InferenceEngine:
                 "grouped dispatch at (%d, %d) failed; degrading to warmed "
                 "geometry (%d, %d)", slots, rows, *fallback, exc_info=True,
             )
-            out = self._dispatch_group_at(parts, sizes, *fallback)
+            out = self._dispatch_group_at(parts, sizes, *fallback, tier)
             self._count_degraded()
             slots, rows = fallback
         stats = self.shape_stats
@@ -1242,9 +1568,12 @@ class InferenceEngine:
             # Geometry occupancy: requested = the rows clients asked for,
             # padded = the full slots x rows grid the program computed
             # (slot padding AND row padding both count as waste).
-            stats.observe(f"group_{slots}x{rows}", sum(sizes), slots * rows)
+            stats.observe(
+                _entry_name(f"group_{slots}x{rows}", tier),
+                sum(sizes), slots * rows,
+            )
         handle = _GroupHandle(
-            out=out, sizes=sizes, rows=rows, slots=slots, t0=t0
+            out=out, sizes=sizes, rows=rows, slots=slots, t0=t0, tier=tier
         )
         handle.start_copy()
         return handle
@@ -1255,6 +1584,7 @@ class InferenceEngine:
         sizes: list[int],
         slots: int,
         rows: int,
+        tier: str | None = None,
     ):
         """Scatter the pre-encoded parts into one [slots, rows, ...] stack
         and fire the fused grouped dispatch — shared by the target-shape
@@ -1267,14 +1597,19 @@ class InferenceEngine:
             cat[i, :n] = part_cat
             num[i, :n] = part_num
             mask[i, :n] = True
-        return self._dispatch_fused(("group", slots, rows), cat, num, mask)
+        key = (
+            ("group", slots, rows) if tier is None
+            else ("group", slots, rows, tier)
+        )
+        return self._dispatch_fused(key, cat, num, mask, tier=tier)
 
     def _degraded_group_shape(
-        self, n_parts: int, max_rows: int, failed: tuple[int, int]
+        self, n_parts: int, max_rows: int, failed: tuple[int, int],
+        tier: str | None = None,
     ) -> tuple[int, int] | None:
-        """Smallest-area WARMED group geometry that fits ``n_parts``
-        requests of up to ``max_rows`` rows, excluding the shape that just
-        failed; None when nothing warmed fits."""
+        """Smallest-area WARMED same-tier group geometry that fits
+        ``n_parts`` requests of up to ``max_rows`` rows, excluding the
+        shape that just failed; None when nothing warmed fits."""
         with self._compile_lock:
             fits = [
                 (key[1], key[2])
@@ -1283,6 +1618,7 @@ class InferenceEngine:
                 and key[1] >= n_parts
                 and key[2] >= max_rows
                 and (key[1], key[2]) != failed
+                and _key_tier(key) == tier
             ]
         return min(fits, key=lambda sr: sr[0] * sr[1], default=None)
 
@@ -1333,7 +1669,8 @@ class InferenceEngine:
             # land on its geometry entry (requested = the rows clients
             # asked for; padded = the full slots x rows grid).
             ledger.observe(
-                f"group_{handle.slots}x{rows}", self._cost_tag,
+                _entry_name(f"group_{handle.slots}x{rows}", handle.tier),
+                self._cost_tag,
                 sum(handle.sizes), handle.slots * rows,
                 time.perf_counter() - handle.t0,
             )

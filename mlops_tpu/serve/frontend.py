@@ -68,6 +68,7 @@ from mlops_tpu.serve.metrics import (
     ENG_RESPAWNS,
     render_ring_metrics,
 )
+from mlops_tpu.serve.tierroute import SLO_DEFAULT, BrownoutGovernor
 from mlops_tpu.serve.wire import (
     EMPTY_RESPONSE_BYTES,
     RESP_EXPIRED,
@@ -168,6 +169,23 @@ class FrontendServer(HttpProtocol):
         )
         self.client = RingClient(
             ring, worker_id, affinity_slack=config.replica_affinity_slack
+        )
+        # Brownout-over-shed governor (ISSUE 19, serve/tierroute.py):
+        # per worker, fed by this worker's own slot-partition occupancy
+        # — the resource whose exhaustion sheds — so each front end
+        # demotes its own default-class traffic before its own partition
+        # 503s. The demoted CLASS rides the slot header; the engine
+        # resolves it to a tier, so a front end never needs the model's
+        # tier ladder. ``slo_routing`` (the shared shell's flag, from
+        # serve.tier_routing) gates header parsing and the governor
+        # together.
+        self._brownout = (
+            BrownoutGovernor(
+                demote_depth=config.brownout_demote_depth,
+                restore_depth=config.brownout_restore_depth,
+            )
+            if self.slo_routing
+            else None
         )
         self.metrics = ShmWorkerMetrics(
             ring, worker_id, default_tenant=default_index
@@ -311,6 +329,7 @@ class FrontendServer(HttpProtocol):
         deadline: float | None = None,
         span=None,
         tenant: int = 0,
+        slo: int = SLO_DEFAULT,
     ):
         """The ring-backed scoring hook under the shared `_predict` shell
         (serve/httpcore.py): per-tenant quota, then slot admission, then
@@ -331,7 +350,7 @@ class FrontendServer(HttpProtocol):
             # 1-tenant fleet: fairness is trivial; admission is exactly
             # the pre-tenancy slot path.
             return await self._score_admitted(
-                record_dicts, request_id, deadline, span, tenant
+                record_dicts, request_id, deadline, span, tenant, slo
             )
         # QUOTA BEFORE EVERYTHING (weighted max-min, tenancy/quota.py),
         # per slot CLASS — the request's row count picks the physical
@@ -369,11 +388,11 @@ class FrontendServer(HttpProtocol):
             # which answers the physical-shed 503 (claim can still
             # succeed if a slot freed since the check — benign).
             return await self._score_admitted(
-                record_dicts, request_id, deadline, span, tenant
+                record_dicts, request_id, deadline, span, tenant, slo
             )
         try:
             return await self._score_admitted(
-                record_dicts, request_id, deadline, span, tenant
+                record_dicts, request_id, deadline, span, tenant, slo
             )
         finally:
             # The governor tracks ADMITTED REQUESTS, not slots: a zombie
@@ -389,6 +408,7 @@ class FrontendServer(HttpProtocol):
         deadline: float | None,
         span,
         tenant: int,
+        slo: int = SLO_DEFAULT,
     ):
         from mlops_tpu.schema import records_to_columns
 
@@ -405,8 +425,20 @@ class FrontendServer(HttpProtocol):
         # governor admitted against the class the row count names, so an
         # overflow slab would hold capacity the other class's governor
         # never accounted (tenancy/quota.py).
+        # Brownout before shed (ISSUE 19): when this worker's partition
+        # occupancy crosses the governor's threshold, default-class
+        # requests demote to the cheap class BEFORE claiming — the
+        # demoted class rides the slot header and the engine serves the
+        # cheaper tier, so pressure turns into faster (still-correct)
+        # answers instead of 503s. Explicit cheap/accurate headers are
+        # never overridden, and the governor auto-restores once
+        # occupancy falls back through the restore threshold.
+        demoted = False
+        if self._brownout is not None:
+            self._brownout.observe(self.client.pressure())
+            slo, demoted = self._brownout.route(slo)
         slot = self.client.claim(
-            n, tenant, allow_overflow=self.quota is None
+            n, tenant, allow_overflow=self.quota is None, slo=slo
         )
         if slot is None:
             # Bounded admission per bucket class: shed FAST with a
@@ -448,6 +480,11 @@ class FrontendServer(HttpProtocol):
                 "application/json",
                 {"retry-after": str(retry_s)},
             )
+        if demoted:
+            # Counted only for ADMITTED requests: a demote-then-shed is a
+            # shed (the demotion never served anyone), so the counter
+            # stays "requests served below their requested class".
+            self.client.count_demotion(brownout=True)
         submitted = False
         try:
             loop = asyncio.get_running_loop()
@@ -928,6 +965,7 @@ def _engine_main(
         model_shards=serve_cfg.model_shards,
         device_index=device_index,
         serve_tier=serve_cfg.serve_tier,
+        tier_routing=serve_cfg.tier_routing,
     )
     engines = registry.engines
     if trace is not None:

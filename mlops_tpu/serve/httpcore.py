@@ -24,6 +24,10 @@ import pydantic
 
 from mlops_tpu.config import ServeConfig
 from mlops_tpu.schema import LoanApplicant
+from mlops_tpu.serve.tierroute import (  # jax-free
+    SLO_DEFAULT,
+    resolve_slo_class,
+)
 from mlops_tpu.tenancy.router import TenantRouter  # jax-free
 from mlops_tpu.trace.span import Span  # jax-free; front ends import this too
 
@@ -190,6 +194,11 @@ class HttpProtocol:
         # the degenerate single-tenant fleet ("default"), under which
         # untagged traffic behaves exactly like the pre-tenancy plane.
         self.tenants = TenantRouter(())
+        # SLO tier routing (ISSUE 19, serve/tierroute.py): armed when the
+        # config turns it on AND the serving side committed more than one
+        # tier. Disarmed (the default) the class resolution short-circuits
+        # to DEFAULT — one boolean check per request, no header parsing.
+        self.slo_routing = bool(getattr(config, "tier_routing", False))
 
     # ------------------------------------------------------ subclass hooks
     async def _predict(
@@ -199,6 +208,7 @@ class HttpProtocol:
         deadline: float | None = None,
         span=None,
         tenant_raw: str = "",
+        slo: int = SLO_DEFAULT,
     ):
         """The reference's `predict()` endpoint (`app/main.py:42-86`):
         validate -> log InferenceData -> score -> log ModelOutput ->
@@ -222,7 +232,12 @@ class HttpProtocol:
         ``tenant_raw`` is the request's ``x-tenant`` header value:
         resolved FIRST (before validation pays pydantic) — an unknown
         tenant answers 404 rather than silently billing the default
-        tenant's quota and monitors for a stranger's traffic."""
+        tenant's quota and monitors for a stranger's traffic.
+
+        ``slo`` is the request's SLO class (serve/tierroute.py — explicit
+        ``x-slo-class`` header or defaulted from the deadline budget),
+        resolved at admission and carried down to `_score`, where each
+        plane maps it to a serving tier."""
         tenant = self.tenants.resolve(tenant_raw)
         if tenant is None:
             return (
@@ -282,7 +297,7 @@ class HttpProtocol:
             if sampled:
                 logger.info("%s", _LazyJson(request_event))
         response = await self._score(
-            record_dicts, request_id, deadline, span, tenant
+            record_dicts, request_id, deadline, span, tenant, slo
         )
         if isinstance(response, tuple):
             # Subclass error path (shed 503 / deadline 504 / failure
@@ -312,6 +327,7 @@ class HttpProtocol:
         deadline: float | None = None,
         span=None,
         tenant: int = 0,
+        slo: int = SLO_DEFAULT,
     ):
         raise NotImplementedError
 
@@ -425,6 +441,7 @@ class HttpProtocol:
                 # budget, so the expiry check after the body read sheds
                 # it without any downstream work.
                 deadline = self._request_deadline(headers)
+                slo = self._request_slo(headers)
                 body = b""
                 # RFC 9110: Content-Length is 1*DIGIT. Bare int() also
                 # accepts '+5', '-1', '1_0', and unicode digits — parser
@@ -496,7 +513,7 @@ class HttpProtocol:
                     # path's Retry-After).
                     result = await self._route(
                         method, route_path, body, request_id, deadline,
-                        span, tenant_raw,
+                        span, tenant_raw, slo,
                     )
                     status, payload, content_type = result[:3]
                     extra_headers = result[3] if len(result) > 3 else None
@@ -567,6 +584,28 @@ class HttpProtocol:
             return asyncio.get_running_loop().time() + int(raw) / 1e3
         return None
 
+    def _request_slo(self, headers: dict) -> int:
+        """SLO class at admission (serve/tierroute.py): an explicit
+        well-formed ``x-slo-class`` header wins; otherwise a tight
+        ``x-request-deadline-ms`` budget (at or under
+        serve.slo_cheap_deadline_ms) routes CHEAP. Malformed values are
+        IGNORED like the deadline header — routing hints must never turn
+        scored traffic into errors. Disarmed (the default) this is one
+        boolean check."""
+        if not self.slo_routing:
+            return SLO_DEFAULT
+        raw = headers.get("x-request-deadline-ms", "")
+        deadline_ms = (
+            float(raw)
+            if raw and raw.isascii() and raw.isdigit() and int(raw) > 0
+            else None
+        )
+        return resolve_slo_class(
+            headers.get("x-slo-class", ""),
+            deadline_ms,
+            getattr(self.config, "slo_cheap_deadline_ms", 0.0),
+        )
+
     def _request_id(self, headers: dict) -> str:
         """Honor a well-formed inbound ``x-request-id`` (so the caller's
         trace id correlates the two log events end to end — the reference
@@ -620,10 +659,11 @@ class HttpProtocol:
         deadline: float | None = None,
         span=None,
         tenant_raw: str = "",
+        slo: int = SLO_DEFAULT,
     ):
         if path == "/predict" and method == "POST":
             return await self._predict(
-                body, request_id, deadline, span, tenant_raw
+                body, request_id, deadline, span, tenant_raw, slo
             )
         if path.startswith("/debug/profile/") and method == "POST":
             return await self._profile(path.removeprefix("/debug/profile/"))

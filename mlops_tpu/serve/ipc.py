@@ -130,6 +130,7 @@ from mlops_tpu.serve.metrics import (
     ROB_EXPIRED_ENGINE,
     ServingMetrics,
 )
+from mlops_tpu.serve.tierroute import TIERS  # jax-free
 from mlops_tpu.serve.wire import (
     GROUP_ROW_BUCKET,
     GROUP_SLOT_BUCKETS,
@@ -224,6 +225,7 @@ TPULINT_SHM_OWNERSHIP = {
     "slot_tenant": "frontend-worker",
     "slot_replica": "frontend-worker",
     "slot_deadline": "frontend-worker",
+    "slot_slo": "frontend-worker",
     # ...the engine owns the response half
     "resp_gen": "engine-replica",
     "resp_status": "engine-replica",
@@ -249,6 +251,8 @@ TPULINT_SHM_OWNERSHIP = {
     "expired": "frontend-worker",
     "parked": "frontend-worker",
     "brownout_shed": "frontend-worker",
+    "tier_demote": "frontend-worker",
+    "brownout_demote": "frontend-worker",
     "trace_dropped": "frontend-worker",
     "flight_dumps": "frontend-worker",
     # engine telemetry blocks (the engine's telemetry loop publishes;
@@ -257,6 +261,7 @@ TPULINT_SHM_OWNERSHIP = {
     "shape_keys": "telemetry-loop",
     "shape_vals": "telemetry-loop",
     "rob_vals": ("engine-replica", "telemetry-loop"),
+    "tier_counts": "engine-replica",
     "mon_vals": ("engine-replica", "telemetry-loop"),
     "mon_drift_last": ("engine-replica", "telemetry-loop"),
     "mon_drift_mean": ("engine-replica", "telemetry-loop"),
@@ -318,6 +323,11 @@ TPULINT_SHM_ROLES = {
 }
 
 SMALL, LARGE = 0, 1  # slot classes (stats/gauge indices)
+
+# Serving-tier geometry for the shm tier_counts block (ISSUE 19): column
+# i of a replica's row counts requests dispatched through TIERS[i].
+N_TIERS = len(TIERS)
+_TIER_IDX = {tier: i for i, tier in enumerate(TIERS)}
 
 STATUSES = RING_STATUSES  # closed status set for the request matrices
 _STATUS_IDX = {s: i for i, s in enumerate(STATUSES)}
@@ -557,6 +567,15 @@ class RequestRing:
             # engine checks it BEFORE dispatching and completes expired
             # descriptors RESP_EXPIRED without touching the device.
             ("slot_deadline", np.dtype(np.float64), (self.n_slots,)),
+            # Routed SLO class of the request occupying the slot (ISSUE
+            # 19, serve/tierroute.py — 0 default / 1 cheap / 2 accurate,
+            # POST brownout demotion: the front end's governor demotes
+            # before the claim, so what rides the slot is the class the
+            # engine must serve). Stamped with slot_tenant at CLAIM, so
+            # the engine's dispatch and a respawned engine's replay both
+            # route the slot through the SAME tier — a replay can never
+            # silently upgrade or downgrade an in-flight request.
+            ("slot_slo", np.dtype(np.uint32), (self.n_slots,)),
             ("resp_gen", np.dtype(np.uint32), (self.n_slots,)),
             ("resp_status", np.dtype(np.uint32), (self.n_slots,)),
             # Engine incarnation that produced this slot's response
@@ -628,6 +647,13 @@ class RequestRing:
             # respawn-ETA Retry-After, not a new status).
             ("parked", np.dtype(np.uint64), (workers,)),
             ("brownout_shed", np.dtype(np.uint64), (workers,)),
+            # ISSUE 19 — SLO tier-routing demotions counted FRONT-END
+            # side (single writer per worker, like expired/shed):
+            # tier_demote = every request served below its requested
+            # class; brownout_demote = the subset demoted by the
+            # brownout governor (pressure), not by an explicit header.
+            ("tier_demote", np.dtype(np.uint64), (workers,)),
+            ("brownout_demote", np.dtype(np.uint64), (workers,)),
             # tracewire spans each front end's bounded recorder DROPPED
             # (single writer per worker, like expired/shed)
             ("trace_dropped", np.dtype(np.uint64), (workers,)),
@@ -651,6 +677,12 @@ class RequestRing:
             # ROB_DEGRADED = the engine's degraded-dispatch total
             # (mirrored by the telemetry loop)
             ("rob_vals", np.dtype(np.float64), (R, 2)),
+            # requests dispatched per serving tier (ISSUE 19 — column i
+            # is tierroute.TIERS[i]; pool threads under
+            # RingService._mon_lock, one row per replica): the ring twin
+            # of ServingMetrics.tier_requests, summed over replicas by
+            # the render.
+            ("tier_counts", np.dtype(np.float64), (R, N_TIERS)),
             # monitor aggregate, ONE ROW PER (REPLICA, TENANT) — single
             # writer: that replica's engine process (each tenant engine
             # owns its own device accumulator and exact host totals,
@@ -1263,6 +1295,13 @@ class RingClient:
         # whose slot has moved on.
         self._free: tuple[list[int], list[int]] = ([], [])
         self._quarantined: set[int] = set()
+        # Partition capacity (both classes) — the denominator of the
+        # brownout governor's pressure signal (ISSUE 19): slot
+        # occupancy over THIS worker's partition, the same bounded
+        # admission queue whose exhaustion is the shed signal, so
+        # "demote before shed" keys off exactly the resource whose
+        # exhaustion sheds.
+        self.partition_slots = len(small) + len(large)
         for slot in (*small, *large):
             ring.slot_gen[slot] += 1
             if int(ring.slot_busy[slot]):
@@ -1318,7 +1357,8 @@ class RingClient:
 
     # -------------------------------------------------------------- claim
     def claim(
-        self, n_rows: int, tenant: int = 0, allow_overflow: bool = True
+        self, n_rows: int, tenant: int = 0, allow_overflow: bool = True,
+        slo: int = 0,
     ) -> int | None:
         """A free slot whose slab fits ``n_rows``, or None (shed). Small
         requests prefer the small class and (with ``allow_overflow``,
@@ -1348,6 +1388,12 @@ class RingClient:
         else:
             return None
         self.ring.slot_tenant[slot] = tenant
+        # SLO class rides the slot header with the tenant tag (ISSUE 19,
+        # stamped BEFORE any counter moves / descriptor visibility): the
+        # engine's dispatch AND a respawned engine's replay both read
+        # the class back from shm, so the serving tier survives every
+        # crash window the tenant tag survives.
+        self.ring.slot_slo[slot] = slo
         self.ring.inflight[
             self.worker, tenant, self.ring.slot_class(slot)
         ] += 1
@@ -1362,6 +1408,25 @@ class RingClient:
         quota (free slots existed; the tenant's floor did not allow the
         claim) — the fairness contract's per-tenant observable."""
         self.ring.quota_shed[self.worker, tenant] += 1
+
+    def pressure(self) -> float:
+        """Occupied fraction of this worker's slot partition (0.0 =
+        idle, 1.0 = the next claim sheds) — event-loop confined like the
+        free lists it reads. Quarantined slots count as occupied: they
+        hold real capacity until the engine's completion frees them."""
+        if not self.partition_slots:
+            return 0.0
+        free = len(self._free[SMALL]) + len(self._free[LARGE])
+        return 1.0 - free / self.partition_slots
+
+    def count_demotion(self, brownout: bool = False) -> None:
+        """One request served below its requested SLO class (ISSUE 19).
+        ``brownout`` marks the governor-driven subset — demote-over-shed
+        under pressure — vs. a deliberate cheap-tier header. Single
+        writer: this worker's event loop (the expired/shed discipline)."""
+        self.ring.tier_demote[self.worker] += 1
+        if brownout:
+            self.ring.brownout_demote[self.worker] += 1
 
     def submit(
         self,
@@ -1762,18 +1827,35 @@ class RingService:
         not a real failure mode."""
         return int(self.ring.slot_tenant[slot]) % len(self.engines)
 
+    def _slot_tier(self, slot: int, tenant: int) -> str | None:
+        """The serving tier the slot's shm SLO class resolves to on its
+        tenant's engine (None = the default tier — the plain exec keys,
+        bit-for-bit the historical dispatch). Reading the class back out
+        of shm — instead of threading it through descriptors — is what
+        makes the respawn replay tier-faithful for free: the replay
+        calls the same resolver over the same header."""
+        slo_tags = getattr(self.ring, "slot_slo", None)
+        route = getattr(self.engines[tenant], "route_tier", None)
+        if slo_tags is None or route is None:
+            return None
+        return route(int(slo_tags[slot]))
+
     def _make_jobs(
         self, descs: list[tuple[int, int]]
     ) -> list[list[tuple[int, int]]]:
         """The coalescing policy, shared by the live collector and the
         re-attach replay: small requests group up to ``max_group`` per
         device dispatch, everything else runs solo. Grouping is PER
-        TENANT — a grouped dispatch runs one tenant's compiled program
-        with one tenant's params and folds one tenant's monitor
-        accumulator, so slots from different tenants can never share a
-        device dispatch (they still share the pool and the ring)."""
+        (TENANT, TIER) — a grouped dispatch runs one tenant's compiled
+        program for one serving tier with one tenant's params and folds
+        one tenant's monitor accumulator, so slots from different
+        tenants — or different SLO tiers of one tenant (ISSUE 19) — can
+        never share a device dispatch (they still share the pool and
+        the ring)."""
         ring = self.ring
-        groupable: dict[int, list[tuple[int, int]]] = {}
+        groupable: dict[
+            tuple[int, str | None], list[tuple[int, int]]
+        ] = {}
         solo: list[tuple[int, int]] = []
         for slot, gen in descs:
             n = int(ring.slot_n[slot])
@@ -1782,12 +1864,13 @@ class RingService:
                 self.engines[tenant], "supports_grouping", False
             )
             if can_group and 1 <= n <= GROUP_ROW_BUCKET:
-                groupable.setdefault(tenant, []).append((slot, gen))
+                tier = self._slot_tier(slot, tenant)
+                groupable.setdefault((tenant, tier), []).append((slot, gen))
             else:
                 solo.append((slot, gen))
         jobs: list[list[tuple[int, int]]] = []
-        for tenant in sorted(groupable):
-            batch = groupable[tenant]
+        for key in sorted(groupable, key=lambda k: (k[0], k[1] or "")):
+            batch = groupable[key]
             for i in range(0, len(batch), self.max_group):
                 jobs.append(batch[i : i + self.max_group])
         jobs.extend([d] for d in solo)
@@ -2088,13 +2171,23 @@ class RingService:
         accumulator, its temperature."""
         ring, engine = self.ring, self.engines[tenant]
         tracing = ring.tracing
+        # Serving tier (ISSUE 19): every slot in a job resolves to ONE
+        # tier (`_make_jobs` partitions per (tenant, tier)), so the
+        # whole job dispatches through that tier's compiled entries.
+        # The kwarg is only passed when a tier actually resolved — the
+        # single-tier call shape stays byte-identical for stub engines.
+        tier = self._slot_tier(job[0][0], tenant)
         parts = []
         for slot, _ in job:
             n = int(ring.slot_n[slot])
             cat, num = ring.request_views(slot)
             parts.append((cat[:n], num[:n]))
         if len(parts) >= 2:
-            handle = engine.dispatch_group_arrays(parts)
+            handle = (
+                engine.dispatch_group_arrays(parts, tier=tier)
+                if tier is not None
+                else engine.dispatch_group_arrays(parts)
+            )
             if tracing:
                 self._stamp_dispatched(job, handle, kind=2)
             sizes, preds, outs, drifts = engine.fetch_group_raw(handle)
@@ -2104,11 +2197,21 @@ class RingService:
             ]
         else:
             cat, num = parts[0]
-            handle = engine.dispatch_arrays(cat, num)
+            handle = (
+                engine.dispatch_arrays(cat, num, tier=tier)
+                if tier is not None
+                else engine.dispatch_arrays(cat, num)
+            )
             if tracing:
                 self._stamp_dispatched(job, handle, kind=1)
             handle.start_copy()
             raws = [engine.fetch_arrays_raw(handle)]
+        label = tier if tier is not None else getattr(
+            engine, "default_tier", None
+        )
+        if label in _TIER_IDX and getattr(ring, "tier_counts", None) is not None:
+            with self._mon_lock:
+                ring.tier_counts[self.replica, _TIER_IDX[label]] += len(job)
         if tracing:
             # Engine-half span stamp 4: the blocking host copy landed
             # (device_fetch ends; the remainder to the front end's

@@ -12,6 +12,10 @@ import threading
 import time
 from collections import defaultdict
 
+# The closed serving-tier set (ISSUE 19): tier label values on the
+# routing series below come from this tuple only, never request text.
+from mlops_tpu.serve.tierroute import TIERS  # jax-free
+
 # ---- multi-worker exposition constants (shared with serve/ipc.py) ----
 # Closed status set for the per-worker shared-memory request matrices
 # (the protocol layer's reason set); anything else lands in the
@@ -141,6 +145,7 @@ TPULINT_BOUNDED_LABELS = (
     "slo",
     "status",
     "tenant",
+    "tier",
     "version",
     "window",
     "worker",
@@ -272,6 +277,13 @@ class ServingMetrics:
         # controller installs a snapshot (same export-only-when-running
         # contract as the lifecycle block).
         self.autotune: dict | None = None
+        # SLO tier routing (ISSUE 19, serve/tierroute.py): requests per
+        # routed tier, class demotions (any cause), and the brownout
+        # subset. Zero baselines always export — a single-tier plane
+        # renders the full closed tier set at 0.
+        self.tier_requests: dict[str, int] = defaultdict(int)
+        self.tier_demotions = 0
+        self.brownout_demotions = 0
 
     # Known routes only: arbitrary request paths must not become unbounded
     # (and injectable) Prometheus label values.
@@ -423,6 +435,51 @@ class ServingMetrics:
         total — `slo/flightrec.FlightRecorder`)."""
         with self._lock:
             self.flight_dumps = int(total)
+
+    def count_tier(self, tier: str) -> None:
+        """One request routed to ``tier`` (a member of the closed TIERS
+        set — callers resolve through the engine, never request text)."""
+        with self._lock:
+            self.tier_requests[tier] += 1
+
+    def count_demotion(self, brownout: bool = False) -> None:
+        """One SLO-class demotion (a request served a cheaper tier than
+        its class asked for); ``brownout`` marks the overload-governor
+        subset."""
+        with self._lock:
+            self.tier_demotions += 1
+            if brownout:
+                self.brownout_demotions += 1
+
+    @staticmethod
+    def tier_lines(
+        tier_requests: dict | None,
+        demotions: int = 0,
+        brownout_demotions: int = 0,
+    ) -> list[str]:
+        """The SLO tier-routing block (ISSUE 19) — ONE definition shared
+        by the single-process render and the ring render so both
+        telemetry planes export identical series names. Always emitted
+        with the FULL closed tier set at a zero baseline: "no series"
+        must never be indistinguishable from "routing off", and the
+        chaos smoke's monotonicity check needs the baseline."""
+        counts = tier_requests or {}
+        lines = ["# TYPE mlops_tpu_tier_requests_total counter"]
+        for tier in TIERS:
+            lines.append(
+                f'mlops_tpu_tier_requests_total{{tier="{tier}"}} '
+                f"{int(counts.get(tier, 0))}"
+            )
+        lines.append("# TYPE mlops_tpu_tier_demotions_total counter")
+        lines.append(f"mlops_tpu_tier_demotions_total {int(demotions)}")
+        # The brownout subset: demotions taken INSTEAD of 503 sheds while
+        # the overload governor is active — the goodput-over-refusal
+        # observable (docs/operations.md "Brownout runbook").
+        lines.append("# TYPE mlops_tpu_brownout_demote_total counter")
+        lines.append(
+            f"mlops_tpu_brownout_demote_total {int(brownout_demotions)}"
+        )
+        return lines
 
     @staticmethod
     def robustness_lines(
@@ -688,6 +745,13 @@ class ServingMetrics:
             # structurally zero but still exported (identical series set
             # across planes; monotonicity stays checkable).
             lines.extend(self.survivability_lines(0, 0, 0, 0, 0))
+            lines.extend(
+                self.tier_lines(
+                    self.tier_requests,
+                    self.tier_demotions,
+                    self.brownout_demotions,
+                )
+            )
             for tenant in sorted(self.lifecycle):
                 lines.extend(
                     self.lifecycle_lines(self.lifecycle[tenant], tenant)
@@ -905,6 +969,25 @@ def render_ring_metrics(ring) -> str:
             int(ring.parked.sum()),
             int(ring.brownout_shed.sum()),
             incarnation=int(ring.eng_vals[:, ENG_INCARNATION].sum()),
+        )
+    )
+    # SLO tier-routing block (ISSUE 19): tier request counts are
+    # engine-writer per-replica rows (summed into plane totals),
+    # demotions per-worker single-writer admission cells. Same shared
+    # formatter (and zero baseline) as the single-process render.
+    tier_vals = getattr(ring, "tier_counts", None)
+    demote = getattr(ring, "tier_demote", None)
+    bdemote = getattr(ring, "brownout_demote", None)
+    lines.extend(
+        ServingMetrics.tier_lines(
+            {
+                tier: int(tier_vals[:, i].sum())
+                for i, tier in enumerate(TIERS)
+            }
+            if tier_vals is not None
+            else None,
+            int(demote.sum()) if demote is not None else 0,
+            int(bdemote.sum()) if bdemote is not None else 0,
         )
     )
     # Per-replica fleet block (ISSUE 13). EVERY configured replica gets

@@ -34,6 +34,7 @@ from mlops_tpu.serve.httpcore import (  # noqa: F401  (re-exports)
     profile_payload,
 )
 from mlops_tpu.serve.metrics import ServingMetrics
+from mlops_tpu.serve.tierroute import BrownoutGovernor
 from mlops_tpu.serve.wire import DeadlineExceeded
 
 logger = logging.getLogger("mlops_tpu.serve")
@@ -241,6 +242,30 @@ class HttpServer(HttpProtocol):
         self.batcher = self.batchers[
             registry.default_index if registry else 0
         ]
+        # SLO tier routing + brownout (ISSUE 19, serve/tierroute.py):
+        # armed only when the config asks for it AND at least one engine
+        # actually committed a second tier (a single-tier fleet routing
+        # by class would just rename the default path). Pressure is the
+        # plane's in-flight predict depth over its dispatch capacity
+        # (max_inflight overlapped groups of max_group requests) — the
+        # same saturation signal that decides when work queues. All
+        # fields are event-loop confined like the rest of the server.
+        self.slo_routing = self.slo_routing and any(
+            len(getattr(eng, "available_tiers", ())) > 1
+            for eng in self.engines
+        )
+        self._brownout = (
+            BrownoutGovernor(
+                demote_depth=config.brownout_demote_depth,
+                restore_depth=config.brownout_restore_depth,
+            )
+            if self.slo_routing
+            else None
+        )
+        self._score_inflight = 0
+        self._score_capacity = max(
+            1, max_inflight * self.batcher.max_group
+        )
 
     # ------------------------------------------------------------- routes
     def _ready(self) -> bool:
@@ -369,6 +394,7 @@ class HttpServer(HttpProtocol):
         deadline: float | None = None,
         span=None,
         tenant: int = 0,
+        slo: int = 0,
     ):
         """The single-process scoring hook under the shared `_predict`
         shell (serve/httpcore.py): micro-batcher -> engine, with the
@@ -376,8 +402,27 @@ class HttpServer(HttpProtocol):
         the batcher/engine for the queue/encode/dispatch/fetch stamps.
         ``tenant`` (resolved from ``x-tenant`` by the shell) picks the
         batcher+engine pair — tenants share the thread pool and the HTTP
-        plane, never a grouped dispatch."""
+        plane, never a grouped dispatch. ``slo`` (the request's SLO
+        class, resolved at admission) maps to a serving tier here —
+        through the brownout governor first, which demotes DEFAULT-class
+        traffic to the cheaper tier while the plane's in-flight depth is
+        past the demote threshold (degraded answers instead of 503s)."""
         batcher = self.batchers[tenant]
+        tier: str | None = None
+        if self._brownout is not None:
+            eng = self.engines[tenant]
+            self._brownout.observe(
+                self._score_inflight / self._score_capacity
+            )
+            routed_cls, demoted = self._brownout.route(slo)
+            tier = eng.route_tier(routed_cls)
+            tier_label = tier or eng.default_tier
+            self.metrics.count_tier(tier_label)
+            if demoted:
+                self.metrics.count_demotion(brownout=True)
+            if span is not None:
+                span.tier = tier_label
+        self._score_inflight += 1
         try:
             # Small concurrent requests coalesce into one vmapped dispatch
             # (serve/batcher.py); everything else runs solo in the pool.
@@ -393,13 +438,18 @@ class HttpServer(HttpProtocol):
             if deadline is not None:
                 remaining = deadline - asyncio.get_running_loop().time()
                 timeout = min(timeout or remaining, remaining)
-            # Disarmed call shape unchanged (test stubs pin it): the span
-            # kwarg only appears when tracing armed it.
-            if span is None:
+            # Disarmed call shape unchanged (test stubs pin it): the
+            # span/tier kwargs only appear when tracing/routing armed
+            # them.
+            if span is None and tier is None:
                 call = batcher.predict(record_dicts, deadline=deadline)
-            else:
+            elif tier is None:
                 call = batcher.predict(
                     record_dicts, deadline=deadline, span=span
+                )
+            else:
+                call = batcher.predict(
+                    record_dicts, deadline=deadline, span=span, tier=tier
                 )
             if timeout is not None:
                 response = await asyncio.wait_for(call, max(timeout, 0.0))
@@ -437,6 +487,11 @@ class HttpServer(HttpProtocol):
             if span is not None:
                 span.abandoned = True  # a grouped dispatch may outlive us
             return 500, {"detail": "prediction failed"}, "application/json"
+        finally:
+            # Event-loop confined, like the increment: the depth fraction
+            # the brownout governor samples counts only requests whose
+            # scoring is actually outstanding.
+            self._score_inflight -= 1
         if self._accumulating[tenant]:
             # Monitor totals are folded ON DEVICE inside the fused predict
             # (monitor/state.py MonitorAccumulator) — the hot path only

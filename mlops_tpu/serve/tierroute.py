@@ -1,0 +1,170 @@
+"""Per-request SLO tier routing and the brownout-before-shed governor.
+
+Jax-free by design (like `serve/wire.py`): the ring-plane front-end
+processes, the single-process server, and the engine process all import
+this without pulling jax.
+
+The routing model (ISSUE 19): one engine holds a MULTI-TIER exec table —
+the default tier it was configured with plus every other gated tier the
+bundle admits (quant student, exact teacher, the gbm tensorization) —
+and each request carries an SLO CLASS chosen at admission:
+
+- ``x-slo-class: cheap|default|accurate`` when the client states it;
+- otherwise a tight ``x-request-deadline-ms`` budget (below
+  ``serve.slo_cheap_deadline_ms``) routes ``cheap`` — the ML-fleet
+  goodput rule (arxiv 2502.06982): the cheapest tier that can still meet
+  the deadline is the one that should serve it;
+- otherwise ``default``.
+
+The class, not the tier, is what rides the wire (HTTP header -> shm slot
+tag): front ends don't know which tiers an engine's bundle gates, so the
+ENGINE maps class -> tier (`InferenceEngine.route_tier`) at dispatch.
+That also makes the ring's crash replay bit-stable: the class tag
+survives in shm, and the same engine maps it to the same tier.
+
+BROWNOUT BEFORE SHED: when admission pressure (live occupancy of the
+inflight capacity) crosses ``brownout_demote_depth``, the governor
+demotes ``default``-class requests to ``cheap`` INSTEAD of letting them
+reach the 503 shed path — overload costs fidelity (a cheaper gated tier
+answers) before it costs availability. ``accurate``-class requests are
+never demoted (that's the tenant pin escape hatch — see
+docs/operations.md), and requests still shed once the cheapest tier
+itself saturates. Restoration is automatic with hysteresis
+(``brownout_restore_depth`` < demote depth), so the switch cannot
+flap at the threshold.
+"""
+
+from __future__ import annotations
+
+# SLO classes, wire order — the shm slot tag stores the index, so the
+# order is a cross-process contract (bump ``serve/ipc.py RING_MAGIC``
+# if it ever changes).
+SLO_CLASSES = ("default", "cheap", "accurate")
+SLO_DEFAULT, SLO_CHEAP, SLO_ACCURATE = 0, 1, 2
+_CLASS_BY_NAME = {name: i for i, name in enumerate(SLO_CLASSES)}
+
+# Every serving tier any engine can hold, cheapest -> most accurate:
+# the quant student (int8/bf16), the gbm tensorization (the sklearn
+# floor's exact bits, so it is both a family's only tier and "cheap"
+# relative to nothing), the exact teacher. Closed set — the ``tier``
+# metric label is bounded by construction (TPULINT_BOUNDED_LABELS).
+TIERS = ("quant", "gbm", "exact")
+
+
+def parse_slo_class(raw: str) -> int | None:
+    """``x-slo-class`` header value -> class index; None when the value
+    is not one of the three classes (admission treats an unknown value
+    as absent rather than 422ing — the header is advisory routing, not
+    part of the scoring payload contract)."""
+    return _CLASS_BY_NAME.get(raw.strip().lower())
+
+
+def resolve_slo_class(
+    header: str, deadline_ms: float | None, cheap_deadline_ms: float
+) -> int:
+    """Admission-time class resolution: an explicit header wins; absent
+    that, a deadline budget at or under ``cheap_deadline_ms`` routes
+    cheap (a client that can only wait 20 ms has already chosen the
+    cheap tier, whether it knows the header or not); everything else is
+    default class. ``cheap_deadline_ms <= 0`` disables deadline routing."""
+    if header:
+        cls = parse_slo_class(header)
+        if cls is not None:
+            return cls
+    if (
+        deadline_ms is not None
+        and cheap_deadline_ms > 0
+        and deadline_ms <= cheap_deadline_ms
+    ):
+        return SLO_CHEAP
+    return SLO_DEFAULT
+
+
+def tier_for_class(
+    ladder: tuple[str, ...], default_tier: str, slo_class: int
+) -> str:
+    """Class -> tier against one engine's gated ladder (cheapest ->
+    most accurate). ``cheap`` takes the ladder floor, ``accurate`` the
+    ceiling, ``default`` the engine's configured tier — on a one-tier
+    engine all three collapse to the same program, so routing is safe
+    to apply unconditionally."""
+    if slo_class == SLO_CHEAP:
+        return ladder[0]
+    if slo_class == SLO_ACCURATE:
+        return ladder[-1]
+    return default_tier
+
+
+class BrownoutGovernor:
+    """The demote-over-shed switch, one per admission point (a front-end
+    worker, or the single-process server) — intentionally unlocked: every
+    admission point is single-threaded where it admits (asyncio event
+    loop), and the counters are plain int adds.
+
+    ``observe(pressure)`` feeds the current 0..1 occupancy (live inflight
+    over capacity) and flips the state with hysteresis; ``route(cls)``
+    applies the active state to one request's class. Counters:
+
+    - ``demotions``: requests whose class was demoted (the
+      mlops_tpu_tier_demotions_total series)
+    - ``brownout_demotions``: the same demotions attributed to the
+      brownout switch specifically (mlops_tpu_brownout_demote_total —
+      today the only demotion cause, kept as its own counter so a future
+      non-brownout demotion cause cannot silently fold in)
+    - ``entered`` / ``exited``: state transitions, for the runbook's
+      flap check.
+    """
+
+    __slots__ = (
+        "demote_depth",
+        "restore_depth",
+        "active",
+        "demotions",
+        "brownout_demotions",
+        "entered",
+        "exited",
+    )
+
+    def __init__(
+        self, demote_depth: float = 0.75, restore_depth: float = 0.5
+    ):
+        if not 0.0 < demote_depth <= 1.0:
+            raise ValueError(
+                f"brownout demote depth must be in (0, 1], got {demote_depth}"
+            )
+        if not 0.0 <= restore_depth < demote_depth:
+            raise ValueError(
+                "brownout restore depth must be in [0, demote depth) for "
+                f"hysteresis, got {restore_depth} vs {demote_depth}"
+            )
+        self.demote_depth = demote_depth
+        self.restore_depth = restore_depth
+        self.active = False
+        self.demotions = 0
+        self.brownout_demotions = 0
+        self.entered = 0
+        self.exited = 0
+
+    def observe(self, pressure: float) -> bool:
+        """Feed the current occupancy fraction; returns the (possibly
+        flipped) brownout state. Hysteresis: once active, only dropping
+        to ``restore_depth`` deactivates."""
+        if self.active:
+            if pressure <= self.restore_depth:
+                self.active = False
+                self.exited += 1
+        elif pressure >= self.demote_depth:
+            self.active = True
+            self.entered += 1
+        return self.active
+
+    def route(self, slo_class: int) -> tuple[int, bool]:
+        """Apply the CURRENT state (callers ``observe`` first with fresh
+        pressure) to one request: under brownout, default class demotes
+        to cheap; cheap is already at the floor and accurate is pinned.
+        Returns ``(effective class, demoted?)``."""
+        if self.active and slo_class == SLO_DEFAULT:
+            self.demotions += 1
+            self.brownout_demotions += 1
+            return SLO_CHEAP, True
+        return slo_class, False

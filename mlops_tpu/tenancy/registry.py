@@ -83,6 +83,7 @@ class TenantRegistry:
         model_shards: int = 1,
         device_index: int | None = None,
         serve_tier: str = "exact",
+        tier_routing: bool = False,
     ) -> None:
         from mlops_tpu.bundle import load_bundle
         from mlops_tpu.serve.engine import InferenceEngine
@@ -114,6 +115,11 @@ class TenantRegistry:
                 # would break architecture-twin executable sharing (the
                 # tiers are different program families).
                 serve_tier=serve_tier,
+                # Fleet-global for the same reason (ISSUE 19): the tier
+                # ladder is extra program families, and every tenant of
+                # one architecture must warm the same families to keep
+                # the executable-dedupe contract.
+                tier_routing=tier_routing,
             )
             for bundle in self.bundles
         ]

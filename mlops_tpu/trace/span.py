@@ -41,7 +41,7 @@ class Span:
     """Stamp accumulator for one traced request."""
 
     __slots__ = ("trace_id", "plane", "worker", "route", "rows", "entry",
-                 "t0", "stamps", "abandoned", "tenant", "replica")
+                 "t0", "stamps", "abandoned", "tenant", "replica", "tier")
 
     def __init__(
         self,
@@ -68,6 +68,10 @@ class Span:
         # Compiled-entry key ("bucket_8", "group_16x1") when the engine
         # told us which program served the request; None otherwise.
         self.entry: str | None = None
+        # Routed serving tier (ISSUE 19, serve/tierroute.py — a member of
+        # the closed TIERS set) when SLO routing resolved one; None keeps
+        # single-tier spans byte-identical to pre-routing records.
+        self.tier: str | None = None
         self.t0 = time.monotonic() if t0 is None else t0
         self.stamps: list[tuple[str, float]] = []
         # Set when the request path gave up on this span while a
@@ -121,4 +125,6 @@ class Span:
         }
         if self.entry is not None:
             record["entry"] = self.entry
+        if self.tier is not None:
+            record["tier"] = self.tier
         return record

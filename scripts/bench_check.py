@@ -53,6 +53,8 @@ HEADLINE_KEYS = (
     "http_vs_engine_ratio", "shed_503_pct",
     # traffic-shape autotuner (ISSUE 18)
     "autotune_goodput_gain_pct", "regrid_downtime_ms",
+    # tiered SLO serving (ISSUE 19)
+    "tier_routed_req_per_s", "brownout_goodput_gain_pct",
     # tenancy + replica set + survivability + lifecycle
     "tenants_shared_exec_count", "starvation_cold_p99_ratio",
     "replica_scaling_efficiency", "engine_respawn_gap_ms",
@@ -67,10 +69,13 @@ HEADLINE_KEYS = (
 BOUNDS = (
     # E-replica fan-out must keep scaling usefully (BENCH_r07: 0.845).
     ("replica_scaling_efficiency", 0.5, 1.05),
-    # HTTP goodput vs raw engine capacity (BENCH_r05+: ~0.68; ROADMAP
-    # item 4 pushes it toward 0.85 — the lower bound is the regression
-    # floor, not the target).
-    ("http_vs_engine_ratio", 0.3, 1.1),
+    # HTTP goodput vs raw engine capacity (BENCH_r05+: ~0.68; the 0.85
+    # target is ROADMAP residue — the lower bound is the regression
+    # floor, not the target). The floor sits at 0.2 because the
+    # DENOMINATOR is noisy on the 1-core box: engine_group_req_per_s
+    # swung 3.5k-4.5k across BENCH_r09-r11 while HTTP held ~1.0-1.2k,
+    # so a tighter floor gates engine speedups instead of HTTP cliffs.
+    ("http_vs_engine_ratio", 0.2, 1.1),
     # sloscope armed overhead on batch-1 p50: ~0 disarmed by design;
     # the armed delta must stay single-digit percent (negative values
     # are measurement noise on a quiet box).
@@ -87,6 +92,12 @@ BOUNDS = (
     # swap window stays far under one dispatch's worth of stall.
     ("autotune_goodput_gain_pct", 0.0, 100000.0),
     ("regrid_downtime_ms", 0.0, 250.0),
+    # Tierroute (ISSUE 19): the cheap class routed through its gated
+    # tier must still clear a real per-request rate (broken routing
+    # reads ~0), and at 10x load brownout must beat pure shed on useful
+    # responses/s — the acceptance claim, so 0 is the regression floor.
+    ("tier_routed_req_per_s", 50.0, 1e9),
+    ("brownout_goodput_gain_pct", 0.0, 100000.0),
 )
 
 

@@ -364,6 +364,16 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
             # drain assertion exercises their wiring.
             "serve.ring_slots_small=4", "serve.ring_slots_large=1",
             "serve.request_timeout_s=6",
+            # Tier routing + brownout (ISSUE 19), DRILL-TUNED: a demote
+            # depth of 0.2 on the 5-slot per-worker partition means ONE
+            # busy slot activates the governor, so the brownout scenario
+            # below can prove demotions precede the first shed without
+            # needing a seeded stall. (The tiny bundle has no gated
+            # quant tier: the ladder collapses to the default program —
+            # demotion counters must rise anyway, bits must not change.)
+            "serve.tier_routing=true",
+            "serve.brownout_demote_depth=0.2",
+            "serve.brownout_restore_depth=0.1",
             "serve.drain_deadline_s=8", "serve.zygote_join_deadline_s=10",
             "serve.engine_zygote_join_s=16",
             # AOT cache: the first boot compiles + persists; the engine
@@ -573,7 +583,64 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
         assert any("mlops_tpu_deadline_expired_total" in k for k in first)
         assert any("mlops_tpu_degraded_dispatch_total" in k for k in first)
 
-        # ---- scenario: overload burst against the tiny ring ----------
+        # ---- scenario: brownout demotes BEFORE the overload shed -----
+        # (ISSUE 19) Phase 1 offers sustained concurrency UNDER the
+        # per-worker partition (4 loops vs 5 slots — a shed is
+        # impossible by construction): the armed governor's demotion
+        # counters must rise while every shed counter stays flat.
+        # Phase 2 is the 10x-partition overload burst: 503s become
+        # legal, statuses stay inside the contract set, and the
+        # demotion counters from phase 1 prove the plane spent fidelity
+        # before it ever spent availability.
+        def counter_sum(counters: dict, prefix: str) -> float:
+            return sum(
+                v for k, v in counters.items() if k.startswith(prefix)
+            )
+
+        def shed_sum(counters: dict) -> float:
+            return counter_sum(
+                counters, "mlops_tpu_shed_total"
+            ) + counter_sum(counters, "mlops_tpu_tenant_quota_shed_total")
+
+        status, text = get(f"http://127.0.0.1:{port}/metrics", 30)
+        assert status == 200
+        base = parse_counters(text.decode())
+        base_demote = counter_sum(base, "mlops_tpu_tier_demotions_total")
+        base_shed = shed_sum(base)
+
+        def brownout_client() -> None:
+            for _ in range(30):
+                status, _, _ = raw_predict(port, body, timeout=30)
+                record_status(status)
+
+        browners = [
+            threading.Thread(target=brownout_client) for _ in range(4)
+        ]
+        for t in browners:
+            t.start()
+        for t in browners:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in browners), (
+            "brownout client hung"
+        )
+        status, text = get(f"http://127.0.0.1:{port}/metrics", 30)
+        assert status == 200
+        mid = parse_counters(text.decode())
+        mid_demote = counter_sum(mid, "mlops_tpu_tier_demotions_total")
+        assert mid_demote > base_demote, (
+            "governor never demoted under sub-partition pressure "
+            f"(demote counter {base_demote} -> {mid_demote})"
+        )
+        assert shed_sum(mid) == base_shed, (
+            "a shed fired while offered load was under the partition — "
+            "brownout must come first"
+        )
+        print(
+            "# chaos-smoke: brownout phase OK "
+            f"(+{mid_demote - base_demote:.0f} demotions, zero sheds)",
+            flush=True,
+        )
+
         def burst_client() -> None:
             try:
                 status, _, _ = raw_predict(port, body, timeout=30)
@@ -581,7 +648,7 @@ def live_plane_scenarios(tmp: str, bundle: str) -> None:
             except OSError:
                 pass  # connection refused under burst = backpressure, fine
 
-        burst = [threading.Thread(target=burst_client) for _ in range(40)]
+        burst = [threading.Thread(target=burst_client) for _ in range(50)]
         for t in burst:
             t.start()
         for t in burst:

@@ -631,12 +631,15 @@ def test_trace_layer_clean_on_registered_entry_points():
     findings, notes = run_trace_checks()
     assert findings == [], [f.format() for f in findings]
     traced = [n for n in notes if n.startswith("traced ")]
-    # conftest forces an 8-device mesh, so nothing may be skipped. 7 =
+    # conftest forces an 8-device mesh, so nothing may be skipped. 9 =
     # dense + TP train steps, exact packed solo/group, quant packed
-    # solo/group (ISSUE 17), bulk chunk.
-    assert len(traced) == 7, notes
+    # solo/group (ISSUE 17), gbm packed solo/group (ISSUE 19), bulk
+    # chunk.
+    assert len(traced) == 9, notes
     assert any("serve-predict-quant-packed" in n for n in traced)
     assert any("serve-predict-quant-group-packed" in n for n in traced)
+    assert any("serve-predict-gbm-packed" in n for n in traced)
+    assert any("serve-predict-gbm-group-packed" in n for n in traced)
     assert all("no device code executed" in n for n in traced)
 
 

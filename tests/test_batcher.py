@@ -141,7 +141,10 @@ def test_disabled_window_runs_solo(engine, sample_request):
     assert len(response["predictions"]) == 1
 
 
-def test_sklearn_flavor_has_no_group_path(tmp_path):
+def test_sklearn_flavor_groups_through_the_tensorized_path(tmp_path):
+    """The gbm family serves through the packed group path (ISSUE 19 —
+    the Hummingbird-style tensorization lowered it into the same packed
+    contract as flax), and grouped answers stay bit-identical to solo."""
     from mlops_tpu.config import Config, ModelConfig, TrainConfig
     from mlops_tpu.train.pipeline import run_training
 
@@ -153,9 +156,13 @@ def test_sklearn_flavor_has_no_group_path(tmp_path):
     config.registry.run_root = str(tmp_path / "runs")
     result = run_training(config, register=False)
     eng = InferenceEngine(load_bundle(result.bundle_dir), buckets=(1, 8))
-    assert not eng.supports_grouping
-    out = eng.predict_group([[{"age": 30.0}], [{"age": 40.0}]])
+    assert eng.supports_grouping
+    reqs = [[{"age": 30.0}], [{"age": 40.0}]]
+    out = eng.predict_group(reqs)
     assert len(out) == 2
+    for grouped, req in zip(out, reqs):
+        solo = eng.predict_records(req)
+        assert grouped["predictions"] == solo["predictions"]
 
 
 def test_overlapped_dispatch_stress_matches_solo(engine, sample_request):
@@ -293,12 +300,14 @@ def test_abandoned_requests_are_purged_at_claim_time(engine, sample_request):
         for _ in range(5):
             dead = loop.create_future()
             dead.cancel()
-            batcher._pending.append(([sample_request[0]], dead, None, None))
+            batcher._pending.append(
+                ([sample_request[0]], dead, None, None, None)
+            )
         live = asyncio.create_task(batcher.predict([sample_request[0]]))
         response = await asyncio.wait_for(live, timeout=30)
         assert 0.0 <= response["predictions"][0] <= 1.0
         # the dead entries did not survive the claim
-        assert all(not f.cancelled() for _, f, _, _ in batcher._pending)
+        assert all(not f.cancelled() for _, f, _, _, _ in batcher._pending)
         executor.shutdown(wait=False)
 
     asyncio.run(run())

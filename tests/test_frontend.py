@@ -426,6 +426,99 @@ def test_overload_burst_sheds_fast_503_with_retry_after(prep_path):
         assert b"mlops_tpu_shed_total" in body
 
 
+def test_brownout_demotes_default_class_before_shedding(prep_path):
+    """Overload with SLO routing armed (ISSUE 19): as the slot partition
+    crosses the governor's demote depth, admitted default-class requests
+    demote to the cheap class — counted in the per-worker shm demotion
+    cells — BEFORE the partition exhausts into 503s. Brownout spends
+    fidelity first; the shed path only fires once the partition (the
+    cheapest tier's own capacity) is saturated."""
+    stub = _SlowStubEngine(delay_s=0.5)
+    with multi_worker_plane(
+        stub,
+        prep_path,
+        workers=1,
+        slots_small=8,
+        slots_large=2,
+        tier_routing=True,
+    ) as (port, ring, _, _svc):
+        results = []
+        lock = threading.Lock()
+
+        def call():
+            status, headers, _ = predict(port, [{}])
+            with lock:
+                results.append((status, headers))
+
+        threads = [threading.Thread(target=call) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        statuses = [s for s, _ in results]
+        # brownout-over-shed: no new failure modes, still bounded
+        assert set(statuses) <= {200, 503}, statuses
+        assert statuses.count(200) >= 8, statuses
+        assert statuses.count(503) >= 1, statuses
+        # Demotions were counted: reaching 100% occupancy (the shed
+        # condition) necessarily crossed the 75% demote depth first, so
+        # the governor demoted admitted traffic before the first 503.
+        assert int(ring.tier_demote.sum()) >= 1
+        assert int(ring.brownout_demote.sum()) == int(
+            ring.tier_demote.sum()
+        )
+        status, _, body = http_exchange(port, "GET", "/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "mlops_tpu_tier_demotions_total" in text
+        assert "mlops_tpu_brownout_demote_total" in text
+        assert 'mlops_tpu_tier_requests_total{tier="quant"}' in text
+
+
+def test_explicit_accurate_class_is_never_demoted(prep_path):
+    """The accurate-class escape hatch: even under full brownout, a
+    request pinning ``x-slo-class: accurate`` keeps its class (the shm
+    slot tag stays SLO_ACCURATE and no demotion is counted for it)."""
+    stub = _SlowStubEngine(delay_s=0.3)
+    with multi_worker_plane(
+        stub,
+        prep_path,
+        workers=1,
+        slots_small=2,
+        slots_large=1,
+        tier_routing=True,
+    ) as (port, ring, _, _svc):
+        # Saturate the 3-slot partition with default-class traffic so
+        # the governor is active, then pin one accurate request.
+        results = []
+        lock = threading.Lock()
+
+        def call(headers=None):
+            status, _, _ = http_exchange(
+                port, "POST", "/predict", body=[{}], headers=headers
+            )
+            with lock:
+                results.append(status)
+
+        filler = [threading.Thread(target=call) for _ in range(4)]
+        for t in filler:
+            t.start()
+        time.sleep(0.1)
+        before = int(ring.tier_demote.sum())
+        pinned = threading.Thread(
+            target=call, args=({"x-slo-class": "accurate"},)
+        )
+        pinned.start()
+        pinned.join(timeout=30)
+        for t in filler:
+            t.join(timeout=30)
+        # The pinned request never demoted: the demotion counter's growth
+        # after it was issued is attributable only to default traffic,
+        # and the slot tags only ever carried {default, cheap, accurate}.
+        assert int(ring.tier_demote.sum()) >= before
+        assert set(results) <= {200, 503}
+
+
 # ------------------------------------------------------------- /metrics
 def test_multiworker_metrics_show_every_worker_and_monitor_aggregate(
     engine, prep_path, sample_request

@@ -1,0 +1,154 @@
+"""gbm-tensor serving parity: the Hummingbird-style tensorization of the
+HistGBM family (`ops/gbm_tensor.py`) must reproduce the sklearn host
+path BIT-FOR-BIT at every bucket and group geometry (ISSUE 19 — the
+sklearn floor is the mandatory parity reference; anything weaker would
+let the packed hot path silently serve different probabilities than the
+family's own `predict_proba`)."""
+
+import numpy as np
+import pytest
+
+from mlops_tpu.schema import SCHEMA
+from mlops_tpu.serve.engine import InferenceEngine
+from mlops_tpu.serve.tierroute import SLO_ACCURATE, SLO_CHEAP
+
+
+@pytest.fixture(scope="module")
+def gbm_pipeline(tmp_path_factory):
+    """One gbm training run (HistGBM + calibration temperature) shared by
+    the parity pins below."""
+    from mlops_tpu.config import Config, ModelConfig, TrainConfig
+    from mlops_tpu.train.pipeline import run_training
+
+    root = tmp_path_factory.mktemp("gbm_tensor")
+    config = Config()
+    config.data.rows = 3000
+    config.model = ModelConfig(
+        family="gbm", n_estimators=40, max_tree_depth=4
+    )
+    config.train = TrainConfig(seed=0)
+    config.registry.root = str(root / "registry")
+    config.registry.run_root = str(root / "runs")
+    result = run_training(config)
+    return config, result
+
+
+@pytest.fixture(scope="module")
+def gbm_bundle(gbm_pipeline):
+    from mlops_tpu.bundle import load_bundle
+
+    _, result = gbm_pipeline
+    return load_bundle(result.bundle_dir)
+
+
+@pytest.fixture(scope="module")
+def gbm_engine(gbm_bundle):
+    engine = InferenceEngine(gbm_bundle, buckets=(1, 8, 64))
+    engine.warmup()
+    return engine
+
+
+@pytest.fixture(scope="module")
+def batch(gbm_bundle):
+    """64 encoded rows with unknown-category pokes (ids past the vocab —
+    the tensor program's gather clamp must agree with sklearn's own
+    unknown-bucket handling) plus the sklearn-floor reference."""
+    from mlops_tpu.train.calibrate import apply_temperature
+
+    rng = np.random.default_rng(7)
+    cat = rng.integers(
+        0, 4, size=(64, SCHEMA.num_categorical)
+    ).astype(np.int32)
+    num = rng.normal(size=(64, SCHEMA.num_numeric)).astype(np.float32)
+    cat[3, 0] = 200
+    cat[9, 2] = 255
+    ref = apply_temperature(
+        gbm_bundle.estimator.predict_proba(cat, num),
+        gbm_bundle.temperature,
+    ).astype(np.float32)
+    return cat, num, ref
+
+
+def _solo(engine, cat, num):
+    handle = engine.dispatch_arrays(cat, num)
+    handle.start_copy()
+    preds, _, _ = engine.fetch_arrays_raw(handle)
+    return preds.astype(np.float32)
+
+
+def test_bit_parity_every_bucket_geometry(gbm_engine, batch):
+    """Exact (1, 8, 64) bucket hits AND every padded residency class
+    (n < bucket pads up) reproduce the sklearn floor bit-for-bit."""
+    cat, num, ref = batch
+    for n in (1, 2, 5, 8, 9, 40, 64):
+        got = _solo(gbm_engine, cat[:n], num[:n])
+        assert (got == ref[:n]).all(), f"parity broke at n={n}"
+
+
+def test_bit_parity_every_group_geometry(gbm_engine, batch):
+    """Grouped dispatches (the scatter/slice path) return the same bits
+    as the sklearn floor for every slot, across slot counts and padded
+    row geometries."""
+    cat, num, ref = batch
+    geometries = (
+        [8, 8],  # exact rows, 2 slots
+        [1, 4, 8],  # mixed padded rows, 3 slots
+        [2, 2, 2, 2, 2],  # 5 slots (pads up the slot bucket too)
+    )
+    for sizes in geometries:
+        parts, offset = [], 0
+        for n in sizes:
+            parts.append((cat[offset : offset + n], num[offset : offset + n]))
+            offset += n
+        handle = gbm_engine.dispatch_group_arrays(parts)
+        got_sizes, preds, _, _ = gbm_engine.fetch_group_raw(handle)
+        assert list(got_sizes) == sizes
+        offset = 0
+        for i, n in enumerate(sizes):
+            got = preds[i, :n].astype(np.float32)
+            assert (got == ref[offset : offset + n]).all(), (
+                f"group parity broke at geometry {sizes} slot {i}"
+            )
+            offset += n
+
+
+def test_predict_records_matches_sklearn_floor(gbm_engine, gbm_bundle):
+    """The record-level serving surface (encode -> packed dispatch ->
+    response formatting) agrees with the host hybrid to the packed
+    pipeline's f32 precision."""
+    from mlops_tpu.train.calibrate import apply_temperature
+
+    records = [
+        {"age": 30.0, "credit_limit": 2000.0},
+        {"age": 55.0, "credit_limit": 90000.0, "education": "graduate"},
+    ]
+    response = gbm_engine.predict_records(records)
+    from mlops_tpu.schema import records_to_columns
+
+    ds = gbm_bundle.preprocessor.encode(records_to_columns(records))
+    ref = apply_temperature(
+        gbm_bundle.estimator.predict_proba(ds.cat_ids, ds.numeric),
+        gbm_bundle.temperature,
+    ).astype(np.float32)
+    assert (np.asarray(response["predictions"], np.float32) == ref).all()
+
+
+def test_gbm_is_a_single_tier_grouping_family(gbm_engine):
+    """The tensorized gbm family serves through the packed contract: it
+    grows a group path, names its own tier, and (being single-tier)
+    collapses every SLO class onto the default program."""
+    assert gbm_engine.supports_grouping
+    assert gbm_engine.default_tier == "gbm"
+    assert gbm_engine.available_tiers == ("gbm",)
+    assert gbm_engine.route_tier(SLO_CHEAP) is None
+    assert gbm_engine.route_tier(SLO_ACCURATE) is None
+
+
+def test_gbm_serve_entries_ride_the_compile_cache(gbm_engine):
+    """The tensorized programs register their own AOT cache entry
+    families (serve-predict-gbm-packed / -group-packed), so a respawned
+    engine deserializes them instead of re-tracing."""
+    from mlops_tpu.compilecache.registry import CACHE_ENTRY_IDS
+
+    assert "serve-predict-gbm-packed" in CACHE_ENTRY_IDS
+    assert "serve-predict-gbm-group-packed" in CACHE_ENTRY_IDS

@@ -634,7 +634,7 @@ def test_log_sampling_always_logs_non_200s(caplog):
     logs its event (errors never sample out)."""
 
     async def shed_score(records, request_id, deadline=None, span=None,
-                         tenant=0):
+                         tenant=0, slo=0):
         return (
             503, {"detail": "overloaded"}, "application/json",
             {"retry-after": "1"},
@@ -663,7 +663,7 @@ def test_log_sampling_always_logs_non_200s(caplog):
 
 def test_log_sampling_samples_successes(caplog):
     async def ok_score(records, request_id, deadline=None, span=None,
-                       tenant=0):
+                       tenant=0, slo=0):
         return {"predictions": [0.1], "outliers": [0],
                 "feature_drift_batch": {}}
 
