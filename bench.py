@@ -2279,28 +2279,38 @@ def _lifecycle_stage(engine, bundle, record) -> dict:
 
 
 def _analysis_stage() -> dict:
-    """Wall time of the full static gate (Layers 1+3+4 plus the
+    """Wall time of the full static gate (Layers 1+3+4+5 plus the
     suppression audit; ``--no-trace`` keeps device work out of it). The
     analyzer is framework code too: a Layer-4 pass that quietly goes
     quadratic on the project graph is a CI-latency regression, and this
     key makes it visible in the BENCH_* trajectory like any other
-    number."""
+    number. The strict run's per-layer timings line is parsed into
+    ``analysis_<layer>_s`` satellites, so a single layer regressing
+    (layer5's call-graph fixpoint, the audit's project re-runs) is
+    attributable instead of smeared across the total."""
+    import re as _re
     import subprocess
 
     repo = os.path.dirname(os.path.abspath(__file__))
     start = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, "-m", "mlops_tpu", "analyze", "--no-trace",
-         "--strict", "--concurrency", "--contracts", "--fail-stale",
-         os.path.join(repo, "mlops_tpu")],
+         "--strict", "--concurrency", "--contracts", "--async",
+         "--fail-stale", os.path.join(repo, "mlops_tpu")],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=600,
         cwd=repo,
     )
     out = {"analysis_wall_s": round(time.perf_counter() - start, 2)}
+    stdout = proc.stdout.decode(errors="replace")
+    timings = _re.search(r"layer timings: (.+)", stdout)
+    if timings:
+        for name, spent in _re.findall(
+            r"(\w+) ([0-9.]+)s", timings.group(1)
+        ):
+            out[f"analysis_{name}_s"] = float(spent)
     if proc.returncode != 0:
         out["analysis_gate_error"] = (
-            f"exit {proc.returncode}: "
-            + proc.stdout.decode(errors="replace").strip()[-300:]
+            f"exit {proc.returncode}: " + stdout.strip()[-300:]
         )
     return out
 

@@ -1,4 +1,4 @@
-"""tpulint — four-layer static analysis for the TPU hot paths.
+"""tpulint — five-layer static analysis for the TPU hot paths.
 
 The production path (train -> register -> serve -> monitor) only hits its
 latency/goodput targets while the compiled hot paths STAY compiled: one
@@ -35,12 +35,26 @@ package keeps the codebase honest on every PR:
   coverage, config knobs that validate but are never read (the PR 13
   ``replica_affinity_slack`` class), and fault points without a fire
   site. Pure ``ast``, opt-in via ``analyze --contracts`` (CI runs it).
+- **Layer 5** (`asyncdiscipline`): async/event-loop discipline over the
+  serve plane, analyzed project-wide like Layer 4 — a call graph seeds
+  event-loop confinement from ``async def`` bodies, loop-callback
+  registrations, and the declared ``TPULINT_LOOP_CONFINED`` manifest,
+  propagates it through sync helpers reachable only from confined
+  contexts, then gates blocking calls on the loop (TPU601, sharing Layer
+  3's blocking table via `blocking`), fire-and-forget tasks (TPU602),
+  cross-thread writes to loop-confined state (TPU603), and ``await``
+  under a sync mutex (TPU604). Pure ``ast``, opt-in via ``analyze
+  --async`` (CI runs it). The RUNTIME half (`loopcheck`) wraps the
+  running loop's callback execution in tests and production: per-callback
+  wall time with attribution, a max-lag assert, and the
+  ``mlops_tpu_event_loop_lag_ms`` gauge.
 
 The suppression ledger stays honest via ``analyze --list-suppressions``
 (every ``# tpulint: disable`` with live/stale status) and ``--fail-stale``
 (stale ones gate as TPU400).
 
-CLI: ``mlops-tpu analyze [--strict] [--concurrency] [--contracts] [paths ...]``
+CLI: ``mlops-tpu analyze [--strict] [--concurrency] [--contracts]
+[--async] [paths ...]``
 (`analysis/cli.py`); CI runs it as a gate before pytest. Suppress a
 finding inline with ``# tpulint: disable=TPU101`` (see
 `docs/static-analysis.md`).
@@ -60,13 +74,23 @@ from mlops_tpu.analysis.contracts import (
     analyze_contracts_paths,
     analyze_contracts_source,
 )
+from mlops_tpu.analysis.asyncdiscipline import (
+    ASYNC_RULES,
+    analyze_async_paths,
+    analyze_async_project,
+    analyze_async_source,
+)
 
 __all__ = [
+    "ASYNC_RULES",
     "CONCURRENCY_RULES",
     "CONTRACT_RULES",
     "Finding",
     "RULES",
     "Severity",
+    "analyze_async_paths",
+    "analyze_async_project",
+    "analyze_async_source",
     "analyze_concurrency_paths",
     "analyze_concurrency_source",
     "analyze_contracts_paths",

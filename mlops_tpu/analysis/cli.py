@@ -81,6 +81,12 @@ def _run_analyze(args: argparse.Namespace) -> int:
         findings.extend(
             timed("layer4", lambda: analyze_contracts_paths(paths))
         )
+    if getattr(args, "async_rules", False):
+        from mlops_tpu.analysis.asyncdiscipline import analyze_async_paths
+
+        findings.extend(
+            timed("layer5", lambda: analyze_async_paths(paths))
+        )
     if getattr(args, "fail_stale", False):
         from mlops_tpu.analysis.suppressions import stale_findings
 

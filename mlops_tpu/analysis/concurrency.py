@@ -64,6 +64,7 @@ import dataclasses
 from pathlib import Path
 from typing import Iterable
 
+from mlops_tpu.analysis import blocking
 from mlops_tpu.analysis.findings import (
     Finding,
     Severity,
@@ -125,55 +126,16 @@ CONCURRENCY_RULES: dict[str, RuleInfo] = {
 }
 
 # ---------------------------------------------------------- blocking model
-# Method names that block (or can block) the calling thread. ``join`` is
-# special-cased below to skip string / path-module receivers.
-_BLOCKING_METHODS = {
-    "block_until_ready",
-    "item",
-    "tolist",
-    "compile",
-    "join",
-    "result",
-    "wait",
-    "put",
-    "read_text",
-    "read_bytes",
-    "write_text",
-    "write_bytes",
-    "unlink",
-    "mkdir",
-}
-# Dotted-name calls that block or materialize device values on the host.
-_BLOCKING_CALLS = {
-    "np.asarray",
-    "np.array",
-    "numpy.asarray",
-    "numpy.array",
-    "onp.asarray",
-    "onp.array",
-    "jax.device_get",
-    "device_get",
-    "jax.block_until_ready",
-    "time.sleep",
-    "subprocess.run",
-    "os.replace",
-    "open",
-}
-# ``.join()`` receivers that are string/path helpers, not threads/queues.
-_JOIN_SAFE_ROOTS = {"os", "posixpath", "ntpath", "str"}
-# ``.compile()`` receivers that are regex/builtins, not XLA lowerings.
-_COMPILE_SAFE_ROOTS = {"re"}
-
-
-def _dotted(node: ast.AST) -> str | None:
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+# The blocking-call table is SHARED with Layer 5 (asyncdiscipline.py):
+# one classifier decides "does this call block?" for both the held-mutex
+# walk (TPU403) and the event-loop-confinement walk (TPU601), so the two
+# layers can never disagree about what a stall is. The table lives in
+# blocking.py; the historical module-private names stay importable here.
+_BLOCKING_METHODS = blocking.BLOCKING_METHODS
+_BLOCKING_CALLS = blocking.BLOCKING_CALLS
+_JOIN_SAFE_ROOTS = blocking.JOIN_SAFE_ROOTS
+_COMPILE_SAFE_ROOTS = blocking.COMPILE_SAFE_ROOTS
+_dotted = blocking.dotted
 
 
 @dataclasses.dataclass
@@ -492,44 +454,28 @@ class _Collector:
         self, call: ast.Call, held_mutexes: frozenset[str]
     ) -> None:
         held = ", ".join(sorted(held_mutexes))
-        func = call.func
-        if isinstance(func, ast.Attribute) and func.attr in _BLOCKING_METHODS:
-            receiver = _dotted(func.value) or ""
-            root = receiver.split(".")[0]
-            if func.attr == "join" and (
-                isinstance(func.value, ast.Constant)
-                or root in _JOIN_SAFE_ROOTS
-            ):
-                return
-            if func.attr == "compile" and root in _COMPILE_SAFE_ROOTS:
-                return
+        label = blocking.classify_blocking(call)
+        if label is None:
+            return
+        if label == ".get() (blocking queue read)":
             self._flag(
                 "TPU403",
                 call,
-                f".{func.attr}() while holding {held} blocks every thread "
+                f"{label} while holding {held}",
+            )
+        elif label.startswith("."):
+            self._flag(
+                "TPU403",
+                call,
+                f"{label} while holding {held} blocks every thread "
                 "queued on the lock — move the blocking work outside the "
                 "critical section",
             )
-            return
-        if (
-            isinstance(func, ast.Attribute)
-            and func.attr == "get"
-            and not call.args
-            and not call.keywords
-        ):
-            # zero-arg .get(): a blocking queue read (dict.get takes a key)
+        else:
             self._flag(
                 "TPU403",
                 call,
-                f".get() (blocking queue read) while holding {held}",
-            )
-            return
-        name = _dotted(func) or ""
-        if name in _BLOCKING_CALLS:
-            self._flag(
-                "TPU403",
-                call,
-                f"{name}() while holding {held} blocks every thread queued "
+                f"{label} while holding {held} blocks every thread queued "
                 "on the lock (device fetch / host materialization / I/O "
                 "belongs outside the critical section)",
             )

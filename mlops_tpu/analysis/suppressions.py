@@ -140,16 +140,20 @@ def audit_file(
 
 
 def audit_paths(paths: Iterable[str | Path]) -> list[Suppression]:
+    from mlops_tpu.analysis.asyncdiscipline import analyze_async_paths
     from mlops_tpu.analysis.contracts import analyze_contracts_paths
 
-    # Layer-4 findings are project-wide (cross-file manifests), so one
-    # suppression-off pass up front, sliced per file below — a disable
-    # covering a TPU501-504 finding counts as live whether or not the
-    # current invocation passed --contracts.
+    # Layer-4 and Layer-5 findings are project-wide (cross-file
+    # manifests / call graph), so one suppression-off pass each up
+    # front, sliced per file below — a disable covering a TPU501-504 or
+    # TPU601-604 finding counts as live whether or not the current
+    # invocation passed --contracts/--async.
     paths = list(paths)
-    contract_by_file: dict[str, list[Finding]] = {}
-    for finding in analyze_contracts_paths(paths, keep_suppressed=True):
-        contract_by_file.setdefault(finding.path, []).append(finding)
+    project_by_file: dict[str, list[Finding]] = {}
+    for finding in analyze_contracts_paths(
+        paths, keep_suppressed=True
+    ) + analyze_async_paths(paths, keep_suppressed=True):
+        project_by_file.setdefault(finding.path, []).append(finding)
     out: list[Suppression] = []
     for file, rel in iter_py_files(paths):
         out.extend(
@@ -157,7 +161,7 @@ def audit_paths(paths: Iterable[str | Path]) -> list[Suppression]:
                 file.read_text(encoding="utf-8"),
                 file.as_posix(),
                 rel_path=rel.as_posix(),
-                extra_findings=contract_by_file.get(file.as_posix(), ()),
+                extra_findings=project_by_file.get(file.as_posix(), ()),
             )
         )
     return out

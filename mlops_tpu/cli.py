@@ -175,6 +175,15 @@ def build_parser() -> argparse.ArgumentParser:
         "no JAX import)",
     )
     analyze.add_argument(
+        "--async",
+        action="store_true",
+        dest="async_rules",
+        help="also run the Layer-5 async/event-loop discipline rules "
+        "(blocking call in a loop-confined context, fire-and-forget "
+        "tasks, cross-thread writes to loop state, await under a sync "
+        "mutex — TPU601-604; pure AST, no JAX import)",
+    )
+    analyze.add_argument(
         "--list-suppressions",
         action="store_true",
         help="report every `# tpulint: disable` in the tree with file:line,"

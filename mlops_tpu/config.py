@@ -358,6 +358,14 @@ class ServeConfig:
     # (sheds, 504s, 500s) are ALWAYS logged regardless of the rate —
     # errors must never be sampled out of the evidence stream. 1.0
     # (default) = log everything, the pre-sampling behavior
+    loop_lag_monitor: bool = False  # arm the LoopLagSanitizer
+    # (analysis/loopcheck.py) on each serving event loop: every callback
+    # is timed and the worst window lands in the
+    # mlops_tpu_event_loop_lag_ms gauge. Off by default — the wrapper
+    # adds one closure per scheduled callback to the hot path
+    loop_lag_slow_ms: float = 100.0  # callbacks at or above this are
+    # recorded with attribution (coroutine qualname) for the sanitizer's
+    # slow-callback report; only meaningful with loop_lag_monitor=true
 
     def validate(self) -> "ServeConfig":
         """Reject inconsistent worker/ring geometries at startup.
@@ -491,6 +499,12 @@ class ServeConfig:
                 f"serve.log_sample_rate={self.log_sample_rate} must be in "
                 "(0, 1] (0 would silence even the always-logged errors' "
                 "InferenceData events; sample DOWN, never off)"
+            )
+        if self.loop_lag_slow_ms <= 0:
+            problems.append(
+                f"serve.loop_lag_slow_ms={self.loop_lag_slow_ms} must be "
+                "> 0 (0 would record every callback as slow, unbounded "
+                "attribution overhead)"
             )
         if problems:
             raise ServeConfigError("; ".join(problems))

@@ -78,6 +78,12 @@ from mlops_tpu.serve.wire import (
 
 logger = logging.getLogger("mlops_tpu.serve")
 
+# tpulint Layer-5 manifest: each front-end process is one asyncio loop;
+# FrontendServer's mutable state and the ring client's doorbell path are
+# EVENT-LOOP CONFINED — blocking work (encode, flight-recorder dumps,
+# anomaly scans) goes through run_in_executor, never the loop thread.
+TPULINT_LOOP_CONFINED = ("FrontendServer", "RingClient.on_doorbell")
+
 # How long a front end waits for the engine collector to acknowledge a
 # forwarded /debug/profile request before cancelling it and answering
 # 504. Covers any healthy collector iteration (its idle select tick is
@@ -745,6 +751,20 @@ async def _run_frontend(
         worker_id, config.service_name, config.host, config.port, os.getpid(),
     )
     loop = asyncio.get_running_loop()
+    if config.loop_lag_monitor:
+        # Runtime half of the Layer-5 discipline, per worker process:
+        # the watchdog drains each window max into this worker's shm
+        # cell, so any worker's scrape renders the fleet's lag gauges.
+        from mlops_tpu.analysis.loopcheck import LoopLagSanitizer
+
+        server.loop_monitor = LoopLagSanitizer(
+            slow_ms=config.loop_lag_slow_ms
+        )
+        server.loop_monitor.attach(loop)
+        logger.info(
+            "frontend %d: loop-lag sanitizer armed (slow_ms=%g)",
+            worker_id, config.loop_lag_slow_ms,
+        )
     draining = asyncio.Event()
 
     def _drain(signum=None, frame=None) -> None:
@@ -815,6 +835,13 @@ async def _run_frontend(
             anomaly_state["alerts"] = _read_alert_flags()
         while not draining.is_set():
             await asyncio.sleep(1.0)
+            if server.loop_monitor is not None:
+                # Single-writer shm publish (this worker's own cell):
+                # the gauge shows each worker's worst callback over the
+                # last watchdog window, 0.0 when the loop stayed smooth.
+                server.metrics.set_loop_lag(
+                    server.loop_monitor.snapshot_ms()
+                )
             if server.flightrec is not None:
                 # Executor: a triggered dump writes a file, which must
                 # not stall the accept loop (the recorder is
@@ -845,6 +872,9 @@ async def _run_frontend(
         w.close()
     server.stop_doorbell()
     watchdog.cancel()
+    if server.loop_monitor is not None:
+        server.loop_monitor.detach()
+        server.loop_monitor = None
     with contextlib.suppress(asyncio.TimeoutError):
         await asyncio.wait_for(srv.wait_closed(), timeout=5)
     # AFTER the busy/pending drain above: every finished exchange has

@@ -188,6 +188,11 @@ class HttpProtocol:
         # override `_slo_view`/`_engine_down` so /healthz and the
         # request hooks render their plane's fleet verdict.
         self.flightrec: Any = None
+        # Loop-lag sanitizer (analysis/loopcheck.py): armed by the plane
+        # runner when ``serve.loop_lag_monitor`` is on, else None — the
+        # mlops_tpu_event_loop_lag_ms gauge drains its window max on each
+        # /metrics scrape (single plane) or watchdog pass (ring plane).
+        self.loop_monitor: Any = None
         # Tenant routing (mlops_tpu/tenancy/): the ``x-tenant`` header
         # resolves to a tenant index through this router; subclasses
         # serving a multi-tenant fleet install their own. The default is

@@ -255,6 +255,7 @@ TPULINT_SHM_OWNERSHIP = {
     "brownout_demote": "frontend-worker",
     "trace_dropped": "frontend-worker",
     "flight_dumps": "frontend-worker",
+    "loop_lag_ms": "frontend-worker",
     # engine telemetry blocks (the engine's telemetry loop publishes;
     # reattach/recovery paths on the replica rebuild them)
     "shape_meta": "telemetry-loop",
@@ -662,6 +663,11 @@ class RequestRing:
             # an anomaly tripped evidence capture somewhere — scrape any
             # worker, see every worker's dumps.
             ("flight_dumps", np.dtype(np.uint64), (workers,)),
+            # loopcheck event-loop lag gauge (single writer per worker):
+            # each front end's LoopLagSanitizer window max in ms, 0 when
+            # the monitor is off or the window was quiet — the
+            # always-emit contract needs a real zero, not a gap.
+            ("loop_lag_ms", np.dtype(np.float64), (workers,)),
             # tracewire shape-histogram mirror (trace/shapes.py): the
             # engine's telemetry loop writes its ShapeStats into this
             # fixed table so ANY front end renders the _bucket series on
@@ -1255,6 +1261,12 @@ class ShmWorkerMetrics:
         """Front-end-side dead-work shed (admission/budget 504 before any
         slot submitted) — single-writer cell, same discipline as shed."""
         self._ring.expired[self._worker] += 1
+
+    def set_loop_lag(self, lag_ms: float) -> None:
+        """Publish this worker's event-loop lag window max (loopcheck's
+        ``snapshot_ms``) — single-writer gauge cell, overwritten each
+        publish; any front end renders every worker's cell on a scrape."""
+        self._ring.loop_lag_ms[self._worker] = lag_ms
 
 
 class RingClient:

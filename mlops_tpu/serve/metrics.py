@@ -266,6 +266,10 @@ class ServingMetrics:
         # mirrored from the recorder per scrape; same zero-baseline
         # contract as trace_dropped.
         self.flight_dumps = 0
+        # loopcheck event-loop lag (analysis/loopcheck.py) — the
+        # sanitizer's window max mirrored per scrape; stays 0.0 (and
+        # still exported) with serve.loop_lag_monitor off.
+        self.loop_lag_ms = 0.0
         # Lifecycle gauges (mlops_tpu/lifecycle/), per tenant: empty until
         # a controller installs a snapshot — the series are only exported
         # when a loop is actually running, so a loop-less deployment's
@@ -436,6 +440,14 @@ class ServingMetrics:
         with self._lock:
             self.flight_dumps = int(total)
 
+    def set_loop_lag(self, lag_ms: float) -> None:
+        """Mirror the loop sanitizer's window max
+        (`analysis/loopcheck.LoopLagSanitizer.snapshot_ms`) — 0.0 when
+        the monitor is off or the window since the last scrape was
+        quiet."""
+        with self._lock:
+            self.loop_lag_ms = float(lag_ms)
+
     def count_tier(self, tier: str) -> None:
         """One request routed to ``tier`` (a member of the closed TIERS
         set — callers resolve through the engine, never request text)."""
@@ -504,6 +516,22 @@ class ServingMetrics:
             "# TYPE mlops_tpu_flightrec_dumps_total counter",
             f"mlops_tpu_flightrec_dumps_total {int(flight_dumps)}",
         ]
+
+    @staticmethod
+    def loop_lag_lines(lag_by_worker: list[tuple[str, float]]) -> list[str]:
+        """The event-loop lag block (loopcheck, Layer 5's runtime twin) —
+        ONE definition shared by the single-process render and the ring
+        render so both telemetry planes export identical series names.
+        Always emitted at a 0.0 baseline: an absent series must never be
+        indistinguishable from "monitor off", and 0.0 is a true reading
+        (no callback held the loop since the last scrape)."""
+        lines = ["# TYPE mlops_tpu_event_loop_lag_ms gauge"]
+        for worker, lag_ms in lag_by_worker:
+            lines.append(
+                f'mlops_tpu_event_loop_lag_ms{{worker="{worker}"}} '
+                f"{float(lag_ms):.3f}"
+            )
+        return lines
 
     @staticmethod
     def survivability_lines(
@@ -740,6 +768,9 @@ class ServingMetrics:
                     self.flight_dumps,
                 )
             )
+            # Single-process plane: ONE event loop, so one worker="0"
+            # lag cell (the ring render emits one per front end).
+            lines.extend(self.loop_lag_lines([("0", self.loop_lag_ms)]))
             # Single-process plane: the engine lives in THIS process, so
             # there is no respawn/replay/parking machinery — the block is
             # structurally zero but still exported (identical series set
@@ -955,6 +986,18 @@ def render_ring_metrics(ring) -> str:
             int(ring.rob_vals[:, ROB_DEGRADED].sum()),
             int(ring.trace_dropped.sum()),
             sum(int(x) for x in getattr(ring, "flight_dumps", ())),
+        )
+    )
+    # Event-loop lag, one cell per front-end worker (single-writer shm
+    # gauge each front end's sanitizer publishes) — same shared formatter
+    # and 0.0 baseline as the single-process render's worker="0" cell.
+    lines.extend(
+        ServingMetrics.loop_lag_lines(
+            [
+                (str(w), float(lag))
+                for w, lag in enumerate(getattr(ring, "loop_lag_ms", ()))
+            ]
+            or [("0", 0.0)]
         )
     )
     # Engine-survivability block (ISSUE 11): per-replica rows summed

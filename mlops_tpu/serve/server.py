@@ -44,6 +44,12 @@ logger = logging.getLogger("mlops_tpu.serve")
 # control() calls — debug-endpoint cadence only, never a request path.
 TPULINT_LOCK_ORDER = {"JaxProfiler": ("_lock",)}
 
+# tpulint Layer-5 manifest: HttpServer's mutable state is EVENT-LOOP
+# CONFINED (the prose contract below, machine-checked since Layer 5) —
+# every method runs on the one asyncio thread, so no method may make a
+# blocking call; device/file work goes through self._executor.
+TPULINT_LOOP_CONFINED = ("HttpServer",)
+
 
 class JaxProfiler:
     """`jax.profiler` start/stop control for whichever process owns the
@@ -329,6 +335,10 @@ class HttpServer(HttpProtocol):
             self.metrics.set_trace_dropped(self.tracer.dropped)
         if self.flightrec is not None:
             self.metrics.set_flight_dumps(self.flightrec.landed)
+        if self.loop_monitor is not None:
+            # Worst callback wall time since the previous scrape (the
+            # window resets on read — gauge semantics, 0.0 = quiet).
+            self.metrics.set_loop_lag(self.loop_monitor.snapshot_ms())
         text = self.metrics.render()
         shape_stats = getattr(self.engine, "shape_stats", None)
         if shape_stats is not None:
@@ -735,6 +745,19 @@ async def _serve(
     # before binding would make K8s liveness probes connection-refuse through
     # the whole compile window and restart the pod.)
     loop = asyncio.get_running_loop()
+    if config.loop_lag_monitor:
+        # Runtime half of the Layer-5 discipline: time every callback on
+        # this loop, drain the window max into the
+        # mlops_tpu_event_loop_lag_ms gauge on each /metrics scrape.
+        from mlops_tpu.analysis.loopcheck import LoopLagSanitizer
+
+        server.loop_monitor = LoopLagSanitizer(
+            slow_ms=config.loop_lag_slow_ms
+        )
+        server.loop_monitor.attach(loop)
+        logger.info(
+            "loop-lag sanitizer armed (slow_ms=%g)", config.loop_lag_slow_ms
+        )
     warmup_error: list[BaseException] = []
 
     async def _warm() -> None:
@@ -829,6 +852,9 @@ async def _serve(
         raise
     finally:
         srv.close()
+        if server.loop_monitor is not None:
+            server.loop_monitor.detach()
+            server.loop_monitor = None
         server.stop_telemetry()
         for _, controller in server._tenant_lifecycles():
             # Controller drain (joins its worker thread, detaches the

@@ -1,0 +1,40 @@
+"""TPU604 fixture: awaiting while a synchronous threading mutex is held
+— the loop runs arbitrary callbacks at the suspension point while every
+thread queued on the lock stalls behind a coroutine that may not resume
+for a long time."""
+
+import asyncio
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._value = None
+
+    async def refresh(self):
+        with self._lock:
+            self._value = await self._fetch()  # PLANT: TPU604
+
+    async def refresh_manual(self):
+        self._lock.acquire()
+        result = await self._fetch()  # PLANT: TPU604
+        self._lock.release()
+        return result
+
+    # ---------------------------------------------------- clean shapes
+    async def refresh_async_lock(self):
+        # Coroutine lock: the loop keeps running while waiters queue.
+        async with self._alock:
+            self._value = await self._fetch()
+
+    async def refresh_split(self):
+        # The fix shape: await first, publish under the lock.
+        value = await self._fetch()
+        with self._lock:
+            self._value = value
+
+    async def _fetch(self):
+        await asyncio.sleep(0)
+        return 42

@@ -1,0 +1,81 @@
+"""TPU601 fixture: blocking calls inside event-loop-confined contexts —
+the blocking-monitor-fetch-wedging-/metrics class of bug. Covers the
+shared Layer-3 table, the loop-only extras, confinement propagation into
+a sync helper, and the hot-mutex sub-rule (a lock Layer 3 saw held
+across blocking work must not be acquired on the loop)."""
+
+import asyncio
+import subprocess
+import threading
+import time
+
+import numpy as np
+
+
+class Handler:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._rows = []
+        self._snapshot = None
+        self._count = 0
+        self._event = asyncio.Event()
+
+    # Thread-side: blocks under _stats_lock, so Layer 3 flags TPU403
+    # here — which makes _stats_lock HOT for the loop-side sub-rule.
+    def flush_stats(self):
+        with self._stats_lock:
+            self._snapshot = np.asarray(self._rows)
+
+    async def fetch(self, handle):
+        out = np.asarray(handle.out)  # PLANT: TPU601
+        handle.block_until_ready()  # PLANT: TPU601
+        return out
+
+    async def backoff(self):
+        time.sleep(0.1)  # PLANT: TPU601
+
+    async def shell_out(self, cmd):
+        subprocess.run(cmd)  # PLANT: TPU601
+        proc = subprocess.Popen(cmd)  # PLANT: TPU601
+        proc.communicate()  # PLANT: TPU601
+
+    async def stats_endpoint(self):
+        with self._stats_lock:  # PLANT: TPU601
+            self._count += 1
+
+    async def stats_probe(self):
+        self._stats_lock.acquire()  # PLANT: TPU601
+        try:
+            return self._count
+        finally:
+            self._stats_lock.release()
+
+    async def respond(self, rows):
+        return self._encode(rows)
+
+    def _encode(self, rows):
+        # Reachable only from the async respond(): inherits confinement.
+        return np.asarray(rows)  # PLANT: TPU601
+
+    # ---------------------------------------------------- clean shapes
+    async def fetch_offloaded(self, loop, handle):
+        # The sanctioned recipe: the blocking work rides the executor.
+        return await loop.run_in_executor(None, self._materialize, handle)
+
+    def _materialize(self, handle):
+        return np.asarray(handle.out)  # thread-side: fine
+
+    async def wait_ready(self):
+        # Awaited subtree: wait() here builds a coroutine, it never
+        # blocks the loop.
+        await asyncio.wait_for(self._event.wait(), 1.0)
+
+    def _on_done(self, fut):
+        # Registered via add_done_callback: the future is complete, so
+        # result() cannot wait.
+        return fut.result()
+
+    async def submit(self, coro):
+        task = asyncio.create_task(coro)
+        task.add_done_callback(self._on_done)
+        return await task

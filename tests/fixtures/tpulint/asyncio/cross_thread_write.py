@@ -1,0 +1,36 @@
+"""TPU603 fixture: a polling thread mutating state the event loop also
+mutates, with no call_soon_threadsafe marshal and no shared mutex — a
+data race against every coroutine touching the same attribute."""
+
+import threading
+
+
+class Plane:
+    def __init__(self, loop):
+        self._loop = loop
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._stats = {}
+        self._seen = 0
+        self._watcher = threading.Thread(target=self._poll, daemon=True)
+        self._watcher.start()
+
+    async def on_request(self):
+        # Loop-confined writers: these attrs belong to the loop.
+        self._depth += 1
+        self._stats["requests"] = self._stats.get("requests", 0) + 1
+        with self._lock:
+            self._seen += 1
+
+    def _poll(self):
+        while True:
+            self._depth = 0  # PLANT: TPU603
+            self._stats["polls"] = 1  # PLANT: TPU603
+            with self._lock:
+                self._seen = 0  # both sides hold _lock: fine
+            self._loop.call_soon_threadsafe(self._reset)
+
+    def _reset(self):
+        # Marshalled onto the loop via call_soon_threadsafe: this body
+        # IS loop-confined, so its writes are the safe shape.
+        self._depth = 0

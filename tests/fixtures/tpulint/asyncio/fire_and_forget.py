@@ -1,0 +1,43 @@
+"""TPU602 fixture: create_task/ensure_future results that are neither
+awaited, stored durably, nor observed — the "Task was destroyed but it
+is pending" class, whose exceptions vanish silently."""
+
+import asyncio
+
+
+class Pump:
+    def __init__(self):
+        self._pump_task = None
+        self._tasks = set()
+
+    async def start_bare(self):
+        asyncio.create_task(self._drain())  # PLANT: TPU602
+
+    async def start_local(self):
+        handle = asyncio.ensure_future(self._drain())  # PLANT: TPU602
+        return None
+
+    async def start_orphan_attr(self):
+        self._orphan = asyncio.create_task(self._drain())  # PLANT: TPU602
+
+    # ---------------------------------------------------- clean shapes
+    async def start_awaited(self):
+        task = asyncio.create_task(self._drain())
+        await task
+
+    async def start_stored(self):
+        # Stored on self AND read back by stop(): observed.
+        self._pump_task = asyncio.create_task(self._drain())
+
+    async def start_collected(self):
+        task = asyncio.create_task(self._drain())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def stop(self):
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+
+    async def _drain(self):
+        while True:
+            await asyncio.sleep(0.1)

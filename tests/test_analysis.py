@@ -59,9 +59,18 @@ def test_each_planted_violation_fires_at_its_line(name):
 
 def test_every_shipped_rule_is_exercised_by_a_fixture():
     """A rule without a fixture is a rule that can silently stop firing."""
-    from mlops_tpu.analysis import CONCURRENCY_RULES, CONTRACT_RULES
+    from mlops_tpu.analysis import (
+        ASYNC_RULES,
+        CONCURRENCY_RULES,
+        CONTRACT_RULES,
+    )
 
-    shipped = set(RULES) | set(CONCURRENCY_RULES) | set(CONTRACT_RULES)
+    shipped = (
+        set(RULES)
+        | set(CONCURRENCY_RULES)
+        | set(CONTRACT_RULES)
+        | set(ASYNC_RULES)
+    )
     planted_rules = set()
     for path in FIXTURES.rglob("*.py"):
         planted_rules |= {rule for _, rule in _planted(path)}
@@ -409,6 +418,142 @@ def test_repo_contract_gate_clean_at_head():
     )
 
 
+# ------------------------------------------------------------ Layer 5
+ASYNC_FIXTURES = FIXTURES / "asyncio"
+# Exact planted counts per async-discipline rule — the precision net in
+# both directions, same contract as the Layer 3/4 count pins above.
+ASYNC_COUNTS = {"TPU601": 9, "TPU602": 3, "TPU603": 2, "TPU604": 2}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        "blocking_in_coroutine",
+        "fire_and_forget",
+        "cross_thread_write",
+        "await_under_lock",
+    ],
+)
+def test_each_planted_async_violation_fires_at_its_line(name):
+    from mlops_tpu.analysis import analyze_async_source
+
+    path = ASYNC_FIXTURES / f"{name}.py"
+    planted = _planted(path)
+    assert planted, f"fixture {name} has no PLANT markers"
+    found = {
+        (f.line, f.rule)
+        for f in analyze_async_source(path.read_text(), path)
+    }
+    assert planted <= found, f"missed: {planted - found}"
+    extra = {(ln, r) for ln, r in found if (ln, r) not in planted}
+    assert not extra, f"unexpected findings: {extra}"
+
+
+def test_async_fixture_counts_pinned():
+    """Exact per-rule counts over the asyncio dir analyzed as ONE project
+    (cross-file confinement must not add or lose findings versus the
+    per-file runs) — and the CLI detects all of them through
+    `analyze --async`."""
+    from collections import Counter
+
+    from mlops_tpu.analysis import analyze_async_paths
+    from mlops_tpu.cli import main
+
+    findings = analyze_async_paths([ASYNC_FIXTURES])
+    assert dict(Counter(f.rule for f in findings)) == ASYNC_COUNTS
+    planted = {
+        (path.as_posix(), lineno, rule)
+        for path in sorted(ASYNC_FIXTURES.iterdir())
+        for lineno, rule in _planted(path)
+    }
+    found = {(f.path, f.line, f.rule) for f in findings}
+    assert found == planted
+    assert (
+        main(["analyze", "--no-trace", "--async", str(ASYNC_FIXTURES)])
+        == 1
+    )
+
+
+def test_async_layer_requires_flag():
+    """Without --async the fixtures raise no TPU60x findings (the planted
+    files are Layer-1 clean by construction)."""
+    from mlops_tpu.cli import main
+
+    assert main(["analyze", "--no-trace", str(ASYNC_FIXTURES)]) == 0
+
+
+def test_async_rules_respect_suppressions():
+    from mlops_tpu.analysis import analyze_async_source
+
+    source = (
+        "import time\n"
+        "async def tick():\n"
+        "    time.sleep(0.1)  # tpulint: disable=TPU601\n"
+    )
+    assert analyze_async_source(source, "inline.py") == []
+    kept = analyze_async_source(source, "inline.py", keep_suppressed=True)
+    assert [f.rule for f in kept] == ["TPU601"]
+
+
+def test_async_suppressions_count_in_ledger(tmp_path, capsys):
+    """A disable covering a Layer-5 finding is LIVE in the ledger even
+    though Layer 5 is cross-file: audit_paths computes the async findings
+    project-wide and slices them per file, exactly like Layer 4's."""
+    from mlops_tpu.cli import main
+
+    mod = tmp_path / "looped.py"
+    mod.write_text(
+        "import time\n"
+        "async def tick():\n"
+        "    time.sleep(0.1)  # tpulint: disable=TPU601\n"
+    )
+    assert main(["analyze", "--list-suppressions", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "looped.py:3: disable=TPU601 [live]" in out
+
+
+def test_repo_async_gate_clean_at_head():
+    """`analyze --async` over the shipped package exits clean: the serve
+    plane's executor-offload discipline (every blocking call rides
+    run_in_executor, no fire-and-forget tasks, no unmarshalled
+    cross-thread writes, no await under a sync mutex) holds at HEAD."""
+    from mlops_tpu.analysis import analyze_async_paths
+    from mlops_tpu.cli import main
+
+    package = Path(__file__).parents[1] / "mlops_tpu"
+    assert analyze_async_paths([package]) == []
+    assert main(["analyze", "--no-trace", "--async", str(package)]) == 0
+
+
+def test_removing_executor_offload_yields_one_tpu601():
+    """The mutation scenario: strip ONE executor offload from the serve
+    plane in memory (the monitor fetch — the exact /metrics-wedging bug
+    class Layer 5 exists for) and the gate must produce exactly one
+    TPU601 at the de-offloaded call."""
+    import re as _re
+
+    from mlops_tpu.analysis import analyze_async_source
+
+    server_py = (
+        Path(__file__).parents[1] / "mlops_tpu" / "serve" / "server.py"
+    )
+    source = server_py.read_text()
+    assert analyze_async_source(source, server_py) == []
+    pattern = (
+        r"await loop\.run_in_executor\(\s*"
+        r"self\._executor, eng\.monitor_snapshot\s*\)"
+    )
+    mutated, n = _re.subn(
+        pattern,
+        "jax.device_get(eng.monitor_snapshot())",
+        source,
+    )
+    assert n == 1, "the monitor-fetch offload moved; update the pattern"
+    findings = analyze_async_source(mutated, server_py)
+    assert [f.rule for f in findings] == ["TPU601"]
+    assert "jax.device_get()" in findings[0].message
+
+
 # ------------------------------------------- suppression ledger (TPU400)
 def test_list_suppressions_reports_live_and_stale(tmp_path, capsys):
     from mlops_tpu.cli import main
@@ -622,6 +767,124 @@ def test_instrument_locks_swaps_and_restores(warm_engine):
     assert isinstance(original, type(threading.Lock()))
 
 
+# ------------------------------------------- runtime loop-lag sanitizer
+def test_loopcheck_times_slow_callback_with_attribution():
+    """A coroutine that blocks the loop is timed with its qualname — the
+    runtime counterpart of TPU601."""
+    import asyncio
+    import time
+
+    from mlops_tpu.analysis.loopcheck import instrument_loop
+
+    async def stall():
+        time.sleep(0.03)  # deliberate: the bug class under test
+
+    async def main(san_holder):
+        loop = asyncio.get_running_loop()
+        with instrument_loop(loop, slow_ms=10.0) as san:
+            await asyncio.create_task(stall())
+            san_holder.append(san)
+        # detached: the loop's own scheduling methods are restored
+        assert "call_soon" not in vars(loop)
+
+    holder = []
+    asyncio.run(main(holder))
+    san = holder[0]
+    assert san.max_lag_ms >= 25.0
+    assert san.callbacks > 0
+    slow = [r for r in san.slow if "stall" in r.label]
+    assert slow and slow[0].label.startswith("task:")
+    assert "held the event loop" in str(slow[0])
+    assert slow[0].schedule_site  # capture_stacks defaults on here
+
+
+def test_loopcheck_assert_max_lag_and_window_reset():
+    import asyncio
+    import time
+
+    from mlops_tpu.analysis.loopcheck import LoopLagSanitizer
+
+    san = LoopLagSanitizer(slow_ms=10.0)
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        san.attach(loop)
+        try:
+            await asyncio.sleep(0)
+            time.sleep(0.02)  # rides the coroutine step: seen as lag
+            await asyncio.sleep(0)
+        finally:
+            san.detach()
+
+    asyncio.run(main())
+    # Gauge semantics: the first snapshot drains the window's max, a
+    # quiet window then reads 0.0 — while the all-time max still gates.
+    assert san.snapshot_ms() >= 15.0
+    assert san.snapshot_ms() == 0.0
+    san.assert_max_lag(1000.0)  # under the bar: no raise
+    with pytest.raises(AssertionError) as err:
+        san.assert_max_lag(10.0)
+    assert "event-loop lag" in str(err.value)
+    assert "held the event loop" in str(err.value)
+
+
+def test_loopcheck_attach_is_exclusive_and_detach_idempotent():
+    import asyncio
+
+    from mlops_tpu.analysis.loopcheck import LoopLagSanitizer
+
+    san = LoopLagSanitizer()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        san.attach(loop)
+        with pytest.raises(RuntimeError):
+            san.attach(loop)
+        san.detach()
+        san.detach()  # no-op, like lockcheck's restore
+        assert "call_soon" not in vars(loop)
+        assert "call_later" not in vars(loop)
+
+    asyncio.run(main())
+
+
+def test_loopcheck_seeded_perturbation_is_deterministic():
+    """The SchedulePerturber discipline from lockcheck: a seeded
+    perturbation shifts the interleaving without changing results —
+    the same seed replays the same schedule, and the workload's output
+    stays bit-identical to the unperturbed run."""
+    import asyncio
+
+    from mlops_tpu.analysis.loopcheck import instrument_loop
+
+    async def workload():
+        out = []
+
+        async def step(i):
+            await asyncio.sleep(0)
+            out.append(i)
+
+        await asyncio.gather(*(step(i) for i in range(8)))
+        return out
+
+    def run(seed):
+        async def main():
+            loop = asyncio.get_running_loop()
+            with instrument_loop(
+                loop, slow_ms=1000.0, perturb_seed=seed
+            ) as san:
+                result = await workload()
+            return result, san.callbacks
+
+        return asyncio.run(main())
+
+    baseline = asyncio.run(workload())
+    r7a, calls7a = run(7)
+    r7b, calls7b = run(7)
+    assert r7a == r7b == baseline
+    assert calls7a == calls7b > 0
+
+
 # ------------------------------------------------------------ Layer 2
 def test_trace_layer_clean_on_registered_entry_points():
     """The acceptance gate: every registered entry point traces abstractly
@@ -741,12 +1004,13 @@ def test_cli_analyze_nonzero_on_fixtures_and_zero_on_package(capsys):
 
     package = Path(__file__).parents[1] / "mlops_tpu"
     assert main(["analyze", "--no-trace", "--strict", str(package)]) == 0
-    # The CI gate shape minus the (slow) trace layer: concurrency rules
-    # and the stale-suppression audit are clean on the shipped package.
+    # The CI gate shape minus the (slow) trace layer: concurrency rules,
+    # the async/event-loop rules, and the stale-suppression audit are
+    # clean on the shipped package.
     assert (
         main(
             ["analyze", "--no-trace", "--strict", "--concurrency",
-             "--fail-stale", str(package)]
+             "--async", "--fail-stale", str(package)]
         )
         == 0
     )
@@ -754,35 +1018,39 @@ def test_cli_analyze_nonzero_on_fixtures_and_zero_on_package(capsys):
 
 @pytest.mark.slow
 def test_cli_analyze_full_gate(capsys):
-    """`mlops-tpu analyze --strict --concurrency --contracts --fail-stale
-    mlops_tpu/` — the exact CI invocation — exits 0 with every entry
-    point traced."""
+    """`mlops-tpu analyze --strict --concurrency --contracts --async
+    --fail-stale mlops_tpu/` — the exact CI invocation — exits 0 with
+    every entry point traced."""
     from mlops_tpu.cli import main
 
     package = Path(__file__).parents[1] / "mlops_tpu"
     assert (
         main(
             ["analyze", "--strict", "--concurrency", "--contracts",
-             "--fail-stale", str(package)]
+             "--async", "--fail-stale", str(package)]
         )
         == 0
     )
     out = capsys.readouterr().out
     # One note per registered entry point (analysis/entrypoints.py) —
     # keep in lockstep with the trace-layer test's count above.
-    assert out.count("traced ") == 5
+    assert out.count("traced ") == 9
 
 
 def test_rule_catalog_documented():
-    """Every rule ID (all three layers + the suppression audit) appears in
+    """Every rule ID (all five layers + the suppression audit) appears in
     docs/static-analysis.md."""
-    from mlops_tpu.analysis import CONCURRENCY_RULES, CONTRACT_RULES
+    from mlops_tpu.analysis import (
+        ASYNC_RULES,
+        CONCURRENCY_RULES,
+        CONTRACT_RULES,
+    )
     from mlops_tpu.analysis.suppressions import STALE_RULE
     from mlops_tpu.analysis.traces import TRACE_RULES
 
     doc = (Path(__file__).parents[1] / "docs" / "static-analysis.md").read_text()
     for rule in [
-        *RULES, *CONCURRENCY_RULES, *CONTRACT_RULES, STALE_RULE,
-        *TRACE_RULES,
+        *RULES, *CONCURRENCY_RULES, *CONTRACT_RULES, *ASYNC_RULES,
+        STALE_RULE, *TRACE_RULES,
     ]:
         assert rule in doc, f"{rule} missing from docs/static-analysis.md"

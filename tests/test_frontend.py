@@ -1156,6 +1156,84 @@ def test_ring_lock_discipline_under_perturbed_schedules(
         assert payload == json.loads(json.dumps(expected))
 
 
+# ---------------------------------------------------------- loop hygiene
+# Same seed split as the lock-hygiene sweep above: seed 0 in the serial
+# tier-1 gate, seeds 1/2 on CI's parallel job.
+@pytest.mark.parametrize(
+    "seed",
+    [0, pytest.param(1, marks=pytest.mark.slow),
+     pytest.param(2, marks=pytest.mark.slow)],
+)
+def test_ring_loop_lag_bounded_under_burst(
+    engine, prep_path, sample_request, seed
+):
+    """Layer 5's runtime half over the real plane: serve.loop_lag_monitor
+    arms a LoopLagSanitizer on every forked front end's event loop while
+    the engine side runs under seeded schedule perturbation. Through a
+    concurrent burst the scraped mlops_tpu_event_loop_lag_ms gauge must
+    stay under a bound generous for a CI container yet far below a
+    wedged loop (one inline monitor fetch or response encode rides the
+    loop for 100ms+), and responses stay bit-identical to the
+    single-process path."""
+    from mlops_tpu.analysis.lockcheck import instrument_locks
+
+    expected = engine.predict_records(sample_request)
+    lag_samples: list = []
+    stop = threading.Event()
+
+    with multi_worker_plane(
+        engine, prep_path, workers=2, slots_small=16,
+        loop_lag_monitor=True, loop_lag_slow_ms=100.0,
+    ) as (port, ring, _, service):
+
+        def scrape_lag():
+            # Any worker's scrape renders the fleet view from shm; the
+            # watchdog overwrites each worker's cell with its last 1 s
+            # window max, so sampling faster than the publish cadence
+            # observes every window.
+            while not stop.is_set():
+                with contextlib.suppress(OSError, ValueError):
+                    _, _, body = http_exchange(port, "GET", "/metrics")
+                    for line in body.decode().splitlines():
+                        if line.startswith("mlops_tpu_event_loop_lag_ms{"):
+                            lag_samples.append(
+                                float(line.rsplit(" ", 1)[1])
+                            )
+                stop.wait(0.25)
+
+        scraper = threading.Thread(target=scrape_lag)
+        scraper.start()
+        with instrument_locks(service, perturb_seed=seed), \
+                instrument_locks(engine, perturb_seed=seed):
+            results: list = []
+            lock = threading.Lock()
+
+            def call():
+                r = predict(port, sample_request)
+                with lock:
+                    results.append(r)
+
+            threads = [threading.Thread(target=call) for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        # One full watchdog pass after the burst so the busiest window's
+        # max is published and scraped before the plane tears down.
+        time.sleep(1.5)
+        stop.set()
+        scraper.join(timeout=10)
+    # The always-emit contract: the gauge renders even with zero lag, so
+    # an empty sample set means the series vanished, not a smooth loop.
+    assert lag_samples, "mlops_tpu_event_loop_lag_ms never rendered"
+    assert max(lag_samples) < 500.0, (
+        f"event-loop lag {max(lag_samples):.1f}ms on a front-end worker"
+    )
+    for status, _, payload in results:
+        assert status == 200
+        assert payload == json.loads(json.dumps(expected))
+
+
 # ----------------------------------------------------- bench key contract
 @pytest.mark.slow
 def test_bench_http_multi_stage_key_contract(engine, sample_request):
